@@ -1,0 +1,149 @@
+"""Device management (reference: `python/paddle/device/__init__.py:265`
+``set_device`` and the phi DeviceManager, `phi/backends/device_manager.h:134`).
+
+TPU-native: devices are PJRT devices enumerated by JAX; there is no manual
+stream/event surface because XLA schedules asynchronously — the stream-like
+knobs are kept as no-op shims for API parity.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["set_device", "get_device", "get_all_devices", "device_count",
+           "is_compiled_with_cuda", "is_compiled_with_rocm",
+           "is_compiled_with_xpu", "is_compiled_with_ipu",
+           "is_compiled_with_custom_device", "synchronize", "Stream", "Event",
+           "current_stream", "cuda"]
+
+_current_device = None
+
+
+def _platform():
+    return jax.default_backend()
+
+
+def set_device(device: str):
+    """Select default device: 'tpu', 'cpu', 'tpu:0' etc."""
+    global _current_device
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    devs = [d for d in jax.devices() if name in (d.platform, "gpu", "tpu", "cpu", "axon")]
+    if not devs:
+        devs = jax.devices()
+    _current_device = devs[min(idx, len(devs) - 1)]
+    try:
+        jax.config.update("jax_default_device", _current_device)
+    except Exception:
+        pass
+    return _current_device
+
+
+def get_device() -> str:
+    d = _current_device or jax.devices()[0]
+    return f"{d.platform}:{getattr(d, 'id', 0)}"
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_custom_device(name="tpu"):
+    return True
+
+
+def synchronize(device=None):
+    """Block until all dispatched work completes (stream sync analog)."""
+    try:
+        (jax.device_put(0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class Stream:
+    """No-op shim: XLA owns scheduling; kept for API parity with
+    ``paddle.device.Stream``."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+class _CudaShim:
+    """``paddle.device.cuda`` compatibility namespace (no CUDA on TPU)."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return 0
+
+
+cuda = _CudaShim()

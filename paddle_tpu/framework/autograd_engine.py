@@ -1,0 +1,185 @@
+"""Reverse-mode autograd engine.
+
+Analog of the reference's queue-based backward runner
+(`paddle/fluid/eager/backward.cc` — ``RunBackward`` + ``GeneralGrad`` for
+``paddle.grad()``). Works on the GradNode tape recorded by
+``framework.tensor.run_op``; each node's backward is a ``jax.vjp`` closure, so
+gradients are exactly JAX's gradients.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import Tensor, GradNode
+
+__all__ = ["backward", "grad"]
+
+
+def _topo_order(roots):
+    """Reverse-topological order of GradNodes reachable from root tensors."""
+    visited = set()
+    order = []
+
+    def visit(node):
+        if node is None or id(node) in visited:
+            return
+        visited.add(id(node))
+        for t in node.inputs:
+            visit(t._node)
+        order.append(node)
+
+    for t in roots:
+        visit(t._node)
+    order.reverse()
+    return order
+
+
+def _run(tensors, grad_tensors, accumulate_into_grad, target_ids=None,
+         retain_graph=False, create_graph=False):
+    """Core engine shared by ``Tensor.backward`` and ``paddle.grad``.
+
+    grads are accumulated per *Tensor object* (keyed by id), matching the
+    reference's ``GradTensorHolder`` multi-path accumulation.
+    """
+    from .tensor import no_grad
+
+    # cotangent store: id(tensor) -> jnp array
+    cotangents = {}
+    holders = {}  # id -> Tensor (keep alive)
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._node is None:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True and no "
+                "grad history")
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad_tensor must be given for non-scalar outputs "
+                    f"(shape {t.shape})")
+            g_arr = jnp.ones_like(t._data)
+        else:
+            g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        cotangents[id(t)] = cotangents.get(id(t), 0) + g_arr
+        holders[id(t)] = t
+
+    order = _topo_order(tensors)
+
+    # map (node, out_index) -> output tensor ids seen on the tape: we stored
+    # the linkage on the tensors themselves, so walk tensors via node inputs.
+    # Output tensors are only reachable as graph roots or as node inputs, and
+    # each records (_node, _out_index); collect them lazily as we traverse.
+    def fire_hooks(t, g_arr):
+        if t._backward_hooks:
+            tg = Tensor(g_arr, stop_gradient=not create_graph)
+            for hook in t._backward_hooks:
+                r = hook(tg)
+                if r is not None:
+                    tg = r if isinstance(r, Tensor) else Tensor(r)
+            return tg._data
+        return g_arr
+
+    grad_ctx = (lambda: _null_ctx()) if create_graph else no_grad
+
+    results = {}
+    with grad_ctx():
+        for node in order:
+            # gather cotangents for this node's outputs
+            outs = []
+            any_ct = False
+            for i in range(node.n_outputs):
+                found = None
+                for tid, arr in cotangents.items():
+                    t = holders[tid]
+                    if t._node is node and t._out_index == i:
+                        found = arr
+                        break
+                if found is None:
+                    shape, dt = node.out_avals[i]
+                    outs.append(jnp.zeros(shape, dt))
+                else:
+                    any_ct = True
+                    outs.append(found)
+            if not any_ct:
+                continue
+            ct_in = node.vjp_fn(tuple(outs) if node.n_outputs > 1 else outs[0])
+            for t, g_arr in zip(node.inputs, ct_in):
+                g_arr = fire_hooks(t, g_arr)
+                key = id(t)
+                holders[key] = t
+                if key in cotangents:
+                    cotangents[key] = cotangents[key] + g_arr
+                else:
+                    cotangents[key] = g_arr
+            if not retain_graph:
+                node.vjp_fn = _used_up
+
+    # write leaf grads
+    for tid, arr in cotangents.items():
+        t = holders[tid]
+        if target_ids is not None:
+            if tid in target_ids:
+                results[tid] = arr
+            continue
+        if t._node is None and not t.stop_gradient:
+            if accumulate_into_grad:
+                if t.grad is None:
+                    t.grad = Tensor(arr, stop_gradient=True)
+                else:
+                    t.grad = Tensor(t.grad._data + arr, stop_gradient=True)
+    return results
+
+
+def _used_up(*_):
+    raise RuntimeError(
+        "Trying to backward through the graph a second time. Set "
+        "retain_graph=True when calling backward the first time.")
+
+
+class _null_ctx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """``paddle.autograd.backward`` — accumulate into ``.grad`` of leaves."""
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    _run(tensors, grad_tensors, accumulate_into_grad=True,
+         retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """``paddle.grad`` — return grads of ``inputs`` without touching ``.grad``.
+
+    Reference: ``GeneralGrad`` in `fluid/eager/backward.cc:103`.
+    """
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    target_ids = {id(t) for t in inputs}
+    res = _run(outputs, grad_outputs, accumulate_into_grad=False,
+               target_ids=target_ids, retain_graph=retain_graph,
+               create_graph=create_graph)
+    out = []
+    for t in inputs:
+        if id(t) in res:
+            out.append(Tensor(res[id(t)], stop_gradient=not create_graph))
+        else:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the input tensors was not used in the graph "
+                    "(pass allow_unused=True to return None for it).")
+            out.append(None)
+    return out

@@ -1,0 +1,15 @@
+"""Einsum (reference: `python/paddle/tensor/einsum.py` — here a direct
+lowering to XLA's native einsum, which maps contractions onto the MXU)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import run_op
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands, name=None):
+    return run_op("einsum",
+                  lambda *xs: jnp.einsum(equation, *xs), list(operands))
