@@ -91,7 +91,29 @@ class PyLayer(metaclass=PyLayerMeta):
                     result.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
             return tuple(result)
 
-        node = GradNode(cls.__name__, vjp_fn, diff_inputs, len(outs), out_avals)
+        def replay_fn(ct_tensors):
+            """Tensor-level backward for create_graph: runs the user's
+            backward on live Tensors so its ops record their own tape."""
+            grads = cls.backward(ctx, *ct_tensors)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            grad_map = {}
+            gi = 0
+            for t in tensor_inputs:
+                if gi < len(grads):
+                    grad_map[id(t)] = grads[gi]
+                    gi += 1
+            result = []
+            for t in diff_inputs:
+                g = grad_map.get(id(t))
+                if g is None:
+                    result.append(Tensor(jnp.zeros(tuple(t.shape), t.dtype)))
+                else:
+                    result.append(g if isinstance(g, Tensor) else Tensor(g))
+            return tuple(result)
+
+        node = GradNode(cls.__name__, vjp_fn, diff_inputs, len(outs), out_avals,
+                        replay_fn=replay_fn)
         for i, o in enumerate(outs):
             if isinstance(o, Tensor) and jnp.issubdtype(o.dtype, jnp.inexact):
                 o.stop_gradient = False

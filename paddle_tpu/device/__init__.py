@@ -18,24 +18,46 @@ __all__ = ["set_device", "get_device", "get_all_devices", "device_count",
 
 _current_device = None
 
+_DEVICE_NAMES = ("cpu", "gpu", "tpu", "cuda", "axon")
+
 
 def _platform():
     return jax.default_backend()
 
 
+def _looks_like_device(spec) -> bool:
+    """True if ``spec`` is a device string like 'tpu' / 'cpu:0' / 'cuda:1'."""
+    if not isinstance(spec, str):
+        return False
+    return spec.lower().partition(":")[0] in _DEVICE_NAMES
+
+
+def _resolve_device(spec: str):
+    """Resolve a device string to a concrete JAX device (shared by
+    ``set_device`` and ``Tensor.to``)."""
+    name, _, idx = spec.lower().partition(":")
+    if name == "cuda":
+        name = "gpu"
+    idx = int(idx) if idx else 0
+    devs = [d for d in jax.devices()
+            if d.platform == name
+            or (name == "gpu" and d.platform in ("cuda", "rocm"))]
+    if not devs and name == "cpu":
+        # CPU devices exist even when an accelerator is the default backend;
+        # ask the CPU backend explicitly.
+        devs = jax.devices("cpu")
+    if not devs:
+        raise ValueError(
+            f"no '{name}' device available; platforms present: "
+            f"{sorted({d.platform for d in jax.devices()})}")
+    return devs[min(idx, len(devs) - 1)]
+
+
 def set_device(device: str):
     """Select default device: 'tpu', 'cpu', 'tpu:0' etc."""
     global _current_device
-    name, _, idx = device.partition(":")
-    idx = int(idx) if idx else 0
-    devs = [d for d in jax.devices() if name in (d.platform, "gpu", "tpu", "cpu", "axon")]
-    if not devs:
-        devs = jax.devices()
-    _current_device = devs[min(idx, len(devs) - 1)]
-    try:
-        jax.config.update("jax_default_device", _current_device)
-    except Exception:
-        pass
+    _current_device = _resolve_device(device)
+    jax.config.update("jax_default_device", _current_device)
     return _current_device
 
 

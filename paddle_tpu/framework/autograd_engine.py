@@ -5,48 +5,90 @@ Analog of the reference's queue-based backward runner
 ``paddle.grad()``). Works on the GradNode tape recorded by
 ``framework.tensor.run_op``; each node's backward is a ``jax.vjp`` closure, so
 gradients are exactly JAX's gradients.
+
+Engine design:
+- iterative DFS topological order (no recursion limit on deep graphs);
+- cotangents for non-leaf tensors are keyed by ``(id(node), out_index)`` so
+  gathering a node's output grads is O(n_outputs), not a scan over all live
+  cotangents — backward is O(edges) overall;
+- ``create_graph=True`` replays each node's backward *through the tape*: the
+  vjp is re-derived from the node's saved pure function as a differentiable
+  op of (primals, cotangents), so grad-of-grad works (the vjp closure alone
+  treats primals as constants and would silently drop second-order terms).
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
-from .tensor import Tensor, GradNode
+from .tensor import Tensor, run_op
 
 __all__ = ["backward", "grad"]
 
 
 def _topo_order(roots):
-    """Reverse-topological order of GradNodes reachable from root tensors."""
+    """Reverse-topological order of GradNodes reachable from root tensors.
+
+    Iterative DFS with an explicit stack (gray/black marking): graphs deeper
+    than Python's recursion limit — long chains from unrolled loops — are
+    fine, and diamond-shaped DAGs order correctly.
+    """
     visited = set()
     order = []
-
-    def visit(node):
-        if node is None or id(node) in visited:
-            return
+    stack = [(t._node, False) for t in roots if t._node is not None]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
         visited.add(id(node))
+        stack.append((node, True))
         for t in node.inputs:
-            visit(t._node)
-        order.append(node)
-
-    for t in roots:
-        visit(t._node)
+            n = t._node
+            if n is not None and id(n) not in visited:
+                stack.append((n, False))
     order.reverse()
     return order
 
 
-def _run(tensors, grad_tensors, accumulate_into_grad, target_ids=None,
+def _key(t):
+    """Cotangent-store key for a tensor: leaves by identity, non-leaves by
+    their (node, output-slot) so lookup during the node sweep is O(1)."""
+    if t._node is None:
+        return id(t)
+    return (id(t._node), t._out_index)
+
+
+def _run(tensors, grad_tensors, accumulate_into_grad, targets=None,
          retain_graph=False, create_graph=False):
     """Core engine shared by ``Tensor.backward`` and ``paddle.grad``.
 
-    grads are accumulated per *Tensor object* (keyed by id), matching the
-    reference's ``GradTensorHolder`` multi-path accumulation.
+    grads accumulate per tensor slot (``_key``), matching the reference's
+    ``GradTensorHolder`` multi-path accumulation.
     """
     from .tensor import no_grad
 
-    # cotangent store: id(tensor) -> jnp array
+    # cotangent store: _key(tensor) -> jnp array (or Tensor if create_graph)
     cotangents = {}
-    holders = {}  # id -> Tensor (keep alive)
+    leaf_holders = {}  # id -> Tensor (keep leaves alive for .grad writes)
+
+    def _raw(g):
+        return g._data if isinstance(g, Tensor) else g
+
+    def _acc(key, g):
+        if key in cotangents:
+            prev = cotangents[key]
+            if create_graph:
+                pt = prev if isinstance(prev, Tensor) else Tensor(prev)
+                gt = g if isinstance(g, Tensor) else Tensor(g)
+                cotangents[key] = run_op("grad_accumulate", jnp.add, (pt, gt))
+            else:
+                cotangents[key] = prev + _raw(g)
+        else:
+            cotangents[key] = g
 
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient and t._node is None:
@@ -61,74 +103,121 @@ def _run(tensors, grad_tensors, accumulate_into_grad, target_ids=None,
             g_arr = jnp.ones_like(t._data)
         else:
             g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
-        cotangents[id(t)] = cotangents.get(id(t), 0) + g_arr
-        holders[id(t)] = t
+        _acc(_key(t), g_arr)
+        if t._node is None:
+            leaf_holders[id(t)] = t
 
     order = _topo_order(tensors)
 
-    # map (node, out_index) -> output tensor ids seen on the tape: we stored
-    # the linkage on the tensors themselves, so walk tensors via node inputs.
-    # Output tensors are only reachable as graph roots or as node inputs, and
-    # each records (_node, _out_index); collect them lazily as we traverse.
-    def fire_hooks(t, g_arr):
+    def fire_hooks(t, g):
         if t._backward_hooks:
-            tg = Tensor(g_arr, stop_gradient=not create_graph)
+            tg = g if isinstance(g, Tensor) else Tensor(g, stop_gradient=not create_graph)
             for hook in t._backward_hooks:
                 r = hook(tg)
                 if r is not None:
                     tg = r if isinstance(r, Tensor) else Tensor(r)
-            return tg._data
-        return g_arr
+            return tg if create_graph else tg._data
+        return g
 
-    grad_ctx = (lambda: _null_ctx()) if create_graph else no_grad
+    grad_ctx = _null_ctx if create_graph else no_grad
 
+    # snapshot targets as their cotangents complete: a slot's accumulation is
+    # final exactly when its producing node is processed (all consumers come
+    # earlier in reverse-topo order), and the sweep pops it then.
     results = {}
+    target_slots = {}
+    if targets is not None:
+        for t in targets:
+            target_slots.setdefault(_key(t), []).append(id(t))
+
+    def _snapshot(key, val):
+        for tid in target_slots.get(key, ()):
+            results[tid] = val
+
     with grad_ctx():
         for node in order:
-            # gather cotangents for this node's outputs
+            # O(1) gather of this node's output cotangents
             outs = []
             any_ct = False
             for i in range(node.n_outputs):
-                found = None
-                for tid, arr in cotangents.items():
-                    t = holders[tid]
-                    if t._node is node and t._out_index == i:
-                        found = arr
-                        break
+                found = cotangents.pop((id(node), i), None)
+                if found is not None:
+                    _snapshot((id(node), i), found)
                 if found is None:
                     shape, dt = node.out_avals[i]
                     outs.append(jnp.zeros(shape, dt))
                 else:
                     any_ct = True
-                    outs.append(found)
+                    outs.append(_raw(found) if not create_graph else found)
             if not any_ct:
                 continue
-            ct_in = node.vjp_fn(tuple(outs) if node.n_outputs > 1 else outs[0])
-            for t, g_arr in zip(node.inputs, ct_in):
-                g_arr = fire_hooks(t, g_arr)
-                key = id(t)
-                holders[key] = t
-                if key in cotangents:
-                    cotangents[key] = cotangents[key] + g_arr
-                else:
-                    cotangents[key] = g_arr
+            if node.vjp_fn is _used_up:
+                node.vjp_fn()  # raises the freed-graph error
+            if create_graph:
+                ct_in = _replay_through_tape(node, outs)
+            else:
+                ct_in = node.vjp_fn(tuple(outs) if node.n_outputs > 1 else outs[0])
+            for t, g in zip(node.inputs, ct_in):
+                g = fire_hooks(t, g)
+                key = _key(t)
+                if t._node is None:
+                    leaf_holders[id(t)] = t
+                _acc(key, g)
             if not retain_graph:
                 node.vjp_fn = _used_up
+                node.pure_fn = None    # release saved-forward closures
+                node.replay_fn = None
+
+    if targets is not None:
+        for t in targets:
+            val = cotangents.get(_key(t))
+            if val is not None:
+                results[id(t)] = val
+        return results
 
     # write leaf grads
-    for tid, arr in cotangents.items():
-        t = holders[tid]
-        if target_ids is not None:
-            if tid in target_ids:
-                results[tid] = arr
+    for tid, t in leaf_holders.items():
+        arr = cotangents.get(tid)
+        if arr is None:
             continue
-        if t._node is None and not t.stop_gradient:
-            if accumulate_into_grad:
-                if t.grad is None:
-                    t.grad = Tensor(arr, stop_gradient=True)
-                else:
-                    t.grad = Tensor(t.grad._data + arr, stop_gradient=True)
+        if t._node is None and not t.stop_gradient and accumulate_into_grad:
+            arr = _raw(arr)
+            if t.grad is None:
+                t.grad = Tensor(arr, stop_gradient=True)
+            else:
+                t.grad = Tensor(t.grad._data + arr, stop_gradient=True)
     return results
+
+
+def _replay_through_tape(node, out_cts):
+    """Run a node's backward as differentiable ops so a new tape is recorded.
+
+    The vjp is re-derived from ``node.pure_fn`` (the pure jax function of the
+    node's differentiable inputs saved by ``run_op``): as a function of
+    (primals, cotangents) it is itself traceable, so second-order grads see
+    the full dependence on the primal inputs.
+    """
+    ct_tensors = [c if isinstance(c, Tensor) else Tensor(c, stop_gradient=True)
+                  for c in out_cts]
+    if node.pure_fn is None:
+        if node.replay_fn is not None:
+            # PyLayer: the user backward runs Tensor ops, recording its own tape
+            return node.replay_fn(ct_tensors)
+        raise NotImplementedError(
+            f"create_graph=True through op '{node.name}' is not supported: "
+            "the node has no saved forward function or Tensor-level backward.")
+    n_in = len(node.inputs)
+    multi = node.n_outputs > 1
+
+    def grad_fn(*args):
+        primals = args[:n_in]
+        cts = args[n_in:]
+        _, vjp = jax.vjp(node.pure_fn, *primals)
+        return vjp(tuple(cts) if multi else cts[0])
+
+    res = run_op(node.name + "_grad", grad_fn,
+                 tuple(node.inputs) + tuple(ct_tensors))
+    return res if isinstance(res, tuple) else (res,)
 
 
 def _used_up(*_):
@@ -168,14 +257,17 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         grad_outputs = [grad_outputs]
     if retain_graph is None:
         retain_graph = create_graph
-    target_ids = {id(t) for t in inputs}
     res = _run(outputs, grad_outputs, accumulate_into_grad=False,
-               target_ids=target_ids, retain_graph=retain_graph,
+               targets=inputs, retain_graph=retain_graph,
                create_graph=create_graph)
     out = []
     for t in inputs:
         if id(t) in res:
-            out.append(Tensor(res[id(t)], stop_gradient=not create_graph))
+            v = res[id(t)]
+            if isinstance(v, Tensor):
+                out.append(v)
+            else:
+                out.append(Tensor(v, stop_gradient=not create_graph))
         else:
             if not allow_unused:
                 raise RuntimeError(

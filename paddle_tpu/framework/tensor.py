@@ -85,14 +85,18 @@ def enable_grad(fn=None):
 # Grad node: one per recorded op (reference: GradNodeBase)
 # ---------------------------------------------------------------------------
 class GradNode:
-    __slots__ = ("name", "vjp_fn", "inputs", "n_outputs", "out_avals", "__weakref__")
+    __slots__ = ("name", "vjp_fn", "inputs", "n_outputs", "out_avals",
+                 "pure_fn", "replay_fn", "__weakref__")
 
-    def __init__(self, name, vjp_fn, inputs, n_outputs, out_avals):
+    def __init__(self, name, vjp_fn, inputs, n_outputs, out_avals,
+                 pure_fn=None, replay_fn=None):
         self.name = name
         self.vjp_fn = vjp_fn          # tuple-of-cotangents -> tuple-of-input-grads
         self.inputs = inputs          # list[Tensor] — differentiable inputs
         self.n_outputs = n_outputs
         self.out_avals = out_avals    # [(shape, dtype)] for zero-cotangent fill
+        self.pure_fn = pure_fn        # pure fn of diff inputs (create_graph replay)
+        self.replay_fn = replay_fn    # Tensor-level backward (PyLayer create_graph)
 
     def __repr__(self):
         return f"<GradNode {self.name} n_in={len(self.inputs)} n_out={self.n_outputs}>"
@@ -162,7 +166,8 @@ def run_op(name, fn, args, kwargs=None, differentiable=True):
     is_multi = isinstance(out, (tuple, list))
     outs = list(out) if is_multi else [out]
     out_avals = [(o.shape, o.dtype) for o in outs]
-    node = GradNode(name, vjp_fn, diff_tensors, len(outs), out_avals)
+    node = GradNode(name, vjp_fn, diff_tensors, len(outs), out_avals,
+                    pure_fn=pure)
 
     result = _wrap_outputs(name, out, stop_gradient=False)
     rts = result if isinstance(result, tuple) else (result,)
@@ -307,13 +312,24 @@ class Tensor:
         return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
 
     def to(self, *args, **kwargs):
-        # accepts dtype and/or device strings; device moves are XLA-managed
+        """Move/cast: accepts dtype and/or device specs like ``paddle.Tensor.to``.
+
+        Device moves are recorded on the tape (``jax.device_put`` is
+        differentiable), so ``w.to('cpu')`` keeps gradient flow back to ``w``.
+        """
+        from ..device import _resolve_device, _looks_like_device
         out = self
         for a in list(args) + list(kwargs.values()):
-            if isinstance(a, (str, jnp.dtype, type)) and str(a) not in ("cpu", "gpu", "tpu"):
+            if a is None:
+                continue
+            if _looks_like_device(a):
+                dev = _resolve_device(str(a))
+                out = run_op("to_device",
+                             lambda arr: jax.device_put(arr, dev), (out,))
+            else:
                 try:
                     out = out.astype(a)
-                except TypeError:
+                except (TypeError, ValueError):
                     pass
         return out
 

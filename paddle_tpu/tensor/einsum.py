@@ -5,11 +5,15 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..framework.tensor import run_op
+from .registry import defop
 
 __all__ = ["einsum"]
 
 
+@defop(name="einsum")
+def _einsum_impl(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
 def einsum(equation, *operands, name=None):
-    return run_op("einsum",
-                  lambda *xs: jnp.einsum(equation, *xs), list(operands))
+    return _einsum_impl(equation, *operands)

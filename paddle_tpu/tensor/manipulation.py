@@ -450,18 +450,32 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_ax
     if len(pad) == 2 * nd:
         widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
     else:
-        # paddle: pad applies to last len(pad)//2 dims, innermost-last order,
-        # except conv-style NCHW/NCDHW shortcuts
+        # paddle semantics (reference python/paddle/nn/functional/common.py
+        # `pad`): the flat pad list pairs up as (left,right),(top,bottom),...
+        # applied to the *innermost* spatial dim first. For channels-last
+        # layouts (NHWC/NDHWC) the channel axis is skipped.
         k = len(pad) // 2
-        widths = [(0, 0)] * (nd - k)
-        if data_format.startswith("NC") and len(pad) in (4, 6) and nd in (4, 5):
-            # spatial dims after N,C
-            widths = [(0, 0), (0, 0)]
-            for i in range(k):
-                widths.append((pad[2 * i], pad[2 * i + 1]))
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(k)]
+        widths = [(0, 0)] * nd
+        if len(pad) in (2, 4, 6) and nd in (3, 4, 5) and data_format in (
+                "NCL", "NCHW", "NCDHW", "NLC", "NHWC", "NDHWC"):
+            if data_format.startswith("NC"):
+                spatial = list(range(2, nd))
+            else:
+                spatial = list(range(1, nd - 1))
+            if len(pairs) > len(spatial):
+                raise ValueError(
+                    f"pad list has {len(pairs)} (left,right) pairs but "
+                    f"data_format {data_format} only has {len(spatial)} "
+                    "spatial dims")
+            # pairs[0] pads the innermost spatial dim (W), pairs[1] the next
+            # (H), etc.
+            for i, pair in enumerate(pairs):
+                widths[spatial[len(spatial) - 1 - i]] = pair
         else:
-            for i in range(k):
-                widths.append((pad[2 * i], pad[2 * i + 1]))
+            # generic: pad applies to the last k dims, innermost first
+            for i, pair in enumerate(pairs):
+                widths[nd - 1 - i] = pair
     jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
              "circular": "wrap"}[mode]
     if jmode == "constant":

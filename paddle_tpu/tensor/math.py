@@ -337,8 +337,7 @@ def logcumsumexp(x, axis=None):
     if axis is None:
         x = x.reshape(-1)
         axis = 0
-    m = jax.lax.associative_scan(jnp.maximum, x, axis=int(axis))
-    return jnp.log(jnp.cumsum(jnp.exp(x - m), axis=int(axis))) + m
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=int(axis))
 
 
 # -- misc -------------------------------------------------------------------
