@@ -68,49 +68,40 @@ class PyLayer(metaclass=PyLayerMeta):
                        and jnp.issubdtype(t.dtype, jnp.inexact)]
         out_avals = [(tuple(o.shape), o.dtype) for o in outs]
 
-        def vjp_fn(cotangents):
-            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
-            ct_tensors = [Tensor(c, stop_gradient=True) for c in cts]
-            grads = cls.backward(ctx, *ct_tensors)
+        def _align(grads, wrap, zeros):
+            """Align user-backward grads with *all* tensor inputs, then select
+            the differentiable ones (paddle: backward returns one grad per
+            input)."""
             if not isinstance(grads, (tuple, list)):
                 grads = (grads,)
-            # align returned grads with *all* tensor inputs, then select the
-            # differentiable ones (paddle: backward returns one grad per input)
             grad_map = {}
             gi = 0
             for t in tensor_inputs:
                 if gi < len(grads):
                     grad_map[id(t)] = grads[gi]
                     gi += 1
-            result = []
-            for t in diff_inputs:
-                g = grad_map.get(id(t))
-                if g is None:
-                    result.append(jnp.zeros(tuple(t.shape), t.dtype))
-                else:
-                    result.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
-            return tuple(result)
+            return tuple(
+                zeros(t) if grad_map.get(id(t)) is None
+                else wrap(grad_map[id(t)])
+                for t in diff_inputs)
+
+        def vjp_fn(cotangents):
+            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            ct_tensors = [Tensor(c, stop_gradient=True) for c in cts]
+            grads = cls.backward(ctx, *ct_tensors)
+            return _align(
+                grads,
+                wrap=lambda g: g._data if isinstance(g, Tensor) else jnp.asarray(g),
+                zeros=lambda t: jnp.zeros(tuple(t.shape), t.dtype))
 
         def replay_fn(ct_tensors):
             """Tensor-level backward for create_graph: runs the user's
             backward on live Tensors so its ops record their own tape."""
             grads = cls.backward(ctx, *ct_tensors)
-            if not isinstance(grads, (tuple, list)):
-                grads = (grads,)
-            grad_map = {}
-            gi = 0
-            for t in tensor_inputs:
-                if gi < len(grads):
-                    grad_map[id(t)] = grads[gi]
-                    gi += 1
-            result = []
-            for t in diff_inputs:
-                g = grad_map.get(id(t))
-                if g is None:
-                    result.append(Tensor(jnp.zeros(tuple(t.shape), t.dtype)))
-                else:
-                    result.append(g if isinstance(g, Tensor) else Tensor(g))
-            return tuple(result)
+            return _align(
+                grads,
+                wrap=lambda g: g if isinstance(g, Tensor) else Tensor(g),
+                zeros=lambda t: Tensor(jnp.zeros(tuple(t.shape), t.dtype)))
 
         node = GradNode(cls.__name__, vjp_fn, diff_inputs, len(outs), out_avals,
                         replay_fn=replay_fn)

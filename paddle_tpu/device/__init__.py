@@ -50,7 +50,11 @@ def _resolve_device(spec: str):
         raise ValueError(
             f"no '{name}' device available; platforms present: "
             f"{sorted({d.platform for d in jax.devices()})}")
-    return devs[min(idx, len(devs) - 1)]
+    if idx >= len(devs):
+        raise ValueError(
+            f"device index {idx} out of range: only {len(devs)} '{name}' "
+            "device(s) present")
+    return devs[idx]
 
 
 def set_device(device: str):

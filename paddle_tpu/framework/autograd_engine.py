@@ -90,6 +90,9 @@ def _run(tensors, grad_tensors, accumulate_into_grad, targets=None,
         else:
             cotangents[key] = g
 
+    hook_owners = {}   # _key -> Tensor with registered hooks
+    finalized = set()  # keys whose hooks already fired
+
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient and t._node is None:
             raise RuntimeError(
@@ -100,10 +103,16 @@ def _run(tensors, grad_tensors, accumulate_into_grad, targets=None,
                 raise RuntimeError(
                     "grad_tensor must be given for non-scalar outputs "
                     f"(shape {t.shape})")
-            g_arr = jnp.ones_like(t._data)
+            g_val = jnp.ones_like(t._data)
+        elif create_graph and isinstance(g, Tensor):
+            # keep the Tensor so double-backward sees the dependence on the
+            # seed (e.g. HVP w.r.t. the vector in grad_outputs)
+            g_val = g
         else:
-            g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
-        _acc(_key(t), g_arr)
+            g_val = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        _acc(_key(t), g_val)
+        if t._backward_hooks:
+            hook_owners[_key(t)] = t
         if t._node is None:
             leaf_holders[id(t)] = t
 
@@ -118,6 +127,15 @@ def _run(tensors, grad_tensors, accumulate_into_grad, targets=None,
                     tg = r if isinstance(r, Tensor) else Tensor(r)
             return tg if create_graph else tg._data
         return g
+
+    def _finalize(key, val):
+        """Apply tensor hooks once, on the fully-accumulated gradient
+        (reference: hooks run on the final grad, not per-edge partials)."""
+        owner = hook_owners.get(key)
+        if owner is not None and key not in finalized:
+            finalized.add(key)
+            val = fire_hooks(owner, val)
+        return val
 
     grad_ctx = _null_ctx if create_graph else no_grad
 
@@ -134,14 +152,32 @@ def _run(tensors, grad_tensors, accumulate_into_grad, targets=None,
         for tid in target_slots.get(key, ()):
             results[tid] = val
 
+    # prune to the useful subgraph when specific targets are requested
+    # (reference: GeneralGrad restricts traversal to output->input paths,
+    # `fluid/eager/backward.cc:103`). A node is useful iff its backward
+    # contributes — directly or through another useful node — to a target.
+    useful = None
+    if targets is not None:
+        target_ids = {id(t) for t in targets}
+        useful = set()
+        for node in reversed(order):  # leaf-most first
+            for t in node.inputs:
+                if id(t) in target_ids or (
+                        t._node is not None and id(t._node) in useful):
+                    useful.add(id(node))
+                    break
+
     with grad_ctx():
         for node in order:
+            if useful is not None and id(node) not in useful:
+                continue
             # O(1) gather of this node's output cotangents
             outs = []
             any_ct = False
             for i in range(node.n_outputs):
                 found = cotangents.pop((id(node), i), None)
                 if found is not None:
+                    found = _finalize((id(node), i), found)
                     _snapshot((id(node), i), found)
                 if found is None:
                     shape, dt = node.out_avals[i]
@@ -158,8 +194,9 @@ def _run(tensors, grad_tensors, accumulate_into_grad, targets=None,
             else:
                 ct_in = node.vjp_fn(tuple(outs) if node.n_outputs > 1 else outs[0])
             for t, g in zip(node.inputs, ct_in):
-                g = fire_hooks(t, g)
                 key = _key(t)
+                if t._backward_hooks:
+                    hook_owners[key] = t
                 if t._node is None:
                     leaf_holders[id(t)] = t
                 _acc(key, g)
@@ -170,9 +207,11 @@ def _run(tensors, grad_tensors, accumulate_into_grad, targets=None,
 
     if targets is not None:
         for t in targets:
+            if id(t) in results:
+                continue
             val = cotangents.get(_key(t))
             if val is not None:
-                results[id(t)] = val
+                results[id(t)] = _finalize(_key(t), val)
         return results
 
     # write leaf grads
@@ -181,7 +220,7 @@ def _run(tensors, grad_tensors, accumulate_into_grad, targets=None,
         if arr is None:
             continue
         if t._node is None and not t.stop_gradient and accumulate_into_grad:
-            arr = _raw(arr)
+            arr = _raw(_finalize(tid, arr))
             if t.grad is None:
                 t.grad = Tensor(arr, stop_gradient=True)
             else:

@@ -1,0 +1,259 @@
+"""Common NN functionals: linear, dropout, embedding, normalize, ...
+
+Reference: `python/paddle/nn/functional/common.py`, `input.py`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.registry import defop
+from ...framework.tensor import Tensor, run_op
+from ...framework import random as frandom
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "normalize", "cosine_similarity", "bilinear",
+    "label_smooth", "interpolate", "upsample", "pixel_shuffle",
+    "pixel_unshuffle", "channel_shuffle", "unfold", "fold", "one_hot",
+]
+
+
+@defop()
+def linear(x, weight, bias=None):
+    """y = x @ W (+ b). W is [in_features, out_features] — the reference's
+    Linear convention (`python/paddle/nn/layer/common.py` Linear)."""
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """Reference: nn/functional/common.py dropout. RNG comes from the
+    framework generator (named-state aware for model parallelism)."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x.scale(1 - p) if hasattr(x, "scale") else x * (1 - p)
+        return x
+    if p == 1.0:
+        return x * 0 if isinstance(x, Tensor) else Tensor(jnp.zeros_like(x))
+    key = frandom.next_key()
+
+    def fn(x_, key_):
+        shape = list(x_.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key_, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, x_ / (1.0 - p), 0).astype(x_.dtype)
+        return jnp.where(keep, x_, 0).astype(x_.dtype)
+
+    return run_op("dropout", fn, (x, key))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (reference common.py alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    key = frandom.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(x_, key_):
+        keep = jax.random.bernoulli(key_, 1.0 - p, x_.shape)
+        a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, x_, alpha_p) + b).astype(x_.dtype)
+
+    return run_op("alpha_dropout", fn, (x, key))
+
+
+@defop()
+def embedding(x, weight, padding_idx=None, sparse=False):
+    """Lookup rows of ``weight`` by integer ids ``x``.
+
+    Reference: nn/functional/input.py embedding — with ``padding_idx`` the
+    output row is zero and no gradient flows to that row.
+    """
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = jnp.where(mask, out, 0).astype(out.dtype)
+    return out
+
+
+@defop()
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=int(axis), keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+@defop()
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=int(axis))
+    n1 = jnp.linalg.norm(x1, axis=int(axis))
+    n2 = jnp.linalg.norm(x2, axis=int(axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@defop()
+def bilinear(x1, x2, weight, bias=None):
+    """out[n,o] = x1[n,i] W[o,i,j] x2[n,j] (+ b). Reference common.py
+    bilinear."""
+    y = jnp.einsum("ni,oij,nj->no", x1, weight, x2)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@defop()
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    c = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / c
+
+
+def one_hot(x, num_classes, name=None):
+    from ...tensor import creation  # reuse registered op if present
+    def fn(x_):
+        return jax.nn.one_hot(x_, num_classes, dtype=jnp.float32)
+    return run_op("one_hot", fn, (x,), differentiable=False)
+
+
+@defop()
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = int(upscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+@defop()
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = int(downscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+@defop()
+def channel_shuffle(x, groups, data_format="NCHW"):
+    g = int(groups)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, g, c // g, h, w)
+        x = jnp.transpose(x, (0, 2, 1, 3, 4))
+        return x.reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, g, c // g)
+    x = jnp.transpose(x, (0, 1, 2, 4, 3))
+    return x.reshape(n, h, w, c)
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(e) for e in v)
+    return (int(v),) * n
+
+
+@defop()
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference common.py unfold): NCHW -> [N, C*kh*kw, L]."""
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    if isinstance(paddings, (list, tuple)) and len(paddings) == 4:
+        p = tuple(int(e) for e in paddings)  # (top, bottom, left, right)
+    else:
+        ph, pw = _pair(paddings, 2)
+        p = (ph, ph, pw, pw)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])))
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, out_h, out_w]
+    return patches.reshape(n, c * kh * kw, -1)
+
+
+@defop()
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im, the adjoint of unfold (reference common.py fold)."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = _pair(paddings, 2)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    out_h = (oh + 2 * p[0] - dh * (kh - 1) - 1) // sh + 1
+    out_w = (ow + 2 * p[1] - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(n, c, kh, kw, out_h, out_w)
+    padded = jnp.zeros((n, c, oh + 2 * p[0], ow + 2 * p[1]), dtype=x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            padded = padded.at[:, :, hi:hi + sh * out_h:sh,
+                               wj:wj + sw * out_w:sw].add(cols[:, :, i, j])
+    return padded[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+
+
+@defop()
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    """Resize via jax.image (reference common.py interpolate subset:
+    nearest / bilinear / bicubic / area on 4-D, trilinear on 5-D)."""
+    if data_format.startswith("NC"):
+        spatial = x.shape[2:]
+    else:
+        spatial = x.shape[1:-1]
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("one of size/scale_factor is required")
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, sf)]
+    size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic",
+              "area": "linear"}[mode]
+    if data_format.startswith("NC"):
+        full = list(x.shape[:2]) + size
+    else:
+        full = [x.shape[0]] + size + [x.shape[-1]]
+    return jax.image.resize(x, tuple(full), method=method)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW", name=None):
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       align_corners=align_corners, data_format=data_format)

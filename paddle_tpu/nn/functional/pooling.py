@@ -1,0 +1,208 @@
+"""Pooling functionals via ``lax.reduce_window``.
+
+Reference: `python/paddle/nn/functional/pooling.py`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.registry import defop
+
+__all__ = ["max_pool1d", "max_pool2d", "max_pool3d",
+           "avg_pool1d", "avg_pool2d", "avg_pool3d",
+           "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(e) for e in v)
+    return (int(v),) * n
+
+
+def _pool_pad(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if all(isinstance(p, int) for p in padding):
+        if len(padding) == nd:
+            return [(p, p) for p in padding]
+        if len(padding) == 2 * nd:
+            return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    return [tuple(int(e) for e in p) for p in padding]
+
+
+def _reduce_pool(x, kernel, stride, padding, nd, channel_last, init, op,
+                 ceil_mode=False):
+    k = _tuple(kernel, nd)
+    s = _tuple(stride if stride is not None else kernel, nd)
+    p = _pool_pad(padding, nd)
+    if channel_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = ([(0, 0)] + p + [(0, 0)]) if isinstance(p, list) else p
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = ([(0, 0), (0, 0)] + p) if isinstance(p, list) else p
+    init = jnp.asarray(init, x.dtype)
+    if isinstance(pads, list) and ceil_mode:
+        # grow right-pad so the last partial window is included
+        spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+        base = 1 if channel_last else 2
+        pads = list(pads)
+        for i in range(nd):
+            size = spatial[i] + pads[base + i][0] + pads[base + i][1]
+            rem = (size - k[i]) % s[i]
+            if rem != 0:
+                lo, hi = pads[base + i]
+                pads[base + i] = (lo, hi + (s[i] - rem))
+    return jax.lax.reduce_window(x, init, op, window, strides, pads), \
+        (window, strides, pads)
+
+
+def _max_pool(x, kernel, stride, padding, nd, data_format, ceil_mode):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    out, _ = _reduce_pool(x, kernel, stride, padding, nd, channel_last,
+                          neg, jax.lax.max, ceil_mode)
+    return out
+
+
+def _avg_pool(x, kernel, stride, padding, nd, data_format, exclusive,
+              ceil_mode):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    summed, (window, strides, pads) = _reduce_pool(
+        x, kernel, stride, padding, nd, channel_last, 0.0, jax.lax.add,
+        ceil_mode)
+    if exclusive and not isinstance(pads, str):
+        ones = jnp.ones(x.shape, dtype=x.dtype)
+        counts = jax.lax.reduce_window(ones, jnp.asarray(0, x.dtype),
+                                       jax.lax.add, window, strides, pads)
+        return summed / counts
+    return summed / float(np.prod(_tuple(kernel, nd)))
+
+
+@defop()
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCL"):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _max_pool(x, kernel_size, stride, padding, 1, fmt, ceil_mode)
+
+
+@defop()
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    return _max_pool(x, kernel_size, stride, padding, 2, data_format,
+                     ceil_mode)
+
+
+@defop()
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    return _max_pool(x, kernel_size, stride, padding, 3, data_format,
+                     ceil_mode)
+
+
+@defop()
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL"):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _avg_pool(x, kernel_size, stride, padding, 1, fmt, exclusive,
+                     ceil_mode)
+
+
+@defop()
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCHW"):
+    return _avg_pool(x, kernel_size, stride, padding, 2, data_format,
+                     exclusive, ceil_mode)
+
+
+@defop()
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCDHW"):
+    return _avg_pool(x, kernel_size, stride, padding, 3, data_format,
+                     exclusive, ceil_mode)
+
+
+def _adaptive_windows(in_size, out_size):
+    """start/end indices per output cell, paddle/torch adaptive convention."""
+    starts = [int(np.floor(i * in_size / out_size)) for i in range(out_size)]
+    ends = [int(np.ceil((i + 1) * in_size / out_size)) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, nd, data_format, reduce_fn):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    out_sizes = _tuple(output_size, nd)
+    spatial_base = 1 if channel_last else 2
+    # uniform case lowers to one strided reduce_window (fast path)
+    in_sizes = x.shape[spatial_base:spatial_base + nd]
+    if all(i % o == 0 for i, o in zip(in_sizes, out_sizes)):
+        k = tuple(i // o for i, o in zip(in_sizes, out_sizes))
+        if channel_last:
+            window = (1,) + k + (1,)
+        else:
+            window = (1, 1) + k
+        init = jnp.asarray(0 if reduce_fn is jax.lax.add else -jnp.inf,
+                           x.dtype)
+        out = jax.lax.reduce_window(x, init, reduce_fn, window, window,
+                                    "VALID")
+        if reduce_fn is jax.lax.add:
+            out = out / float(np.prod(k))
+        return out
+    # general case: gather per-cell slices (static loop, still one XLA graph)
+    for d in range(nd):
+        axis = spatial_base + d
+        starts, ends = _adaptive_windows(x.shape[axis], out_sizes[d])
+        pieces = []
+        for s, e in zip(starts, ends):
+            sl = jax.lax.slice_in_dim(x, s, e, axis=axis)
+            if reduce_fn is jax.lax.add:
+                pieces.append(jnp.mean(sl, axis=axis, keepdims=True))
+            else:
+                pieces.append(jnp.max(sl, axis=axis, keepdims=True))
+        x = jnp.concatenate(pieces, axis=axis)
+    return x
+
+
+@defop()
+def adaptive_avg_pool1d(x, output_size, data_format="NCL"):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _adaptive_pool(x, output_size, 1, fmt, jax.lax.add)
+
+
+@defop()
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, data_format, jax.lax.add)
+
+
+@defop()
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, data_format, jax.lax.add)
+
+
+@defop()
+def adaptive_max_pool1d(x, output_size, return_mask=False, data_format="NCL"):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _adaptive_pool(x, output_size, 1, fmt, jax.lax.max)
+
+
+@defop()
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, data_format, jax.lax.max)
+
+
+@defop()
+def adaptive_max_pool3d(x, output_size, return_mask=False,
+                        data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, data_format, jax.lax.max)

@@ -1,0 +1,45 @@
+"""nn.utils — parameter vectorization + clip utilities.
+
+Reference: `python/paddle/nn/utils/`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+__all__ = ["parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_", "weight_norm",
+           "remove_weight_norm", "spectral_norm"]
+
+
+def parameters_to_vector(parameters):
+    return Tensor(jnp.concatenate(
+        [p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters):
+    offset = 0
+    for p in parameters:
+        n = 1
+        for s in p._data.shape:
+            n *= s
+        p._data = vec._data[offset:offset + n].reshape(p._data.shape) \
+            .astype(p._data.dtype)
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    raise NotImplementedError("weight_norm: planned; use SpectralNorm or "
+                              "explicit normalization for now")
+
+
+def remove_weight_norm(layer, name="weight"):
+    raise NotImplementedError
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    raise NotImplementedError("use nn.SpectralNorm layer")

@@ -448,7 +448,12 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_ax
         int(p._data) if isinstance(p, Tensor) else int(p) for p in pad)
     nd = x.ndim
     if len(pad) == 2 * nd:
-        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        if pad_from_left_axis:
+            widths = pairs
+        else:
+            # torch-style: first pair pads the last axis, walking backwards
+            widths = [pairs[nd - 1 - i] for i in range(nd)]
     else:
         # paddle semantics (reference python/paddle/nn/functional/common.py
         # `pad`): the flat pad list pairs up as (left,right),(top,bottom),...
