@@ -32,6 +32,7 @@ grad = _engine.grad
 
 from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import amp  # noqa: F401,E402

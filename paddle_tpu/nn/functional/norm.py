@@ -172,4 +172,5 @@ def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0,
     window[channel_axis] = size
     acc = jax.lax.reduce_window(sq, jnp.asarray(0, x.dtype), jax.lax.add,
                                 tuple(window), (1,) * x.ndim, "VALID")
-    return x / jnp.power(k + alpha * acc, beta)
+    # reference normalizes by the window *mean* (avg_pool), not the sum
+    return x / jnp.power(k + alpha * acc / size, beta)

@@ -1,0 +1,5 @@
+"""Vision datasets (reference: `python/paddle/vision/datasets/__init__.py`)."""
+
+from .mnist import MNIST, FashionMNIST  # noqa: F401
+
+__all__ = ["MNIST", "FashionMNIST"]

@@ -1,0 +1,10 @@
+"""Vision model zoo (reference: `python/paddle/vision/models/__init__.py`)."""
+
+from .lenet import LeNet  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    wide_resnet50_2, wide_resnet101_2,
+)
+
+__all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
+           "resnet101", "resnet152", "wide_resnet50_2", "wide_resnet101_2"]
