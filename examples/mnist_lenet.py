@@ -1,0 +1,99 @@
+"""MNIST LeNet end-to-end training (SURVEY.md §7 milestone 2).
+
+Exercises the full stack: vision dataset -> DataLoader -> nn.Layer model ->
+CrossEntropyLoss -> AdamW -> jit.to_static compiled train step -> eval.
+
+Run:  python examples/mnist_lenet.py [--epochs 5] [--eager]
+CPU:  env -u PYTHONPATH JAX_PLATFORMS=cpu python examples/mnist_lenet.py
+"""
+
+import argparse
+import sys
+import time
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.optimizer as optim  # noqa: E402
+import paddle_tpu.jit as jit  # noqa: E402
+from paddle_tpu.io import DataLoader  # noqa: E402
+from paddle_tpu.vision.datasets import MNIST  # noqa: E402
+from paddle_tpu.vision import transforms as T  # noqa: E402
+from paddle_tpu.vision.models import LeNet  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--eager", action="store_true",
+                    help="skip jit compilation (debug mode)")
+    ap.add_argument("--n-per-class", type=int, default=600)
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    tf = T.Compose([T.ToTensor(), T.Normalize(0.5, 0.5)])
+    train_ds = MNIST(mode="train", transform=tf, n_per_class=args.n_per_class)
+    test_ds = MNIST(mode="test", transform=tf,
+                    n_per_class=max(args.n_per_class // 6, 50))
+    train_dl = DataLoader(train_ds, batch_size=args.batch_size, shuffle=True,
+                          drop_last=True, num_workers=2)
+    test_dl = DataLoader(test_ds, batch_size=256)
+    print(f"train={len(train_ds)} test={len(test_ds)} "
+          f"synthetic={train_ds.synthetic}")
+
+    model = LeNet(num_classes=10)
+    sched = optim.lr.CosineAnnealingDecay(args.lr, T_max=args.epochs)
+    opt = optim.AdamW(learning_rate=sched, parameters=model.parameters(),
+                      weight_decay=1e-4)
+    loss_fn = nn.CrossEntropyLoss()
+
+    def train_step(x, y):
+        logits = model(x)
+        loss = loss_fn(logits, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    if not args.eager:
+        train_step = jit.to_static(train_step, state=[model, opt])
+
+    def evaluate():
+        model.eval()
+        correct = total = 0
+        with paddle.no_grad():
+            for img, lab in test_dl:
+                logits = model(paddle.to_tensor(img))
+                pred = logits.numpy().argmax(axis=1)
+                correct += int((pred == lab).sum())
+                total += len(lab)
+        model.train()
+        return correct / total
+
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        losses = []
+        for img, lab in train_dl:
+            loss = train_step(paddle.to_tensor(img), paddle.to_tensor(lab))
+            losses.append(loss)
+        sched.step()
+        acc = evaluate()
+        dt = time.time() - t0
+        ips = len(train_ds) / dt
+        print(f"epoch {epoch}: loss={float(losses[-1]):.4f} "
+              f"test_acc={acc * 100:.2f}% ({dt:.1f}s, {ips:.0f} img/s)")
+
+    final = evaluate()
+    print(f"FINAL test accuracy: {final * 100:.2f}%")
+    assert final > 0.97, f"convergence gate failed: {final}"
+    print("MNIST milestone PASSED (>97%)")
+
+
+if __name__ == "__main__":
+    main()

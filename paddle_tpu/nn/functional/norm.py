@@ -170,7 +170,8 @@ def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0,
     sq = jnp.pad(sq, pads)
     window = [1] * x.ndim
     window[channel_axis] = size
-    acc = jax.lax.reduce_window(sq, jnp.asarray(0, x.dtype), jax.lax.add,
+    # scalar init keeps the (init, op) monoid recognizable to JAX autodiff
+    acc = jax.lax.reduce_window(sq, 0.0, jax.lax.add,
                                 tuple(window), (1,) * x.ndim, "VALID")
     # reference normalizes by the window *mean* (avg_pool), not the sum
     return x / jnp.power(k + alpha * acc / size, beta)

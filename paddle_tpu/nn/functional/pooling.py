@@ -52,7 +52,9 @@ def _reduce_pool(x, kernel, stride, padding, nd, channel_last, init, op,
         window = (1, 1) + k
         strides = (1, 1) + s
         pads = ([(0, 0), (0, 0)] + p) if isinstance(p, list) else p
-    init = jnp.asarray(init, x.dtype)
+    # init must stay a Python scalar: JAX recognizes the (init, op) monoid
+    # (sum/max/min) only for literal identities — wrapping it in an array
+    # defeats the detection and the op loses its autodiff rule under jit.
     if isinstance(pads, list) and ceil_mode:
         # grow right-pad so the last partial window is included
         spatial = x.shape[1:-1] if channel_last else x.shape[2:]
@@ -70,8 +72,10 @@ def _reduce_pool(x, kernel, stride, padding, nd, channel_last, init, op,
 
 def _max_pool(x, kernel, stride, padding, nd, data_format, ceil_mode):
     channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
-    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
-        else jnp.iinfo(x.dtype).min
+    # dtype-matched numpy scalar: keeps the (init, op) monoid recognizable
+    # to autodiff while satisfying reduce_window's dtype check for ints
+    neg = float("-inf") if jnp.issubdtype(x.dtype, jnp.floating) \
+        else np.dtype(x.dtype).type(jnp.iinfo(x.dtype).min)
     out, _ = _reduce_pool(x, kernel, stride, padding, nd, channel_last,
                           neg, jax.lax.max, ceil_mode)
     return out
@@ -85,8 +89,8 @@ def _avg_pool(x, kernel, stride, padding, nd, data_format, exclusive,
         ceil_mode)
     if exclusive and not isinstance(pads, str):
         ones = jnp.ones(x.shape, dtype=x.dtype)
-        counts = jax.lax.reduce_window(ones, jnp.asarray(0, x.dtype),
-                                       jax.lax.add, window, strides, pads)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                       window, strides, pads)
         return summed / counts
     return summed / float(np.prod(_tuple(kernel, nd)))
 
@@ -153,8 +157,12 @@ def _adaptive_pool(x, output_size, nd, data_format, reduce_fn):
             window = (1,) + k + (1,)
         else:
             window = (1, 1) + k
-        init = jnp.asarray(0 if reduce_fn is jax.lax.add else -jnp.inf,
-                           x.dtype)
+        if reduce_fn is jax.lax.add:
+            init = 0.0
+        elif jnp.issubdtype(x.dtype, jnp.floating):
+            init = float("-inf")
+        else:
+            init = np.dtype(x.dtype).type(jnp.iinfo(x.dtype).min)
         out = jax.lax.reduce_window(x, init, reduce_fn, window, window,
                                     "VALID")
         if reduce_fn is jax.lax.add:
