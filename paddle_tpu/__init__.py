@@ -26,6 +26,7 @@ from .framework.dtype import bool_  # noqa: F401
 
 from .tensor import *  # noqa: F401,F403
 from . import tensor  # noqa: F401
+from .tensor import linalg  # noqa: F401  (paddle.linalg namespace)
 
 from .framework import autograd_engine as _engine
 grad = _engine.grad

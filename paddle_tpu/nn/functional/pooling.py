@@ -39,6 +39,17 @@ def _pool_pad(padding, nd):
     return [tuple(int(e) for e in p) for p in padding]
 
 
+def _reduce_init(reduce_fn, dtype):
+    """Identity element for a reduce_window monoid, as a Python/numpy
+    scalar — array-wrapped inits defeat JAX's monoid recognition and lose
+    the op's autodiff rule under jit."""
+    if reduce_fn is jax.lax.add:
+        return 0.0
+    if jnp.issubdtype(dtype, jnp.floating):
+        return float("-inf")
+    return np.dtype(dtype).type(jnp.iinfo(dtype).min)
+
+
 def _reduce_pool(x, kernel, stride, padding, nd, channel_last, init, op,
                  ceil_mode=False):
     k = _tuple(kernel, nd)
@@ -72,10 +83,7 @@ def _reduce_pool(x, kernel, stride, padding, nd, channel_last, init, op,
 
 def _max_pool(x, kernel, stride, padding, nd, data_format, ceil_mode):
     channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
-    # dtype-matched numpy scalar: keeps the (init, op) monoid recognizable
-    # to autodiff while satisfying reduce_window's dtype check for ints
-    neg = float("-inf") if jnp.issubdtype(x.dtype, jnp.floating) \
-        else np.dtype(x.dtype).type(jnp.iinfo(x.dtype).min)
+    neg = _reduce_init(jax.lax.max, x.dtype)
     out, _ = _reduce_pool(x, kernel, stride, padding, nd, channel_last,
                           neg, jax.lax.max, ceil_mode)
     return out
@@ -157,12 +165,7 @@ def _adaptive_pool(x, output_size, nd, data_format, reduce_fn):
             window = (1,) + k + (1,)
         else:
             window = (1, 1) + k
-        if reduce_fn is jax.lax.add:
-            init = 0.0
-        elif jnp.issubdtype(x.dtype, jnp.floating):
-            init = float("-inf")
-        else:
-            init = np.dtype(x.dtype).type(jnp.iinfo(x.dtype).min)
+        init = _reduce_init(reduce_fn, x.dtype)
         out = jax.lax.reduce_window(x, init, reduce_fn, window, window,
                                     "VALID")
         if reduce_fn is jax.lax.add:

@@ -1,0 +1,27 @@
+"""Test config: force the CPU backend with 8 virtual devices.
+
+The container registers the axon TPU plugin via sitecustomize (jax is
+already imported when conftest runs), so the only reliable override is
+``jax.config.update`` — env edits are too late. 8 virtual CPU devices give
+the multi-chip mesh surface the sharding tests need (SURVEY §4: the
+reference tests SPMD rules metadata-only on CPU).
+"""
+
+import os
+
+import jax
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield

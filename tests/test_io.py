@@ -1,0 +1,126 @@
+"""io pipeline tests: datasets, samplers, DataLoader.
+
+Reference discipline: `test/legacy_test/test_dataloader_*`.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    Dataset, IterableDataset, TensorDataset, ConcatDataset, Subset,
+    random_split, BatchSampler, RandomSampler, SequenceSampler,
+    DistributedBatchSampler, DataLoader, default_collate_fn,
+)
+
+
+class RangeDS(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.int64(i % 3)
+
+
+def test_tensor_dataset():
+    a = paddle.to_tensor(np.arange(12, dtype="float32").reshape(6, 2))
+    b = paddle.to_tensor(np.arange(6, dtype="int64"))
+    ds = TensorDataset([a, b])
+    assert len(ds) == 6
+    x, y = ds[2]
+    np.testing.assert_array_equal(x.numpy(), [4, 5])
+    assert int(y) == 2
+
+
+def test_concat_subset_split():
+    ds = ConcatDataset([RangeDS(3), RangeDS(4)])
+    assert len(ds) == 7
+    assert float(ds[3][0]) == 0.0  # second dataset's first item
+    sub = Subset(RangeDS(10), [2, 4, 6])
+    assert len(sub) == 3 and float(sub[1][0]) == 4.0
+    parts = random_split(RangeDS(10), [7, 3])
+    assert len(parts[0]) == 7 and len(parts[1]) == 3
+    all_idx = sorted(float(parts[0][i][0]) for i in range(7)) + \
+        sorted(float(parts[1][i][0]) for i in range(3))
+    assert sorted(all_idx) == list(map(float, range(10)))
+
+
+def test_batch_sampler():
+    bs = BatchSampler(RangeDS(10), batch_size=3, drop_last=False)
+    batches = list(bs)
+    assert len(batches) == 4 and len(batches[-1]) == 1
+    bs2 = BatchSampler(RangeDS(10), batch_size=3, drop_last=True)
+    assert len(list(bs2)) == 3 == len(bs2)
+
+
+def test_random_sampler_covers_all():
+    s = RandomSampler(RangeDS(20))
+    assert sorted(list(s)) == list(range(20))
+
+
+def test_dataloader_batching():
+    dl = DataLoader(RangeDS(10), batch_size=4, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == (4,) and y.shape == (4,)
+    np.testing.assert_array_equal(x, [0, 1, 2, 3])
+
+
+def test_dataloader_shuffle_deterministic_coverage():
+    dl = DataLoader(RangeDS(16), batch_size=4, shuffle=True)
+    seen = np.concatenate([b[0] for b in dl])
+    assert sorted(seen.tolist()) == list(map(float, range(16)))
+
+
+def test_dataloader_workers_preserve_order():
+    dl = DataLoader(RangeDS(32), batch_size=4, num_workers=3)
+    batches = [b[0] for b in dl]
+    flat = np.concatenate(batches)
+    np.testing.assert_array_equal(flat, np.arange(32, dtype="float32"))
+
+
+def test_iterable_dataset():
+    class Stream(IterableDataset):
+        def __iter__(self):
+            for i in range(7):
+                yield np.float32(i)
+
+    dl = DataLoader(Stream(), batch_size=3)
+    shapes = [b.shape[0] for b in dl]
+    assert shapes == [3, 3, 1]
+
+
+def test_collate_nested():
+    batch = [{"a": np.float32(1), "b": (np.float32(2), np.float32(3))},
+             {"a": np.float32(4), "b": (np.float32(5), np.float32(6))}]
+    out = default_collate_fn(batch)
+    np.testing.assert_array_equal(out["a"], [1, 4])
+    np.testing.assert_array_equal(out["b"][0], [2, 5])
+
+
+def test_distributed_batch_sampler_partitions():
+    ds = RangeDS(10)
+    seen = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                    rank=rank)
+        for batch in s:
+            seen.extend(batch)
+        assert len(s) == 2  # ceil(10/4)=3 -> padded to 3 per rank? 2 batches
+    # every sample covered (padding duplicates allowed)
+    assert set(range(10)).issubset(set(seen))
+
+
+def test_distributed_batch_sampler_shuffle_epoch():
+    ds = RangeDS(16)
+    s = DistributedBatchSampler(ds, batch_size=4, num_replicas=2, rank=0,
+                                shuffle=True)
+    s.set_epoch(0)
+    a = [i for b in s for i in b]
+    s.set_epoch(1)
+    b = [i for b_ in s for i in b_]
+    assert a != b  # different epoch -> different permutation

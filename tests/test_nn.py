@@ -1,0 +1,218 @@
+"""nn.Layer + layer zoo tests.
+
+Reference discipline: `test/legacy_test/test_layers.py` style — layer
+registration, state_dict, and numerics vs NumPy references.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, rg=False):
+    return paddle.to_tensor(np.asarray(a, dtype="float32"),
+                            stop_gradient=not rg)
+
+
+class TinyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+        self.register_buffer("steps", paddle.to_tensor(np.zeros(1, "float32")))
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_parameter_registration():
+    net = TinyNet()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    assert len(list(net.buffers())) == 1
+    assert len(list(net.sublayers())) == 2
+
+
+def test_state_dict_roundtrip():
+    net, net2 = TinyNet(), TinyNet()
+    sd = net.state_dict()
+    assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias",
+                       "steps"}
+    net2.set_state_dict(sd)
+    for (_, a), (_, b) in zip(net.named_parameters(), net2.named_parameters()):
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+def test_train_eval_mode():
+    net = TinyNet()
+    net.eval()
+    assert not net.training and not net.fc1.training
+    net.train()
+    assert net.training and net.fc2.training
+
+
+def test_forward_hooks():
+    net = TinyNet()
+    calls = []
+    h1 = net.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+    h2 = net.register_forward_post_hook(
+        lambda l, inp, out: calls.append("post"))
+    net(t(np.zeros((1, 4))))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    calls.clear()
+    net(t(np.zeros((1, 4))))
+    assert calls == []
+
+
+def test_linear_numerics():
+    lin = nn.Linear(3, 2)
+    x = np.random.randn(5, 3).astype("float32")
+    ref = x @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(lin(t(x)).numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor(np.array([1, 3, 1], dtype="int32"))
+    out = emb(idx)
+    np.testing.assert_allclose(out.numpy()[0], emb.weight.numpy()[1])
+    np.testing.assert_allclose(out.numpy()[0], out.numpy()[2])
+
+
+def test_layernorm_numerics():
+    ln = nn.LayerNorm(8)
+    x = np.random.randn(2, 8).astype("float32")
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(ln(t(x)).numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_rmsnorm_numerics():
+    rn = nn.RMSNorm(8)
+    x = np.random.randn(2, 8).astype("float32")
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(rn(t(x)).numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_train_and_eval():
+    bn = nn.BatchNorm2D(3)
+    x = np.random.randn(4, 3, 5, 5).astype("float32") * 2 + 1
+    y = bn(t(x)).numpy()
+    np.testing.assert_allclose(y.mean(axis=(0, 2, 3)), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(axis=(0, 2, 3)), 1, atol=1e-2)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    y2 = bn(t(x)).numpy()
+    assert not np.allclose(y, y2)  # eval uses running stats
+
+
+def test_conv2d_matches_naive():
+    conv = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+    w = conv.weight.numpy()[0, 0]
+    x = np.random.randn(1, 1, 5, 5).astype("float32")
+    out = conv(t(x)).numpy()[0, 0]
+    ref = np.zeros((3, 3), "float32")
+    for i in range(3):
+        for j in range(3):
+            ref[i, j] = (x[0, 0, i:i + 3, j:j + 3] * w).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_dropout_train_vs_eval():
+    d = nn.Dropout(0.5)
+    x = t(np.ones((100, 100)))
+    y = d(x)
+    frac_zero = float((y.numpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+def test_activations():
+    x = np.linspace(-3, 3, 13).astype("float32")
+    np.testing.assert_allclose(nn.ReLU()(t(x)).numpy(), np.maximum(x, 0))
+    np.testing.assert_allclose(nn.Sigmoid()(t(x)).numpy(),
+                               1 / (1 + np.exp(-x)), rtol=1e-5)
+    np.testing.assert_allclose(
+        nn.SiLU()(t(x)).numpy(), x / (1 + np.exp(-x)), rtol=1e-5)
+    np.testing.assert_allclose(
+        F.softmax(t(x.reshape(1, -1))).numpy().sum(), 1.0, rtol=1e-5)
+    gelu_ref = 0.5 * x * (1 + np.vectorize(__import__("math").erf)(
+        x / np.sqrt(2)))
+    np.testing.assert_allclose(nn.GELU()(t(x)).numpy(), gelu_ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_cross_entropy_matches_numpy():
+    logits = np.random.randn(6, 5).astype("float32")
+    labels = np.array([0, 1, 2, 3, 4, 1], dtype="int64")
+    lf = nn.CrossEntropyLoss()
+    got = float(lf(t(logits), paddle.to_tensor(labels)))
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(6), labels]).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_mse_l1_losses():
+    a, b = np.random.randn(4, 3).astype("float32"), \
+        np.random.randn(4, 3).astype("float32")
+    np.testing.assert_allclose(
+        float(nn.MSELoss()(t(a), t(b))), ((a - b) ** 2).mean(), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(nn.L1Loss()(t(a), t(b))), np.abs(a - b).mean(), rtol=1e-5)
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(list(seq.parameters())) == 4
+    out = seq(t(np.zeros((1, 4))))
+    assert out.shape == [1, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.parameters())) == 6
+
+
+def test_clip_grad_by_global_norm():
+    ps = [paddle.framework.tensor.Parameter(np.ones((2, 2), "float32"))
+          for _ in range(2)]
+    grads = [paddle.to_tensor(np.full((2, 2), 3.0, "float32")) for _ in ps]
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    out = clip(list(zip(ps, grads)))
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+    w = nn.Linear(100, 100,
+                  weight_attr=nn.ParamAttr(initializer=I.Constant(0.5)))
+    np.testing.assert_array_equal(w.weight.numpy(),
+                                  np.full((100, 100), 0.5, "float32"))
+    x = nn.Linear(200, 300,
+                  weight_attr=nn.ParamAttr(initializer=I.XavierNormal()))
+    std = x.weight.numpy().std()
+    expected = np.sqrt(2.0 / (200 + 300))
+    assert abs(std - expected) / expected < 0.15
+
+
+def test_multihead_attention_shape():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = t(np.random.randn(2, 5, 16))
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4,
+                                       dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, num_layers=2)
+    x = t(np.random.randn(2, 5, 16))
+    out = enc(x)
+    assert out.shape == [2, 5, 16]

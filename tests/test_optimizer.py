@@ -1,0 +1,211 @@
+"""Optimizer + LR schedule tests: update rules vs NumPy references.
+
+Reference discipline: `test/legacy_test/test_sgd_op.py`,
+`test_adamw_op.py`-style single-step numerics.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def one_param_model(value):
+    lin = nn.Linear(1, 1, bias_attr=False)
+    lin.weight.set_value(np.array([[value]], dtype="float32"))
+    return lin
+
+
+def run_step(opt_cls, w0=1.0, grad=0.5, **kw):
+    m = one_param_model(w0)
+    o = opt_cls(parameters=m.parameters(), **kw)
+    m.weight.grad = paddle.to_tensor(np.array([[grad]], dtype="float32"))
+    o.step()
+    return float(m.weight.numpy()[0, 0]), o, m
+
+
+def test_sgd():
+    w, _, _ = run_step(optim.SGD, learning_rate=0.1)
+    np.testing.assert_allclose(w, 1.0 - 0.1 * 0.5, rtol=1e-6)
+
+
+def test_momentum_two_steps():
+    m = one_param_model(1.0)
+    o = optim.Momentum(learning_rate=0.1, momentum=0.9,
+                       parameters=m.parameters())
+    v = 0.0
+    ref = 1.0
+    for _ in range(2):
+        m.weight.grad = paddle.to_tensor(np.array([[0.5]], "float32"))
+        o.step()
+        v = 0.9 * v + 0.5
+        ref -= 0.1 * v
+    np.testing.assert_allclose(float(m.weight.numpy()), ref, rtol=1e-6)
+
+
+def test_adam_single_step():
+    w, _, _ = run_step(optim.Adam, learning_rate=0.1, beta1=0.9, beta2=0.999)
+    g = 0.5
+    m1 = 0.1 * g
+    v1 = 0.001 * g * g
+    lr_t = 0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    ref = 1.0 - lr_t * m1 / (np.sqrt(v1) + 1e-8)
+    np.testing.assert_allclose(w, ref, rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    wd = 0.1
+    w_adamw, _, _ = run_step(optim.AdamW, learning_rate=0.1, weight_decay=wd)
+    w_adam, _, _ = run_step(optim.Adam, learning_rate=0.1)
+    # AdamW shrinks the param by lr*wd*w before the adam update
+    np.testing.assert_allclose(w_adamw, w_adam - 0.1 * wd * 1.0, rtol=1e-5)
+
+
+def test_adamw_apply_decay_param_fun():
+    def no_decay(name):
+        return False
+    w, _, _ = run_step(optim.AdamW, learning_rate=0.1, weight_decay=0.1,
+                       apply_decay_param_fun=no_decay)
+    w_ref, _, _ = run_step(optim.Adam, learning_rate=0.1)
+    np.testing.assert_allclose(w, w_ref, rtol=1e-6)
+
+
+def test_rmsprop():
+    w, _, _ = run_step(optim.RMSProp, learning_rate=0.1, rho=0.95)
+    ms = 0.05 * 0.25
+    ref = 1.0 - 0.1 * 0.5 / np.sqrt(ms + 1e-6)
+    np.testing.assert_allclose(w, ref, rtol=1e-5)
+
+
+def test_adagrad():
+    w, _, _ = run_step(optim.Adagrad, learning_rate=0.1)
+    ref = 1.0 - 0.1 * 0.5 / (np.sqrt(0.25) + 1e-6)
+    np.testing.assert_allclose(w, ref, rtol=1e-5)
+
+
+def test_l2_weight_decay_couples_into_grad():
+    w, _, _ = run_step(optim.SGD, learning_rate=0.1,
+                       weight_decay=paddle.regularizer.L2Decay(0.01))
+    np.testing.assert_allclose(w, 1.0 - 0.1 * (0.5 + 0.01 * 1.0), rtol=1e-6)
+
+
+def test_grad_clip_global_norm_in_optimizer():
+    m = one_param_model(1.0)
+    o = optim.SGD(learning_rate=1.0, parameters=m.parameters(),
+                  grad_clip=nn.ClipGradByGlobalNorm(0.1))
+    m.weight.grad = paddle.to_tensor(np.array([[10.0]], "float32"))
+    o.step()
+    np.testing.assert_allclose(float(m.weight.numpy()), 1.0 - 0.1, rtol=1e-4)
+
+
+def test_optimizer_state_dict_roundtrip():
+    m = one_param_model(1.0)
+    o = optim.Adam(learning_rate=0.1, parameters=m.parameters())
+    m.weight.grad = paddle.to_tensor(np.array([[0.5]], "float32"))
+    o.step()
+    sd = o.state_dict()
+    assert any("moment1" in k for k in sd)
+
+    m2 = one_param_model(float(m.weight.numpy()))
+    o2 = optim.Adam(learning_rate=0.1, parameters=m2.parameters())
+    o2.set_state_dict(sd)
+    # same grad -> identical next step
+    for mm, oo in ((m, o), (m2, o2)):
+        mm.weight.grad = paddle.to_tensor(np.array([[0.25]], "float32"))
+        oo.step()
+    np.testing.assert_array_equal(m.weight.numpy(), m2.weight.numpy())
+
+
+def test_multi_precision_master_weights():
+    lin = nn.Linear(1, 1, bias_attr=False)
+    lin.weight.set_value(np.array([[1.0]], "float32"))
+    lin.bfloat16()
+    o = optim.AdamW(learning_rate=1e-4, parameters=lin.parameters(),
+                    multi_precision=True)
+    for _ in range(3):
+        lin.weight.grad = paddle.to_tensor(
+            np.array([[0.5]], "float32")).astype(paddle.bfloat16)
+        o.step()
+    master = o._accumulators["master_weight"][id(lin.weight)]
+    assert str(master.dtype) == "float32"
+    assert str(lin.weight.dtype) == "bfloat16"
+    np.testing.assert_allclose(
+        float(master.numpy()),
+        float(lin.weight.astype("float32").numpy()), rtol=1e-2)
+
+
+def test_lr_scheduler_drives_optimizer():
+    sched = lr_mod.StepDecay(0.1, step_size=1, gamma=0.5)
+    m = one_param_model(1.0)
+    o = optim.SGD(learning_rate=sched, parameters=m.parameters())
+    assert o.get_lr() == pytest.approx(0.1)
+    sched.step()
+    assert o.get_lr() == pytest.approx(0.05)
+
+
+SCHEDULE_VALUES = [
+    (lambda: lr_mod.ExponentialDecay(1.0, 0.5), [1.0, 0.5, 0.25]),
+    (lambda: lr_mod.NaturalExpDecay(1.0, 1.0),
+     [1.0, np.exp(-1), np.exp(-2)]),
+    (lambda: lr_mod.InverseTimeDecay(1.0, 1.0), [1.0, 0.5, 1 / 3]),
+    (lambda: lr_mod.PiecewiseDecay([1, 2], [0.3, 0.2, 0.1]),
+     [0.3, 0.2, 0.1]),
+    (lambda: lr_mod.MultiStepDecay(1.0, [1, 2], 0.1), [1.0, 0.1, 0.01]),
+    (lambda: lr_mod.StepDecay(1.0, 2, 0.1), [1.0, 1.0, 0.1]),
+    (lambda: lr_mod.LambdaDecay(2.0, lambda e: 1 / (e + 1)),
+     [2.0, 1.0, 2 / 3]),
+    (lambda: lr_mod.CosineAnnealingDecay(1.0, 4),
+     [1.0, (1 + np.cos(np.pi / 4)) / 2, (1 + np.cos(np.pi / 2)) / 2]),
+    (lambda: lr_mod.PolynomialDecay(1.0, 10, end_lr=0.0, power=1.0),
+     [1.0, 0.9, 0.8]),
+]
+
+
+@pytest.mark.parametrize("make,expected", SCHEDULE_VALUES,
+                         ids=[m()().__class__.__name__ if False else str(i)
+                              for i, (m, _) in enumerate(SCHEDULE_VALUES)])
+def test_schedule_values(make, expected):
+    s = make()
+    got = []
+    for _ in expected:
+        got.append(s())
+        s.step()
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_linear_warmup():
+    s = lr_mod.LinearWarmup(0.5, warmup_steps=5, start_lr=0.0, end_lr=0.5)
+    vals = []
+    for _ in range(7):
+        vals.append(s())
+        s.step()
+    np.testing.assert_allclose(vals[:5], [0.0, 0.1, 0.2, 0.3, 0.4],
+                               atol=1e-6)
+    assert vals[5] == pytest.approx(0.5)
+
+
+def test_reduce_on_plateau():
+    s = lr_mod.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+    for _ in range(5):
+        s.step(1.0)  # no improvement
+    assert s.get_lr() < 1.0
+
+
+def test_training_convergence_adamw():
+    np.random.seed(0)
+    X = np.random.randn(32, 4).astype("float32")
+    Y = X @ np.array([[1.0], [2.0], [-1.0], [0.5]], "float32")
+    m = nn.Linear(4, 1)
+    o = optim.AdamW(learning_rate=0.1, parameters=m.parameters())
+    first = None
+    for _ in range(25):
+        loss = ((m(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+        if first is None:
+            first = float(loss)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    assert float(loss) < 0.2 * first

@@ -1,0 +1,73 @@
+"""Checkpoint tests: save/load, bf16, bitwise train-resume.
+
+Reference discipline: `test/legacy_test/test_paddle_save_load.py` +
+VERDICT round-1 item 10 (train -> save -> restore -> bitwise-identical
+next step).
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+
+
+def test_tensor_roundtrip(tmp_path):
+    p = str(tmp_path / "t.pdtensor")
+    x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+    paddle.save(x, p)
+    y = paddle.load(p)
+    np.testing.assert_array_equal(x.numpy(), y.numpy())
+
+
+def test_bf16_roundtrip(tmp_path):
+    p = str(tmp_path / "t.pdtensor")
+    x = paddle.to_tensor(
+        np.random.randn(5, 5).astype("float32")).astype(paddle.bfloat16)
+    paddle.save({"w": x}, p)
+    y = paddle.load(p)["w"]
+    assert str(y.dtype) == "bfloat16"
+    np.testing.assert_array_equal(x.astype("float32").numpy(),
+                                  y.astype("float32").numpy())
+
+
+def test_layer_state_dict_roundtrip(tmp_path):
+    p = str(tmp_path / "model.pdparams")
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    paddle.save(m.state_dict(), p)
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(paddle.load(p))
+    x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+    np.testing.assert_array_equal(m(x).numpy(), m2(x).numpy())
+
+
+def test_train_save_resume_bitwise(tmp_path):
+    """VERDICT item 10: restore must reproduce the next step exactly."""
+    mp, op_ = str(tmp_path / "m.pdparams"), str(tmp_path / "o.pdopt")
+    X = np.random.RandomState(0).randn(8, 4).astype("float32")
+    Y = X @ np.ones((4, 1), "float32")
+
+    def step(m, o):
+        loss = ((m(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    paddle.seed(0)
+    m = nn.Linear(4, 1)
+    o = optim.AdamW(learning_rate=0.01, parameters=m.parameters())
+    for _ in range(3):
+        step(m, o)
+    paddle.save(m.state_dict(), mp)
+    paddle.save(o.state_dict(), op_)
+    step(m, o)  # the step to reproduce
+    expected = m.weight.numpy().copy()
+
+    paddle.seed(0)
+    m2 = nn.Linear(4, 1)
+    o2 = optim.AdamW(learning_rate=0.01, parameters=m2.parameters())
+    m2.set_state_dict(paddle.load(mp))
+    o2.set_state_dict(paddle.load(op_))
+    step(m2, o2)
+    np.testing.assert_array_equal(expected, m2.weight.numpy())
