@@ -216,6 +216,9 @@ class StaticFunction:
             self._warm.add(sig)
             out = self._fn(*args, **kwargs)
             self._collect_state()  # re-collect: step() created accumulators
+            # the grown state changes the signature; mark it warm so the
+            # next same-shape call compiles instead of re-warming
+            self._warm.add(self._signature(in_arrays))
             return out
 
         entry = self._cache.get(sig)
