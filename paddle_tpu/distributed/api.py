@@ -154,7 +154,12 @@ def shard_optimizer(optimizer, shard_fn=None):
     from ..optimizer import Optimizer
     if not isinstance(optimizer, Optimizer):
         raise TypeError("expected a paddle_tpu Optimizer")
+    if getattr(optimizer, "_shard_fn_installed", False):
+        optimizer._shard_fn = shard_fn  # idempotent: update hook, don't re-wrap
+        return optimizer
     orig_add = optimizer._add_accumulator
+    optimizer._shard_fn = shard_fn
+    optimizer._shard_fn_installed = True
 
     def _add(name, param, **kw):
         acc = orig_add(name, param, **kw)
@@ -162,8 +167,9 @@ def shard_optimizer(optimizer, shard_fn=None):
                 acc._data.shape == param._data.shape:
             acc._data = jax.device_put(acc._data, param._data.sharding)
             _annotate(acc, param._process_mesh, param._placements)
-        if shard_fn is not None:
-            new = shard_fn(name, param, acc)
+        fn = optimizer._shard_fn
+        if fn is not None:
+            new = fn(name, param, acc)
             if new is not None:
                 optimizer._accumulators[name][id(param)] = new
                 return new

@@ -152,7 +152,19 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    return tensor  # single-controller: every shard already consistent
+    """In an SPMD region: every rank takes rank ``src``'s value (an
+    all-gather + static index, which XLA simplifies to the broadcast
+    collective). Eager single-controller: identity — GSPMD arrays are
+    already globally consistent."""
+    axis = _axis(group)
+    if axis is not None and _is_traced(tensor):
+        out = run_op(
+            "broadcast",
+            lambda x: jax.lax.all_gather(x, axis, tiled=False)[src],
+            (tensor,))
+        tensor._data = out._data
+        return out
+    return tensor
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -160,10 +172,28 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if tensor_list:
-        tensor._data = (tensor_list[0]._data
-                        if isinstance(tensor_list[0], Tensor)
-                        else jnp.asarray(tensor_list[0]))
+    """In an SPMD region: rank i takes slice i of ``src``'s stacked input.
+    (all_gather + dynamic index on axis_index; XLA folds the redundancy.)"""
+    axis = _axis(group)
+    if not tensor_list:
+        return tensor
+    from ..tensor.manipulation import stack
+    stacked = stack(list(tensor_list), axis=0)
+    if axis is not None and _is_traced(stacked):
+        n = jax.lax.psum(1, axis)  # static: mesh axis size
+        if len(tensor_list) != n:
+            raise ValueError(
+                f"scatter got {len(tensor_list)} tensors for a {n}-wide "
+                f"axis {axis!r}; one slice per rank is required")
+        def _scatter(x):
+            full = jax.lax.all_gather(x, axis, tiled=False)[src]
+            return full[jax.lax.axis_index(axis)]
+        out = run_op("scatter", _scatter, (stacked,))
+        tensor._data = out._data
+        return out
+    tensor._data = (tensor_list[0]._data
+                    if isinstance(tensor_list[0], Tensor)
+                    else jnp.asarray(tensor_list[0]))
     return tensor
 
 
@@ -172,9 +202,15 @@ def barrier(group=None):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point on TPU is collective-permute on a mesh axis. Inside an
+    SPMD region use :mod:`paddle_tpu.distributed.p2p` (``shift`` /
+    ``send_forward`` / ``send_backward``), which every rank calls
+    collectively; a one-sided eager ``send`` has no TPU equivalent."""
     raise NotImplementedError(
-        "point-to-point send/recv maps to lax.ppermute inside shard_map; "
-        "use paddle_tpu.distributed.p2p helpers in a pipeline schedule")
+        "one-sided send/recv has no TPU equivalent — p2p is collective "
+        "(both sides participate): inside shard_map use "
+        "paddle_tpu.distributed.p2p.shift / send_forward / send_backward / "
+        "ppermute from every rank of the axis")
 
 
 recv = isend = irecv = send

@@ -30,6 +30,7 @@ class ProcessMesh:
         self._ids = arr
         self._dim_names = list(dim_names)
         self._jax_mesh = None
+        self._jax_mesh_key = None
 
     # -- reference API surface ---------------------------------------------
     @property
@@ -74,16 +75,23 @@ class ProcessMesh:
 
     # -- JAX bridge ---------------------------------------------------------
     def to_jax_mesh(self):
-        """Materialize as ``jax.sharding.Mesh`` over the visible devices."""
-        if self._jax_mesh is None:
-            devices = np.asarray(jax.devices())
+        """Materialize as ``jax.sharding.Mesh`` over the visible devices.
+
+        The cache is keyed on the visible device list so a mesh built
+        before ``jax.distributed.initialize`` (or a backend switch) is
+        rebuilt rather than silently reusing stale devices."""
+        devices = jax.devices()
+        key = tuple(id(d) for d in devices)
+        if self._jax_mesh is None or self._jax_mesh_key != key:
+            dev_np = np.asarray(devices)
             flat = self._ids.reshape(-1)
-            if flat.max() >= len(devices):
+            if flat.max() >= len(dev_np):
                 raise RuntimeError(
                     f"mesh references process id {int(flat.max())} but only "
-                    f"{len(devices)} devices are visible")
-            dev_arr = devices[flat].reshape(self._ids.shape)
+                    f"{len(dev_np)} devices are visible")
+            dev_arr = dev_np[flat].reshape(self._ids.shape)
             self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+            self._jax_mesh_key = key
         return self._jax_mesh
 
     def __enter__(self):

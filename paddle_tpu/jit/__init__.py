@@ -141,7 +141,7 @@ class StaticFunction:
             add(t)
         self._state_tensors = state
 
-    def _signature(self, flat_in):
+    def _signature(self, flat_in, in_treedef):
         training = tuple(l.training for l in self._layers)
         grads = tuple(t.grad is not None for t in self._state_tensors or ())
         shapes = tuple(
@@ -149,7 +149,10 @@ class StaticFunction:
             else (type(a).__name__, a if isinstance(a, (int, float, bool, str,
                                                         type(None))) else None)
             for a in flat_in)
-        return (shapes, training, grads)
+        # the treedef distinguishes positional from keyword binding of the
+        # same leaves — without it f(x, y) and f(y=y, x=x) would share a
+        # compiled entry and silently mis-bind inputs
+        return (shapes, repr(in_treedef), training, grads)
 
     # -- the traced pure step ----------------------------------------------
     def _build(self, in_treedef):
@@ -209,7 +212,7 @@ class StaticFunction:
                      for a in flat_in]
         if self._state_tensors is None:
             self._collect_state()
-        sig = self._signature(in_arrays)
+        sig = self._signature(in_arrays, in_treedef)
 
         if sig not in self._warm:
             # warmup: eager run materializes accumulators / lazy buffers
@@ -218,7 +221,7 @@ class StaticFunction:
             self._collect_state()  # re-collect: step() created accumulators
             # the grown state changes the signature; mark it warm so the
             # next same-shape call compiles instead of re-warming
-            self._warm.add(self._signature(in_arrays))
+            self._warm.add(self._signature(in_arrays, in_treedef))
             return out
 
         entry = self._cache.get(sig)

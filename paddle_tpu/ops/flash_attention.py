@@ -57,6 +57,10 @@ def supported(q, k, v, attn_mask, causal):
         return False
     b, sq, h, d = qs
     sk = ks[1]
+    if causal and sq > sk:
+        # bottom-right alignment gives offset < 0: leading q-blocks would
+        # see zero keys (l == 0 -> 0/0 NaN rows); let the XLA path mask them
+        return False
     if sq < BLOCK_Q or sk < BLOCK_K:
         return False
     if sq % BLOCK_Q or sk % BLOCK_K:

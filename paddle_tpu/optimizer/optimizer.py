@@ -51,6 +51,7 @@ class Optimizer:
         self._learning_rate = learning_rate
         self._lr_override = None   # traced scalar injected by paddle_tpu.jit
         self.regularization = weight_decay
+        self._group_weight_decay = None  # set per-group during step()
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
         self._name = name or type(self).__name__.lower()
@@ -126,10 +127,15 @@ class Optimizer:
     # -- the update ---------------------------------------------------------
     def _apply_regularization(self, p, g):
         """L2 regularization folded into the gradient (reference:
-        ``append_regularization_ops``). Param-level regularizer wins over the
-        optimizer-level one."""
-        reg = p.regularizer if getattr(p, "regularizer", None) is not None \
-            else self.regularization
+        ``append_regularization_ops``). Param-level regularizer wins over
+        the group-level one, which wins over the optimizer-level one
+        (reference optimizer.py:1918 sets param.regularizer from the group)."""
+        if getattr(p, "regularizer", None) is not None:
+            reg = p.regularizer
+        elif self._group_weight_decay is not None:
+            reg = self._group_weight_decay
+        else:
+            reg = self.regularization
         if reg is None:
             return g
         coeff = getattr(reg, "coeff", None)
@@ -151,6 +157,7 @@ class Optimizer:
         self._accumulators_created = True
         for group in self._param_groups:
             group_lr_scale = group.get("learning_rate", 1.0)
+            self._group_weight_decay = group.get("weight_decay")
             group_params = {id(p) for p in group["params"]}
             for p, g in params_grads:
                 if id(p) not in group_params:

@@ -121,7 +121,10 @@ class Adam(Optimizer):
     def _single_update(self, p, g, lr, value):
         m_new, v_new, b1p, b2p = self._adam_moments(p, g)
         lr_t = jnp.asarray(lr, jnp.float32) * jnp.sqrt(1 - b2p) / (1 - b1p)
-        upd = m_new / (jnp.sqrt(v_new) + self._epsilon)
+        # epsilon scales with sqrt(1-beta2^t) exactly like the reference phi
+        # kernel (adam_functors.h:225): m / (sqrt(v) + eps*sqrt(1-beta2_pow))
+        upd = m_new / (jnp.sqrt(v_new)
+                       + self._epsilon * jnp.sqrt(1 - b2p))
         return value - (lr_t.astype(value.dtype)
                         * upd.astype(value.dtype))
 
@@ -153,9 +156,13 @@ class AdamW(Adam):
         if self._apply_decay_param_fun is not None and \
                 not self._apply_decay_param_fun(p.name):
             with_decay = False
-        if with_decay and self._coeff != 0.0:
+        coeff = self._coeff
+        if self._group_weight_decay is not None:
+            gw = self._group_weight_decay
+            coeff = float(getattr(gw, "coeff", gw))
+        if with_decay and coeff != 0.0:
             value = value * (1.0 - jnp.asarray(lr, jnp.float32)
-                             * self._coeff).astype(value.dtype)
+                             * coeff).astype(value.dtype)
         return super()._single_update(p, g, lr, value)
 
 
