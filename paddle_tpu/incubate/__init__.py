@@ -1,0 +1,9 @@
+"""``paddle_tpu.incubate`` — incubating APIs (fused transformer ops, MoE).
+
+Reference surface: `python/paddle/incubate/` (fused functional ops in
+`incubate/nn/functional/`, MoE under `incubate/distributed/models/moe/`).
+"""
+
+from . import nn  # noqa: F401
+
+__all__ = ["nn"]

@@ -30,6 +30,12 @@ def _naive_attention(q, k, v, mask, dropout_p, is_causal, key, scale=None):
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
+    if kh.shape[1] != qh.shape[1]:
+        # GQA fallback: broadcast the kv heads across their query group
+        # (XLA keeps this as a broadcast feeding the einsum, no HBM copy)
+        group = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, group, axis=1)
+        vh = jnp.repeat(vh, group, axis=1)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     # fp32 softmax accumulation (TPU numerics idiom)

@@ -38,10 +38,12 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
 
 
 @defop()
-def rms_norm(x, weight=None, epsilon=1e-6, bias=None):
-    """RMSNorm (reference: incubate fused_rms_norm). fp32 accumulation."""
+def rms_norm(x, weight=None, epsilon=1e-6, bias=None, axis=-1):
+    """RMSNorm (reference: incubate fused_rms_norm). fp32 accumulation.
+    ``axis`` may be an int or tuple (incubate's begin_norm_axis maps to
+    ``tuple(range(begin_norm_axis, ndim))``)."""
     xf = x.astype(jnp.float32)
-    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
     out = (xf * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
     if weight is not None:
         out = out * weight
