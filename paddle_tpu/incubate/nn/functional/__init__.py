@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ....framework.tensor import Tensor, run_op
+from ....framework.tensor import run_op
 from ....framework import random as frandom
 from ....tensor.registry import OPS
 
@@ -42,10 +42,6 @@ __all__ = [
     "fused_linear",
     "fused_bias_act",
 ]
-
-
-def _data(x):
-    return x._data if isinstance(x, Tensor) else x
 
 
 def swiglu(x, y=None, name=None):
@@ -116,7 +112,7 @@ def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5,
                                            residual))
 
 
-def _default_sin_cos(seq_len, head_dim, dtype, base=10000.0):
+def _default_sin_cos(seq_len, head_dim, base=10000.0):
     inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
                                           dtype=jnp.float32) / head_dim))
     t = jnp.arange(seq_len, dtype=jnp.float32)
@@ -130,21 +126,21 @@ def _rotate_half(x):
     return jnp.concatenate([-b, a], axis=-1)
 
 
-def _apply_rope(x, sin, cos, neox):
-    # x: [B, S, H, D]; sin/cos: [S, D] (neox) broadcast over batch & heads
+def _apply_rope(x, sin_e, cos_e, neox):
+    """x: [B, S, H, D]; sin_e/cos_e already expanded to a shape
+    broadcastable against it ([*, S, 1, D], fp32). Rotation runs in fp32
+    and casts back, so bf16 activations stay bf16."""
+    xf = x.astype(jnp.float32)
     if neox:
-        sin_ = sin[None, :, None, :]
-        cos_ = cos[None, :, None, :]
-        return x * cos_ + _rotate_half(x) * sin_
-    # GPT-J interleaved style: pairs (x0,x1),(x2,x3),...
-    half = sin[..., : sin.shape[-1] // 2]              # [S, D/2]
-    sin_ = half[None, :, None, :]
-    cos_ = cos[..., : cos.shape[-1] // 2][None, :, None, :]
-    x1 = x[..., 0::2]
-    x2 = x[..., 1::2]
-    r1 = x1 * cos_ - x2 * sin_
-    r2 = x2 * cos_ + x1 * sin_
-    return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+        out = xf * cos_e + _rotate_half(xf) * sin_e
+    else:
+        # GPT-J interleaved style: pairs (x0,x1),(x2,x3),...
+        half = sin_e.shape[-1] // 2
+        s_, c_ = sin_e[..., :half], cos_e[..., :half]
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        out = jnp.stack([x1 * c_ - x2 * s_, x2 * c_ + x1 * s_],
+                        axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -184,28 +180,21 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             sin_b = jnp.take(sin_, pos_, axis=0)
             cos_b = jnp.take(cos_, pos_, axis=0)
         if pos_ is not None:
+            sin_e = sin_b.astype(jnp.float32)[:, :, None, :]   # [B, S, 1, D]
+            cos_e = cos_b.astype(jnp.float32)[:, :, None, :]
 
             def app(x):
-                if neox:
-                    return (x * cos_b[:, :, None, :]
-                            + _rotate_half(x) * sin_b[:, :, None, :])
-                half = sin_b.shape[-1] // 2
-                s_ = sin_b[..., :half][:, :, None, :]
-                c_ = cos_b[..., :half][:, :, None, :]
-                x1, x2 = x[..., 0::2], x[..., 1::2]
-                return jnp.stack([x1 * c_ - x2 * s_, x2 * c_ + x1 * s_],
-                                 axis=-1).reshape(x.shape)
+                return _apply_rope(x, sin_e, cos_e, neox)
         else:
             if sin_ is None or cos_ is None:
-                sin_, cos_ = _default_sin_cos(seq_len, head_dim, q_.dtype,
-                                              base)
+                sin_, cos_ = _default_sin_cos(seq_len, head_dim, base)
             sin_ = jnp.reshape(sin_, (-1, sin_.shape[-1]))  # accept [1,S,1,D]
             cos_ = jnp.reshape(cos_, (-1, cos_.shape[-1]))
-            sin_t = sin_[:seq_len]
-            cos_t = cos_[:seq_len]
+            sin_e = sin_[:seq_len].astype(jnp.float32)[None, :, None, :]
+            cos_e = cos_[:seq_len].astype(jnp.float32)[None, :, None, :]
 
             def app(x):
-                return _apply_rope(x, sin_t, cos_t, neox)
+                return _apply_rope(x, sin_e, cos_e, neox)
 
         outs = [app(q_)]
         if k_ is not None:

@@ -1,0 +1,18 @@
+"""``paddle_tpu.models`` — flagship model families.
+
+The reference keeps its LLM recipes out-of-tree (PaddleNLP), but the
+BASELINE north star is Llama-3-8B pretraining MFU, so the decoder family
+lives in-tree here, built on the incubate fused ops + Pallas GQA flash
+attention.
+"""
+
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaMLP, LlamaAttention, LlamaDecoderLayer, LlamaModel,
+    LlamaForCausalLM, shard_llama, llama3_8b_config, tiny_llama_config,
+)
+
+__all__ = [
+    "LlamaConfig", "LlamaMLP", "LlamaAttention", "LlamaDecoderLayer",
+    "LlamaModel", "LlamaForCausalLM", "shard_llama", "llama3_8b_config",
+    "tiny_llama_config",
+]

@@ -1,0 +1,326 @@
+"""Llama decoder family (the BASELINE.md north-star model).
+
+Capability reference: the reference framework trains Llama via PaddleNLP on
+top of the fused ops in `python/paddle/incubate/nn/functional/` (swiglu,
+fused_rms_norm, fused_rotary_position_embedding) and flash attention
+(`python/paddle/nn/functional/flash_attention.py:147`). This module is the
+TPU-native recipe built on the same in-tree pieces:
+
+- pre-norm decoder blocks: RMSNorm -> GQA attention (+rope) -> RMSNorm ->
+  SwiGLU MLP, all through the eager tape so one definition serves eager
+  debugging and ``jit.to_static`` whole-step compilation;
+- attention dispatches to the Pallas GQA flash kernel when shapes allow
+  (`paddle_tpu/ops/flash_attention.py`), XLA fallback otherwise;
+- :func:`shard_llama` annotates every weight with (tp, fsdp) placements
+  over a ``ProcessMesh`` — GSPMD inserts the Megatron collectives
+  (column/row linear all-gather + psum, vocab-parallel embedding) from the
+  layout alone, the TPU analog of the reference's
+  `fleet/layers/mpu/mp_layers.py`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..framework.tensor import Tensor
+from ..incubate.nn import functional as FI
+from ..nn.initializer import Normal
+
+__all__ = ["LlamaConfig", "LlamaMLP", "LlamaAttention", "LlamaDecoderLayer",
+           "LlamaModel", "LlamaForCausalLM", "shard_llama",
+           "llama3_8b_config", "tiny_llama_config"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama3_8b_config():
+    """Llama-3-8B: GQA 32q/8kv, 128k vocab, rope theta 500k."""
+    return LlamaConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+        max_position_embeddings=8192, rms_norm_eps=1e-5, rope_theta=500000.0)
+
+
+def tiny_llama_config(**kw):
+    """A few-thousand-param config for tests and dry runs."""
+    base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=256,
+                rope_theta=10000.0)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def _winit(cfg):
+    return Normal(mean=0.0, std=cfg.initializer_range)
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU MLP: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        wa = _winit(config)
+        self.gate_proj = nn.Linear(config.hidden_size,
+                                   config.intermediate_size,
+                                   weight_attr=wa, bias_attr=False)
+        self.up_proj = nn.Linear(config.hidden_size,
+                                 config.intermediate_size,
+                                 weight_attr=wa, bias_attr=False)
+        self.down_proj = nn.Linear(config.intermediate_size,
+                                   config.hidden_size,
+                                   weight_attr=wa, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(FI.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaAttention(nn.Layer):
+    """GQA attention with rotary embeddings; [B, S, H, D] layout throughout
+    so the Pallas flash kernel path needs no relayout."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
+        wa = _winit(config)
+        self.q_proj = nn.Linear(config.hidden_size, h * d, weight_attr=wa,
+                                bias_attr=False)
+        self.k_proj = nn.Linear(config.hidden_size, hk * d, weight_attr=wa,
+                                bias_attr=False)
+        self.v_proj = nn.Linear(config.hidden_size, hk * d, weight_attr=wa,
+                                bias_attr=False)
+        self.o_proj = nn.Linear(h * d, config.hidden_size, weight_attr=wa,
+                                bias_attr=False)
+
+    def forward(self, x, position_ids=None, cache=None):
+        b, s = x.shape[0], x.shape[1]
+        h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
+        q = self.q_proj(x).reshape([b, s, h, d])
+        k = self.k_proj(x).reshape([b, s, hk, d])
+        v = self.v_proj(x).reshape([b, s, hk, d])
+        if position_ids is None and cache is not None:
+            # rope positions continue after the cached prefix
+            from ..tensor import creation
+            offset = cache[0].shape[1]
+            position_ids = creation.arange(
+                offset, offset + s, dtype="int64").reshape([1, s])
+        q, k, v = FI.fused_rotary_position_embedding(
+            q, k, v, position_ids=position_ids,
+            rotary_emb_base=self.config.rope_theta)
+        if cache is not None:
+            # decode path: append to the KV cache, attend over the prefix
+            pk, pv = cache
+            from ..tensor import manipulation as M
+            k = M.concat([pk, k], axis=1)
+            v = M.concat([pv, v], axis=1)
+            cache = (k, v)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = self.o_proj(out.reshape([b, s, h * d]))
+        if cache is not None:
+            return out, cache
+        return out
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, position_ids=None, cache=None):
+        h = self.input_layernorm(x)
+        if cache is not None:
+            attn, cache = self.self_attn(h, position_ids, cache)
+        else:
+            attn = self.self_attn(h, position_ids)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        if cache is not None:
+            return x, cache
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size,
+                                         weight_attr=_winit(config))
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        x = self.embed_tokens(input_ids)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                x, c = layer(x, position_ids, caches[i])
+                new_caches.append(c)
+            else:
+                x = layer(x, position_ids)
+        x = self.norm(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class LlamaForCausalLM(nn.Layer):
+    """Decoder LM. ``forward(input_ids, labels=None)`` returns logits, or
+    ``(loss, logits)`` when next-token labels are given (labels are the
+    input shifted by the caller, ignore_index=-100)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     weight_attr=_winit(config),
+                                     bias_attr=False)
+
+    def _logits(self, hidden):
+        if self.lm_head is not None:
+            return self.lm_head(hidden)
+        from ..tensor import linalg
+        return linalg.matmul(hidden, self.model.embed_tokens.weight,
+                             transpose_y=True)
+
+    def forward(self, input_ids, labels=None, position_ids=None):
+        hidden = self.model(input_ids, position_ids)
+        logits = self._logits(hidden)
+        if labels is None:
+            return logits
+        v = self.config.vocab_size
+        loss = F.cross_entropy(
+            logits.reshape([-1, v]).astype("float32"),
+            labels.reshape([-1]), ignore_index=-100)
+        return loss, logits
+
+    def num_params(self):
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+    def flops_per_token(self, seq_len):
+        """Approximate training FLOPs/token: 6*N_params + attention term
+        (the standard MFU accounting)."""
+        cfg = self.config
+        n = self.num_params()
+        attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+        return 6 * n + attn
+
+    def generate(self, input_ids, max_new_tokens=16):
+        """Greedy decode with a KV cache (serving sanity path, not perf)."""
+        from ..framework.tensor import no_grad
+        from ..tensor import manipulation as M, creation, search
+        with no_grad():
+            b, s = input_ids.shape[0], input_ids.shape[1]
+            pos = creation.arange(0, s, dtype="int64").reshape([1, s])
+            pos = M.concat([pos] * b, axis=0) if b > 1 else pos
+            hidden, caches = self.model(input_ids, pos,
+                                        caches=self._empty_caches(b))
+            logits = self._logits(hidden[:, -1:])
+            out = input_ids
+            for step in range(max_new_tokens):
+                nxt = search.argmax(logits, axis=-1).astype("int64")
+                out = M.concat([out, nxt.reshape([b, 1])], axis=1)
+                if step == max_new_tokens - 1:
+                    break  # last sampled token needs no further logits
+                cur = out.shape[1] - 1
+                pos = creation.full([b, 1], cur, dtype="int64")
+                hidden, caches = self.model(nxt.reshape([b, 1]), pos, caches)
+                logits = self._logits(hidden)
+            return out
+
+    def _empty_caches(self, batch):
+        from ..tensor import creation
+        cfg = self.config
+        dt = self.model.embed_tokens.weight.dtype  # match model dtype
+        return [
+            (creation.zeros([batch, 0, cfg.num_key_value_heads,
+                             cfg.head_dim], dtype=dt),
+             creation.zeros([batch, 0, cfg.num_key_value_heads,
+                             cfg.head_dim], dtype=dt))
+            for _ in range(cfg.num_hidden_layers)]
+
+
+# ---------------------------------------------------------------------------
+# sharding recipe: (tp, fsdp) placements per weight — the Megatron layout
+# expressed as GSPMD annotations (reference: fleet/layers/mpu/mp_layers.py)
+# ---------------------------------------------------------------------------
+def shard_llama(model: LlamaForCausalLM, mesh, tp_axis="mp",
+                fsdp_axis=None):
+    """Annotate a LlamaForCausalLM's weights over ``mesh``.
+
+    - attention q/k/v and mlp gate/up: column-parallel (out-dim on tp)
+    - attention o and mlp down: row-parallel (in-dim on tp)
+    - embedding + lm_head: vocab-parallel
+    - fsdp_axis (optional) shards the *other* matrix dim, giving the
+      ZeRO-3 layout; norms shard on fsdp only.
+    """
+    from ..distributed import shard_tensor, Shard, Replicate
+
+    tp_dim = mesh.dim_names.index(tp_axis) if tp_axis else None
+    fs_dim = mesh.dim_names.index(fsdp_axis) if fsdp_axis else None
+
+    def place(t, tp_tensor_dim, fsdp_tensor_dim):
+        p = [Replicate()] * mesh.ndim
+        if tp_dim is not None and tp_tensor_dim is not None:
+            p[tp_dim] = Shard(tp_tensor_dim)
+        if fs_dim is not None and fsdp_tensor_dim is not None:
+            p[fs_dim] = Shard(fsdp_tensor_dim)
+        return shard_tensor(t, mesh, p)
+
+    m = model.model
+    m.embed_tokens.weight = place(m.embed_tokens.weight, 0, 1)
+    if model.lm_head is not None:
+        model.lm_head.weight = place(model.lm_head.weight, 1, 0)
+    for layer in m.layers:
+        a, mlp = layer.self_attn, layer.mlp
+        a.q_proj.weight = place(a.q_proj.weight, 1, 0)
+        a.k_proj.weight = place(a.k_proj.weight, 1, 0)
+        a.v_proj.weight = place(a.v_proj.weight, 1, 0)
+        a.o_proj.weight = place(a.o_proj.weight, 0, 1)
+        mlp.gate_proj.weight = place(mlp.gate_proj.weight, 1, 0)
+        mlp.up_proj.weight = place(mlp.up_proj.weight, 1, 0)
+        mlp.down_proj.weight = place(mlp.down_proj.weight, 0, 1)
+        layer.input_layernorm.weight = place(
+            layer.input_layernorm.weight, None, 0)
+        layer.post_attention_layernorm.weight = place(
+            layer.post_attention_layernorm.weight, None, 0)
+    m.norm.weight = place(m.norm.weight, None, 0)
+    return model

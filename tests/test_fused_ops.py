@@ -144,6 +144,17 @@ class TestRope:
         np.testing.assert_allclose(q1.numpy()[:, 0], qf.numpy()[:, 100],
                                    rtol=1e-4, atol=1e-5)
 
+    def test_bf16_dtype_preserved(self):
+        x = paddle.to_tensor(np.random.randn(1, 4, 2, 8).astype(np.float32),
+                             dtype="bfloat16")
+        q, k, v = F_inc.fused_rotary_position_embedding(x, x, x)
+        assert str(q.dtype) == "bfloat16"
+        assert str(k.dtype) == "bfloat16"
+        q2, _, _ = F_inc.fused_rotary_position_embedding(
+            x, position_ids=paddle.to_tensor(np.array([[0, 1, 2, 3]],
+                                                      np.int64)))
+        assert str(q2.dtype) == "bfloat16"
+
     def test_grad_flows(self):
         x = t(np.random.randn(1, 4, 2, 8))
         q, _, _ = F_inc.fused_rotary_position_embedding(x)
