@@ -102,12 +102,15 @@ def _is_arraylike(x):
 class StaticFunction:
     """The compiled wrapper returned by ``to_static``."""
 
-    def __init__(self, function, input_spec=None, state=None, donate=True):
+    def __init__(self, function, input_spec=None, state=None, donate=True,
+                 warmup="per-signature"):
         functools.update_wrapper(self, function)
         self._fn = function
         self._input_spec = input_spec
         self._extra_state = state
         self._donate = donate
+        self._warmup = warmup   # "per-signature" | "once"
+        self._warmed_any = False
         self._cache = {}        # signature -> (jitted fn, grad slots, out box)
         self._warm = set()      # signatures already run eagerly once
         self._layers = []
@@ -214,10 +217,15 @@ class StaticFunction:
             self._collect_state()
         sig = self._signature(in_arrays, in_treedef)
 
-        if sig not in self._warm:
-            # warmup: eager run materializes accumulators / lazy buffers
-            self._warm.add(sig)
+        if sig not in self._warm and not (self._warmup == "once"
+                                          and self._warmed_any):
+            # warmup: eager run materializes accumulators / lazy buffers.
+            # Bookkeeping only after success — a failed warmup (OOM, data
+            # bug) must not mark the function warm, or a retry would trace
+            # with never-materialized accumulators and leak tracers.
             out = self._fn(*args, **kwargs)
+            self._warm.add(sig)
+            self._warmed_any = True
             self._collect_state()  # re-collect: step() created accumulators
             # the grown state changes the signature; mark it warm so the
             # next same-shape call compiles instead of re-warming
@@ -256,23 +264,31 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, state=None, full_graph=True, **kwargs):
+              backend=None, state=None, full_graph=True,
+              warmup="per-signature", **kwargs):
     """Decorator/wrapper: compile an imperative step into one XLA program.
 
     ``state`` optionally lists Layers/Optimizers/Tensors the function
     mutates (auto-discovered from the closure when omitted). Matches the
     reference's ``paddle.jit.to_static`` call shapes: bare decorator,
     decorator-with-args, and direct wrapping of a Layer.
+
+    ``warmup="once"``: only the first call runs eagerly (to materialize
+    optimizer accumulators); later unseen shapes compile directly. Use when
+    the eager pass at full shape would exceed HBM (eager holds every
+    intermediate; the compiled program lets XLA schedule memory).
     """
     def wrap(fn):
         from ..nn import Layer
         if isinstance(fn, Layer):
             layer = fn
             sf = StaticFunction(layer.forward, input_spec=input_spec,
-                                state=[layer] + list(state or ()))
+                                state=[layer] + list(state or ()),
+                                warmup=warmup)
             layer.forward = sf
             return layer
-        return StaticFunction(fn, input_spec=input_spec, state=state)
+        return StaticFunction(fn, input_spec=input_spec, state=state,
+                              warmup=warmup)
     if function is not None:
         return wrap(function)
     return wrap

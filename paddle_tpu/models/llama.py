@@ -236,10 +236,14 @@ class LlamaForCausalLM(nn.Layer):
         return sum(int(np.prod(p.shape)) for p in self.parameters())
 
     def flops_per_token(self, seq_len):
-        """Approximate training FLOPs/token: 6*N_params + attention term
-        (the standard MFU accounting)."""
+        """Approximate training FLOPs/token: 6*N_matmul_params + attention
+        term (the standard MFU accounting). The embedding lookup is a
+        gather, not a matmul, so its params are excluded — unless the
+        embedding is tied and doubles as the output projection."""
         cfg = self.config
         n = self.num_params()
+        if not cfg.tie_word_embeddings:
+            n -= cfg.vocab_size * cfg.hidden_size  # embed_tokens lookup
         attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
         return 6 * n + attn
 

@@ -141,7 +141,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         num_iters = num_kb
     acc, m, l = jax.lax.fori_loop(0, num_iters, body, init)
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    # lse is [Bq, 1]: the trailing singleton keeps the Mosaic block 2-D
+    # (blocks of a (B, H, Sq) array would be (1, Bq) — second-to-last dim 1
+    # fails the sublane-divisibility rule on real TPU lowering)
+    lse_ref[0, 0] = m + jnp.log(l)
 
 
 def _fwd(q, k, v, scale, causal, group):
@@ -162,11 +165,11 @@ def _fwd(q, k, v, scale, causal, group):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, BLOCK_Q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, BLOCK_Q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -180,8 +183,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, scale, causal, block_k, offset):
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
+    lse = lse_ref[0, 0]                         # [Bq, 1]
+    delta = delta_ref[0, 0]                     # [Bq, 1]
     sk = k_ref.shape[2]
     num_kb = sk // block_k
     qi = pl.program_id(2)
@@ -232,8 +235,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 .astype(jnp.float32)
             do = do_ref[0, gi, pl.ds(i * block_q, block_q), :] \
                 .astype(jnp.float32)
-            lse = lse_ref[0, gi, pl.ds(i * block_q, block_q)][:, None]
-            delta = delta_ref[0, gi, pl.ds(i * block_q, block_q)][:, None]
+            lse = lse_ref[0, gi, pl.ds(i * block_q, block_q), :]
+            delta = delta_ref[0, gi, pl.ds(i * block_q, block_q), :]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
             if causal:
@@ -272,7 +275,7 @@ def _bwd(scale, causal, group, res, g):
     hk, sk = kh.shape[1], kh.shape[2]
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                     # [B, H, Sq]
+                    axis=-1, keepdims=True)      # [B, H, Sq, 1]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_k=BLOCK_K, offset=sk - sq),
@@ -284,8 +287,8 @@ def _bwd(scale, causal, group, res, g):
             pl.BlockSpec((1, 1, sk, d),
                          lambda bi, hi, qi: (bi, hi // group, 0, 0)),
             pl.BlockSpec((1, 1, BLOCK_Q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda bi, hi, qi: (bi, hi, qi)),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, BLOCK_Q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, BLOCK_Q, d),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -302,8 +305,8 @@ def _bwd(scale, causal, group, res, g):
             pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((1, group, sq, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, group, sq), lambda bi, hi, ki: (bi, hi, 0)),
-            pl.BlockSpec((1, group, sq), lambda bi, hi, ki: (bi, hi, 0)),
+            pl.BlockSpec((1, group, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, group, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, hi, ki: (bi, hi, ki, 0)),

@@ -177,3 +177,64 @@ def test_jit_with_lr_schedule_no_retrace():
     sched.step()  # lr change must NOT retrace (lr is an input)
     step(x, y)
     assert len(step._cache) == n_compiled
+
+
+def test_jit_warmup_once_skips_eager_on_new_shapes():
+    m1, o1 = make_model(9)
+    m2, o2 = make_model(9)
+    calls = {"n": 0}
+
+    def step_eager(x, y):
+        loss = ((m1(x) - y) ** 2).mean()
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+        return loss
+
+    def _step(x, y):
+        calls["n"] += 1
+        loss = ((m2(x) - y) ** 2).mean()
+        loss.backward()
+        o2.step()
+        o2.clear_grad()
+        return loss
+
+    step_jit = jit.to_static(_step, state=[m2, o2], warmup="once")
+    xs, ys = paddle.to_tensor(X[:4]), paddle.to_tensor(Y[:4])
+    xb, yb = paddle.to_tensor(X), paddle.to_tensor(Y)
+    step_jit(xs, ys)            # eager warmup (small shape)
+    step_eager(xs, ys)
+    assert calls["n"] == 1
+    # a NEW shape must compile directly: the python body runs only while
+    # tracing (once), never as a second eager warmup
+    for _ in range(3):
+        le = float(step_eager(xb, yb))
+        lc = float(step_jit(xb, yb))
+        np.testing.assert_allclose(le, lc, rtol=1e-5, atol=1e-6)
+    assert calls["n"] == 2  # exactly one trace of the big shape
+
+
+def test_jit_failed_warmup_does_not_mark_warm():
+    m, o = make_model(11)
+    boom = {"on": True}
+
+    def _step(x, y):
+        if boom["on"]:
+            raise RuntimeError("injected warmup failure")
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    step_jit = jit.to_static(_step, state=[m, o], warmup="once")
+    x, y = paddle.to_tensor(X), paddle.to_tensor(Y)
+    try:
+        step_jit(x, y)
+    except RuntimeError:
+        pass
+    boom["on"] = False
+    # retry must re-run the eager warmup (accumulators were never made)
+    first = float(step_jit(x, y))
+    second = float(step_jit(x, y))  # now compiled
+    assert np.isfinite(first) and np.isfinite(second)
