@@ -44,7 +44,7 @@ def device_peak():
 
 
 def bench_train_step(cfg_kw, batch, seq, steps=10, amp=True):
-    """Train-step wall time through to_static; returns (result dict, model)."""
+    """Train-step wall time through to_static; returns a result dict."""
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, LlamaConfig
 

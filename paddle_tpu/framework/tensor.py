@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from . import dtype as dtypes
+from . import amp_state
 from .. import flags
 
 __all__ = ["Tensor", "Parameter", "GradNode", "is_grad_enabled", "set_grad_enabled",
@@ -122,6 +123,8 @@ def run_op(name, fn, args, kwargs=None, differentiable=True):
     grad. Returns Tensor or tuple of Tensors, matching fn's output structure.
     """
     kwargs = kwargs or {}
+    if amp_state.enabled():
+        fn = amp_state.wrap(name, fn)
     diff_tensors = []       # Tensors we differentiate w.r.t.
     spec_args = []          # arg template: ('d', idx) | raw value
     record = _grad_enabled and differentiable

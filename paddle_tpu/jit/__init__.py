@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 from ..framework import random as frandom
+from ..framework import amp_state
 
 __all__ = ["to_static", "not_to_static", "ignore_module", "StaticFunction",
            "enable_to_static"]
@@ -66,6 +67,10 @@ def _discover_state(fn, extra):
             optimizers.append(obj)
         elif isinstance(obj, Tensor):
             tensors.append(obj)
+        elif hasattr(obj, "__state_tensors__"):
+            # stateful helpers (e.g. amp.GradScaler) expose their Tensors
+            for t in obj.__state_tensors__():
+                visit(t)
         elif isinstance(obj, (list, tuple)):
             for e in obj:
                 visit(e)
@@ -152,10 +157,16 @@ class StaticFunction:
             else (type(a).__name__, a if isinstance(a, (int, float, bool, str,
                                                         type(None))) else None)
             for a in flat_in)
+        # ambient autocast state is baked into the trace (casts become part
+        # of the compiled program), so a program traced inside auto_cast
+        # must not be reused outside it — key the cache on it
+        amp = amp_state.current()
+        amp_key = None if amp is None else (amp.dtype.name, amp.level,
+                                            amp.white, amp.black)
         # the treedef distinguishes positional from keyword binding of the
         # same leaves — without it f(x, y) and f(y=y, x=x) would share a
         # compiled entry and silently mis-bind inputs
-        return (shapes, repr(in_treedef), training, grads)
+        return (shapes, repr(in_treedef), training, grads, amp_key)
 
     # -- the traced pure step ----------------------------------------------
     def _build(self, in_treedef):

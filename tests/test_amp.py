@@ -1,0 +1,293 @@
+"""AMP: auto_cast O1/O2, decorate, GradScaler, to_static integration.
+
+Reference bar: `python/paddle/amp/auto_cast.py`, `grad_scaler.py`,
+`amp_lists.py` — white ops run low-precision, black ops fp32, O2 casts
+params with fp32 master weights, scaler skips overflow steps.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_o1_white_op_runs_bf16():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    w = paddle.to_tensor(np.random.randn(8, 2).astype("float32"))
+    with paddle.amp.auto_cast():
+        y = paddle.matmul(x, w)
+    assert y.dtype.name == "bfloat16"
+    # outside the region: fp32 again
+    y2 = paddle.matmul(x, w)
+    assert y2.dtype.name == "float32"
+
+
+def test_o1_black_op_runs_fp32():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    with paddle.amp.auto_cast():
+        h = x.astype("bfloat16")
+        s = paddle.exp(h)
+    assert s.dtype.name == "float32"
+
+
+def test_o1_gray_op_follows_inputs():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    with paddle.amp.auto_cast():
+        y = x + 1.0
+    assert y.dtype.name == "float32"
+
+
+def test_custom_lists_override():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    w = paddle.to_tensor(np.random.randn(8, 2).astype("float32"))
+    with paddle.amp.auto_cast(custom_black_list={"matmul"}):
+        y = paddle.matmul(x.astype("bfloat16"), w.astype("bfloat16"))
+    assert y.dtype.name == "float32"
+
+
+def test_nested_disable():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    w = paddle.to_tensor(np.random.randn(8, 2).astype("float32"))
+    with paddle.amp.auto_cast():
+        with paddle.amp.auto_cast(enable=False):
+            y = paddle.matmul(x, w)
+    assert y.dtype.name == "float32"
+
+
+def test_grad_flows_back_in_param_dtype():
+    w = paddle.to_tensor(np.random.randn(8, 2).astype("float32"),
+                         stop_gradient=False)
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    with paddle.amp.auto_cast():
+        y = paddle.matmul(x, w)
+        loss = (y.astype("float32") ** 2).mean()
+    loss.backward()
+    assert w.grad is not None
+    assert w.grad.dtype.name == "float32"  # cotangent cast back through vjp
+
+
+def _llama_step_fns(seed=3):
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+    paddle.seed(seed)
+    cfg = tiny_llama_config(num_hidden_layers=1)
+    m = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+
+    def step(ids, labels):
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            loss, _ = m(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (4, 17)).astype(np.int64)
+    return m, opt, step, (paddle.to_tensor(ids[:, :-1]),
+                          paddle.to_tensor(ids[:, 1:]))
+
+
+def test_o1_llama_converges_eager():
+    m, opt, step, (ids, labels) = _llama_step_fns()
+    losses = [float(step(ids, labels)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(losses))
+
+
+def test_o1_llama_traced_matches_eager():
+    m1, o1, step1, (ids, labels) = _llama_step_fns(seed=3)
+    m2, o2, step2, _ = _llama_step_fns(seed=3)
+    for (na, a), (nb, b) in zip(m1.named_parameters(), m2.named_parameters()):
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+    compiled = paddle.jit.to_static(step2, state=[m2, o2])
+    for _ in range(4):
+        le = float(step1(ids, labels))
+        lc = float(compiled(ids, labels))
+        # bf16 matmuls: eager and traced share the policy, so parity is
+        # limited only by compile-vs-eager fusion differences
+        np.testing.assert_allclose(le, lc, rtol=2e-2, atol=2e-3)
+
+
+def test_o2_decorate_casts_params_except_norms():
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+    paddle.seed(0)
+    m = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=1))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    m, opt = paddle.amp.decorate(m, opt, level="O2")
+    assert m.model.layers[0].self_attn.q_proj.weight.dtype.name == "bfloat16"
+    assert m.model.embed_tokens.weight.dtype.name == "bfloat16"
+    assert m.model.norm.weight.dtype.name == "float32"  # norms stay fp32
+    assert opt._multi_precision
+
+
+def test_o2_master_weights_update():
+    paddle.seed(0)
+    lin = nn.Linear(8, 4)
+    opt = paddle.optimizer.AdamW(learning_rate=0.1,
+                                 parameters=lin.parameters())
+    lin, opt = paddle.amp.decorate(lin, opt, level="O2")
+    x = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+    w_before = lin.weight.numpy().copy()
+    for _ in range(2):
+        with paddle.amp.auto_cast(level="O2"):
+            loss = (lin(x).astype("float32") ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert lin.weight.dtype.name == "bfloat16"
+    master = opt._accumulators["master_weight"][id(lin.weight)]
+    assert master.dtype.name == "float32"
+    # param tracks the quantized master
+    np.testing.assert_array_equal(
+        lin.weight.numpy(), master._data.astype(lin.weight._data.dtype))
+    assert not np.array_equal(lin.weight.numpy(), w_before)
+
+
+def test_grad_scaler_scales_and_unscales():
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    loss = lin(x).mean()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    g_scaled = lin.weight.grad.numpy().copy()
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(lin.weight.grad.numpy(), g_scaled / 1024.0,
+                               rtol=1e-6)
+    scaler.step(opt)
+    scaler.update()
+    assert float(scaler.get_loss_scaling()) == 1024.0  # growth not yet hit
+
+
+def test_grad_scaler_skips_on_overflow_and_shrinks():
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    w0 = lin.weight.numpy().copy()
+    loss = lin(paddle.to_tensor(np.ones((2, 4), "float32"))).mean()
+    scaler.scale(loss).backward()
+    # poison the gradient
+    import jax.numpy as jnp
+    lin.weight.grad._data = lin.weight.grad._data.at[0, 0].set(jnp.inf)
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_array_equal(lin.weight.numpy(), w0)  # step skipped
+    assert float(scaler.get_loss_scaling()) == 512.0       # scale halved
+
+
+def test_grad_scaler_growth():
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                   incr_every_n_steps=2)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    for _ in range(2):
+        loss = lin(x).mean()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+    assert float(scaler.get_loss_scaling()) == 16.0
+
+
+def test_grad_scaler_under_to_static():
+    def make():
+        paddle.seed(5)
+        m = nn.Linear(4, 1)
+        o = paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=m.parameters())
+        s = paddle.amp.GradScaler(init_loss_scaling=256.0,
+                                  incr_every_n_steps=3)
+        return m, o, s
+
+    me, oe, se = make()
+    mc, oc, sc = make()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4)
+                         .astype("float32"))
+
+    def step(m, o, s, x):
+        loss = (m(x) ** 2).mean()
+        s.scale(loss).backward()
+        s.step(o)
+        s.update()
+        o.clear_grad()
+        return loss
+
+    compiled = paddle.jit.to_static(lambda x: step(mc, oc, sc, x),
+                                    state=[mc, oc, sc])
+    for _ in range(4):
+        le = float(step(me, oe, se, x))
+        lc = float(compiled(x))
+        np.testing.assert_allclose(le, lc, rtol=1e-5, atol=1e-6)
+    # scaler state advanced identically inside the compiled program
+    np.testing.assert_allclose(float(se.get_loss_scaling()),
+                               float(sc.get_loss_scaling()))
+    np.testing.assert_allclose(me.weight.numpy(), mc.weight.numpy(),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_scaler_state_dict_roundtrip():
+    s = paddle.amp.GradScaler(init_loss_scaling=64.0)
+    state = s.state_dict()
+    s2 = paddle.amp.GradScaler()
+    s2.load_state_dict(state)
+    assert float(s2.get_loss_scaling()) == 64.0
+
+
+def test_is_supported_flags():
+    assert paddle.amp.is_bfloat16_supported() is True
+
+
+def test_traced_overflow_step_leaves_params_unchanged():
+    # init scale so large the scaled grads overflow fp32: the compiled
+    # step must mask the update (params bit-identical), not NaN-poison it
+    paddle.seed(6)
+    m = nn.Linear(4, 1)
+    o = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    s = paddle.amp.GradScaler(init_loss_scaling=1e38)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4)
+                         .astype("float32") * 100)
+
+    def step(x):
+        loss = (m(x) ** 2).mean()
+        s.scale(loss).backward()
+        s.step(o)
+        s.update()
+        o.clear_grad()
+        return loss
+
+    compiled = paddle.jit.to_static(step, state=[m, o, s])
+    compiled(x)                      # warmup (eager)
+    w0 = m.weight.numpy().copy()
+    scale0 = float(s.get_loss_scaling())
+    compiled(x)                      # compiled overflow step
+    assert np.isfinite(m.weight.numpy()).all()
+    np.testing.assert_array_equal(m.weight.numpy(), w0)
+    assert float(s.get_loss_scaling()) == scale0 / 2
+
+
+def test_to_static_cache_keys_on_amp_state():
+    m = nn.Linear(4, 2)
+
+    def fwd(x):
+        return m(x)
+
+    compiled = paddle.jit.to_static(fwd, state=[m])
+    x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+    with paddle.amp.auto_cast():
+        compiled(x)                      # warm inside autocast
+        y_amp = compiled(x)              # compiled with bf16 baked in
+        assert y_amp.dtype.name == "bfloat16"
+    compiled(x)                          # warm outside autocast
+    y = compiled(x)                      # must NOT reuse the bf16 program
+    assert y.dtype.name == "float32"
