@@ -108,12 +108,17 @@ class StaticFunction:
     """The compiled wrapper returned by ``to_static``."""
 
     def __init__(self, function, input_spec=None, state=None, donate=True,
-                 warmup="per-signature"):
+                 warmup="per-signature", donate_inputs=False):
         functools.update_wrapper(self, function)
         self._fn = function
         self._input_spec = input_spec
         self._extra_state = state
         self._donate = donate
+        # donate_inputs additionally donates the INPUT arrays to XLA so
+        # same-shaped outputs alias them in place (e.g. KV-cache buffers in
+        # a decode loop). Only safe when the caller never reuses an input
+        # after the call.
+        self._donate_inputs = donate_inputs
         self._warmup = warmup   # "per-signature" | "once"
         self._warmed_any = False
         self._cache = {}        # signature -> (jitted fn, grad slots, out box)
@@ -214,6 +219,8 @@ class StaticFunction:
                     o._lr_override = ov
 
         donate = (0, 1) if self._donate else ()
+        if self._donate_inputs:
+            donate = donate + (2,)
         return jax.jit(pure_step, donate_argnums=donate), grad_idx, out_box
 
     def __call__(self, *args, **kwargs):
@@ -254,8 +261,18 @@ class StaticFunction:
         lrs = [jnp.asarray(o.get_lr(), jnp.float32)
                for o in self._optimizers]
         key = frandom.next_key()
-        new_state, new_grads, flat_out, _ = jitted(
-            state, grads, in_arrays, lrs, key)
+        if self._donate_inputs:
+            # some inputs (e.g. prefill tokens) have no same-shaped output
+            # to alias — the resulting JAX warning is expected, not a bug
+            import warnings
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
+                new_state, new_grads, flat_out, _ = jitted(
+                    state, grads, in_arrays, lrs, key)
+        else:
+            new_state, new_grads, flat_out, _ = jitted(
+                state, grads, in_arrays, lrs, key)
         for t, a in zip(self._state_tensors, new_state):
             t._data = a
             t._node = None

@@ -77,6 +77,47 @@ def _winit(cfg):
     return Normal(mean=0.0, std=cfg.initializer_range)
 
 
+def _kv_cache_update(buf, new, start):
+    """Write ``new`` [B, s, Hk, D] into ``buf`` [B, max_len, Hk, D] at
+    sequence offset ``start`` (a scalar int Tensor, traced-safe)."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.tensor import run_op
+
+    s, max_len = new.shape[1], buf.shape[1]
+    start_arr = start._data if hasattr(start, "_data") else start
+    if not isinstance(start_arr, jax.core.Tracer) \
+            and int(start_arr) + s > max_len:
+        # dynamic_update_slice would silently clamp the start and corrupt
+        # the newest cached positions — refuse instead
+        raise ValueError(
+            f"KV cache overflow: writing {s} tokens at offset "
+            f"{int(start_arr)} exceeds the static buffer ({max_len})")
+
+    def fn(b, n, st):
+        zero = jnp.zeros((), jnp.int32)
+        return jax.lax.dynamic_update_slice(
+            b, n.astype(b.dtype), (zero, jnp.asarray(st, jnp.int32),
+                                   zero, zero))
+
+    return run_op("kv_cache_update", fn, (buf, new, start))
+
+
+def _decode_mask(length, s, max_len):
+    """Bool [1, 1, s, max_len]: query i (absolute pos length+i) sees key j
+    iff j <= length + i — causal over the valid prefix of a static
+    buffer."""
+    import jax.numpy as jnp
+    from ..framework.tensor import run_op
+
+    def fn(ln):
+        qpos = jnp.asarray(ln, jnp.int32) + jnp.arange(s, dtype=jnp.int32)
+        kpos = jnp.arange(max_len, dtype=jnp.int32)
+        return (kpos[None, :] <= qpos[:, None])[None, None]
+
+    return run_op("decode_mask", fn, (length,), differentiable=False)
+
+
 class LlamaMLP(nn.Layer):
     """SwiGLU MLP: down(silu(gate(x)) * up(x))."""
 
@@ -118,33 +159,44 @@ class LlamaAttention(nn.Layer):
         self.o_proj = nn.Linear(h * d, config.hidden_size, weight_attr=wa,
                                 bias_attr=False)
 
-    def forward(self, x, position_ids=None, cache=None):
+    def forward(self, x, position_ids=None, cache=None, cache_len=None,
+                attn_mask=None):
         b, s = x.shape[0], x.shape[1]
         h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
         q = self.q_proj(x).reshape([b, s, h, d])
         k = self.k_proj(x).reshape([b, s, hk, d])
         v = self.v_proj(x).reshape([b, s, hk, d])
+        if cache is not None and cache_len is None:
+            raise ValueError(
+                "cache_len (scalar int Tensor) is required when a KV "
+                "cache is passed — the static buffer needs the write "
+                "offset")
         if position_ids is None and cache is not None:
-            # rope positions continue after the cached prefix
+            # direct layer use: rope continues after the cached prefix
+            # (LlamaModel.forward precomputes this; keep the layer correct
+            # standalone too)
             from ..tensor import creation
-            offset = cache[0].shape[1]
             position_ids = creation.arange(
-                offset, offset + s, dtype="int64").reshape([1, s])
+                0, s, dtype="int64").reshape([1, s]) \
+                + cache_len.astype("int64")
         q, k, v = FI.fused_rotary_position_embedding(
             q, k, v, position_ids=position_ids,
             rotary_emb_base=self.config.rope_theta)
         if cache is not None:
-            # decode path: append to the KV cache, attend over the prefix
-            pk, pv = cache
-            from ..tensor import manipulation as M
-            k = M.concat([pk, k], axis=1)
-            v = M.concat([pv, v], axis=1)
-            cache = (k, v)
+            # decode path: write into the static [B, max_len, Hk, D] buffer
+            # at cache_len (the TPU idiom — no shape growth, one compile for
+            # all decode steps; reference capability:
+            # phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu)
+            k_buf = _kv_cache_update(cache[0], k, cache_len)
+            v_buf = _kv_cache_update(cache[1], v, cache_len)
+            if attn_mask is None:
+                attn_mask = _decode_mask(cache_len, s, k_buf.shape[1])
+            out = F.scaled_dot_product_attention(q, k_buf, v_buf,
+                                                 attn_mask=attn_mask)
+            out = self.o_proj(out.reshape([b, s, h * d]))
+            return out, (k_buf, v_buf)
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
-        out = self.o_proj(out.reshape([b, s, h * d]))
-        if cache is not None:
-            return out, cache
-        return out
+        return self.o_proj(out.reshape([b, s, h * d]))
 
 
 class LlamaDecoderLayer(nn.Layer):
@@ -157,10 +209,12 @@ class LlamaDecoderLayer(nn.Layer):
             config.hidden_size, epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, position_ids=None, cache=None):
+    def forward(self, x, position_ids=None, cache=None, cache_len=None,
+                attn_mask=None):
         h = self.input_layernorm(x)
         if cache is not None:
-            attn, cache = self.self_attn(h, position_ids, cache)
+            attn, cache = self.self_attn(h, position_ids, cache, cache_len,
+                                         attn_mask)
         else:
             attn = self.self_attn(h, position_ids)
         x = x + attn
@@ -183,12 +237,29 @@ class LlamaModel(nn.Layer):
         self.norm = nn.RMSNorm(config.hidden_size,
                                epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, position_ids=None, caches=None):
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cache_len=None):
         x = self.embed_tokens(input_ids)
         new_caches = [] if caches is not None else None
+        attn_mask = None
+        if caches is not None:
+            if cache_len is None:
+                raise ValueError(
+                    "cache_len is required when caches are passed")
+            s = input_ids.shape[1]
+            if position_ids is None:
+                # rope positions continue after the cached prefix
+                # (cache_len is a traced scalar: one program per shape)
+                from ..tensor import creation
+                position_ids = creation.arange(
+                    0, s, dtype="int64").reshape([1, s]) \
+                    + cache_len.astype("int64")
+            # identical for every layer — build once, not per layer
+            attn_mask = _decode_mask(cache_len, s, caches[0][0].shape[1])
         for i, layer in enumerate(self.layers):
             if caches is not None:
-                x, c = layer(x, position_ids, caches[i])
+                x, c = layer(x, position_ids, caches[i], cache_len,
+                             attn_mask)
                 new_caches.append(c)
             else:
                 x = layer(x, position_ids)
@@ -247,37 +318,72 @@ class LlamaForCausalLM(nn.Layer):
         attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
         return 6 * n + attn
 
-    def generate(self, input_ids, max_new_tokens=16):
-        """Greedy decode with a KV cache (serving sanity path, not perf)."""
-        from ..framework.tensor import no_grad
-        from ..tensor import manipulation as M, creation, search
+    def _decode_step(self, tokens, cache_len, caches):
+        """One generation step: (next_token, new_cache_len, new_caches).
+        Pure in (tokens, cache_len, caches) so ``to_static`` compiles it
+        ONCE per shape — the static KV buffers keep every decode step the
+        same program, and with input donation XLA updates them in place."""
+        from ..tensor import search
+        hidden, caches = self.model(tokens, None, caches, cache_len)
+        logits = self._logits(hidden[:, -1:])
+        nxt = search.argmax(logits, axis=-1).astype("int64")
+        new_len = cache_len + tokens.shape[1]
+        return nxt, new_len, caches
+
+    def generate(self, input_ids, max_new_tokens=16, max_length=None):
+        """Greedy decode over a static KV cache: one compile for the
+        prefill shape + one for the single-token decode shape, reused for
+        every subsequent step and every same-shape call. Inputs of the
+        compiled step are donated (the caches alias in place on device), so
+        nothing passed to one step is touched after it. The buffer length
+        is bucketed (multiple of 64) so prompts of different lengths share
+        the same decode executable."""
+        from ..framework.tensor import Tensor, no_grad
+        from ..tensor import manipulation as M
+        from .. import jit
+        import jax.numpy as jnp
+
+        # the compiled step pins parameter objects; rebuild if any were
+        # replaced since (e.g. shard_llama swapped in dist Parameters)
+        param_key = tuple(id(p) for p in self.parameters())
+        if getattr(self, "_decode_static", None) is None \
+                or self._decode_param_key != param_key:
+            self._decode_static = jit.StaticFunction(
+                self._decode_step, state=[self], warmup="once",
+                donate_inputs=True)
+            self._decode_param_key = param_key
+        step = self._decode_static
         with no_grad():
             b, s = input_ids.shape[0], input_ids.shape[1]
-            pos = creation.arange(0, s, dtype="int64").reshape([1, s])
-            pos = M.concat([pos] * b, axis=0) if b > 1 else pos
-            hidden, caches = self.model(input_ids, pos,
-                                        caches=self._empty_caches(b))
-            logits = self._logits(hidden[:, -1:])
-            out = input_ids
-            for step in range(max_new_tokens):
-                nxt = search.argmax(logits, axis=-1).astype("int64")
-                out = M.concat([out, nxt.reshape([b, 1])], axis=1)
-                if step == max_new_tokens - 1:
-                    break  # last sampled token needs no further logits
-                cur = out.shape[1] - 1
-                pos = creation.full([b, 1], cur, dtype="int64")
-                hidden, caches = self.model(nxt.reshape([b, 1]), pos, caches)
-                logits = self._logits(hidden)
-            return out
+            need = s + max_new_tokens
+            max_len = max_length if max_length is not None \
+                else ((need + 63) // 64) * 64
+            if max_len < need:
+                raise ValueError(
+                    f"max_length={max_len} < prompt + max_new_tokens "
+                    f"({need})")
+            caches = self._empty_caches(b, max_len)
+            cache_len = Tensor(jnp.asarray(0, jnp.int32))
+            # clone: the step donates its inputs, and the caller's
+            # input_ids must survive
+            tokens = Tensor(jnp.array(input_ids._data))
+            new_tokens = []
+            for i in range(max_new_tokens):
+                nxt, cache_len, caches = step(tokens, cache_len, caches)
+                tokens = nxt.reshape([b, 1])
+                # copy: `tokens` itself is donated into the next step, but
+                # the appended value must survive until the final concat
+                new_tokens.append(Tensor(jnp.array(tokens._data)))
+            return M.concat([input_ids] + new_tokens, axis=1)
 
-    def _empty_caches(self, batch):
+    def _empty_caches(self, batch, max_len):
         from ..tensor import creation
         cfg = self.config
         dt = self.model.embed_tokens.weight.dtype  # match model dtype
         return [
-            (creation.zeros([batch, 0, cfg.num_key_value_heads,
+            (creation.zeros([batch, max_len, cfg.num_key_value_heads,
                              cfg.head_dim], dtype=dt),
-             creation.zeros([batch, 0, cfg.num_key_value_heads,
+             creation.zeros([batch, max_len, cfg.num_key_value_heads,
                              cfg.head_dim], dtype=dt))
             for _ in range(cfg.num_hidden_layers)]
 

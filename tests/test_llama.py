@@ -108,21 +108,62 @@ class TestLlamaModel:
 
     def test_cache_decode_positions_default(self):
         # decode without explicit position_ids must rope at the true
-        # position (prefix length), matching the full-sequence forward
+        # position (cache_len), matching the full-sequence forward —
+        # now over a STATIC [B, max_len, Hk, D] buffer
+        import jax.numpy as jnp
+        from paddle_tpu import Tensor
         cfg = tiny_llama_config(num_hidden_layers=1)
         m = LlamaForCausalLM(cfg)
         m.eval()
         ids, _ = data(batch=1, seq=9)
         full_logits = m(ids)
-        caches = m._empty_caches(1)
-        import paddle_tpu.tensor.creation as C
-        pos = C.arange(0, 7, dtype="int64").reshape([1, 7])
-        h, caches = m.model(ids[:, :7], pos, caches)
+        caches = m._empty_caches(1, 8)
+        zero = Tensor(jnp.asarray(0, jnp.int32))
+        h, caches = m.model(ids[:, :7], None, caches, cache_len=zero)
         # feed token 7 with NO position_ids: attention must infer pos=7
-        h2, _ = m.model(ids[:, 7:8], None, caches)
+        seven = Tensor(jnp.asarray(7, jnp.int32))
+        h2, _ = m.model(ids[:, 7:8], None, caches, cache_len=seven)
         l_full = full_logits.numpy()[:, 7]
         l_dec = m._logits(h2).numpy()[:, 0]
         np.testing.assert_allclose(l_dec, l_full, rtol=1e-4, atol=1e-4)
+        # prefill logits over the static buffer also match the dense run
+        l_pre = m._logits(h[:, -1:]).numpy()[:, 0]
+        np.testing.assert_allclose(l_pre, full_logits.numpy()[:, 6],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_generate_compiles_once(self):
+        # the serving property the static cache buys: the decode python
+        # body traces at most twice (prefill shape + token shape), no
+        # matter how many tokens or repeated calls
+        cfg = tiny_llama_config(num_hidden_layers=1)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids, _ = data(batch=2, seq=5)
+        m.generate(ids, max_new_tokens=6)
+        sf = m._decode_static
+        assert len(sf._cache) <= 2
+        m.generate(ids, max_new_tokens=6)   # may compile the prefill shape
+        assert len(sf._cache) <= 2
+        n_compiled = len(sf._cache)
+        out3 = m.generate(ids, max_new_tokens=6)
+        assert len(sf._cache) == n_compiled  # steady state: zero new traces
+        assert out3.shape == [2, 10]
+
+    def test_generate_matches_dense_greedy(self):
+        # KV-cache greedy decode == argmax over the dense full forward
+        cfg = tiny_llama_config(num_hidden_layers=2)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids, _ = data(batch=2, seq=5, seed=3)
+        out = m.generate(ids, max_new_tokens=4).numpy()
+        cur = ids
+        import paddle_tpu as paddle
+        for _ in range(4):
+            logits = m(cur).numpy()
+            nxt = logits[:, -1].argmax(-1)
+            cur = paddle.to_tensor(
+                np.concatenate([cur.numpy(), nxt[:, None]], axis=1))
+        np.testing.assert_array_equal(out, cur.numpy())
 
     def test_tied_embeddings(self):
         cfg = tiny_llama_config(tie_word_embeddings=True)
@@ -177,3 +218,43 @@ class TestShardedLlama:
         dw = m.model.layers[0].mlp.down_proj.weight
         spec = dw._data.sharding.spec
         assert spec[0] == "mp" and spec[1] == "fsdp"
+
+
+class TestKVCacheGuards:
+    def test_overflow_raises(self):
+        import jax.numpy as jnp
+        from paddle_tpu import Tensor
+        cfg = tiny_llama_config(num_hidden_layers=1)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids, _ = data(batch=1, seq=9)
+        caches = m._empty_caches(1, 8)
+        with pytest.raises(ValueError, match="overflow"):
+            m.model(ids[:, :8], None, caches,
+                    cache_len=Tensor(jnp.asarray(1, jnp.int32)))
+
+    def test_cache_without_len_raises(self):
+        cfg = tiny_llama_config(num_hidden_layers=1)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids, _ = data(batch=1, seq=5)
+        caches = m._empty_caches(1, 8)
+        with pytest.raises(ValueError, match="cache_len"):
+            m.model(ids[:, :4], None, caches)
+
+    def test_generate_rebuilds_after_param_swap(self):
+        # replacing parameter objects (shard_llama does this) must not
+        # leave generate() bound to the stale tensors
+        cfg = tiny_llama_config(num_hidden_layers=1)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids, _ = data(batch=1, seq=5)
+        out1 = m.generate(ids, max_new_tokens=3)
+        sf1 = m._decode_static
+        # swap in a fresh Parameter object with identical values
+        from paddle_tpu.framework.tensor import Parameter
+        w = m.model.embed_tokens.weight
+        m.model.embed_tokens.weight = Parameter(w._data)
+        out2 = m.generate(ids, max_new_tokens=3)
+        assert m._decode_static is not sf1  # rebuilt, not stale
+        np.testing.assert_array_equal(out1.numpy(), out2.numpy())
