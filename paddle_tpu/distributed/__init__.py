@@ -26,6 +26,8 @@ from .mp_layers import (  # noqa: F401
     ParallelCrossEntropy,
 )
 from . import p2p  # noqa: F401
+from . import pipeline  # noqa: F401
+from .pipeline import pipeline_spmd  # noqa: F401
 from . import collective  # noqa: F401
 
 __all__ = [

@@ -10,9 +10,10 @@ from .llama import (  # noqa: F401
     LlamaConfig, LlamaMLP, LlamaAttention, LlamaDecoderLayer, LlamaModel,
     LlamaForCausalLM, shard_llama, llama3_8b_config, tiny_llama_config,
 )
+from .llama_pipe import LlamaForCausalLMPipe  # noqa: F401
 
 __all__ = [
     "LlamaConfig", "LlamaMLP", "LlamaAttention", "LlamaDecoderLayer",
     "LlamaModel", "LlamaForCausalLM", "shard_llama", "llama3_8b_config",
-    "tiny_llama_config",
+    "tiny_llama_config", "LlamaForCausalLMPipe",
 ]

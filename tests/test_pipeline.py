@@ -1,0 +1,215 @@
+"""Pipeline parallelism: compiled fill-drain schedule over a pp mesh axis.
+
+Reference bar: `fleet/meta_parallel/pipeline_parallel.py:149` — the pp
+model's loss curve must match the single-device run
+(`test/legacy_test/test_dist_base.py:952`).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import ProcessMesh
+from paddle_tpu.distributed.pipeline import pipeline_spmd
+from paddle_tpu.models import (LlamaForCausalLM, LlamaForCausalLMPipe,
+                               tiny_llama_config)
+
+import jax
+import jax.numpy as jnp
+
+
+def pp_mesh(p=4):
+    return ProcessMesh(np.arange(p), dim_names=["pp"])
+
+
+class TestPipelineSpmd:
+    def test_identity_stages_roundtrip(self):
+        # P stages of y = x @ W with W = I: pipeline output == input
+        mesh = pp_mesh(4)
+        params = {"w": jnp.stack([jnp.eye(8, dtype=jnp.float32)] * 4)}
+
+        def stage(p, h):
+            def body(hc, w):
+                return jnp.matmul(hc, w), None
+            h, _ = jax.lax.scan(body, h, p["w"])
+            return h
+
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 8), jnp.float32)
+        y = pipeline_spmd(stage, params, x, mesh=mesh, axis="pp",
+                          num_microbatches=4)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6)
+
+    def test_matches_sequential_composition(self):
+        mesh = pp_mesh(4)
+        rng = np.random.RandomState(1)
+        ws = jnp.asarray(rng.randn(4, 8, 8) * 0.3, jnp.float32)
+        bs = jnp.asarray(rng.randn(4, 8) * 0.1, jnp.float32)
+        params = {"w": ws, "b": bs}
+
+        def stage(p, h):
+            def body(hc, wb):
+                w, b = wb
+                return jnp.tanh(jnp.matmul(hc, w) + b), None
+            h, _ = jax.lax.scan(body, h, (p["w"], p["b"]))
+            return h
+
+        x = jnp.asarray(rng.randn(6, 8), jnp.float32)
+        y = pipeline_spmd(stage, params, x, mesh=mesh, axis="pp",
+                          num_microbatches=2)
+        ref = x
+        for i in range(4):
+            ref = jnp.tanh(jnp.matmul(ref, ws[i]) + bs[i])
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gradients_flow_through_pipeline(self):
+        mesh = pp_mesh(2)
+        rng = np.random.RandomState(2)
+        ws = jnp.asarray(rng.randn(2, 4, 4) * 0.3, jnp.float32)
+        x = jnp.asarray(rng.randn(4, 4), jnp.float32)
+
+        def stage(p, h):
+            def body(hc, w):
+                return jnp.tanh(jnp.matmul(hc, w)), None
+            h, _ = jax.lax.scan(body, h, p["w"])
+            return h
+
+        def loss_pipe(ws, x):
+            y = pipeline_spmd(stage, {"w": ws}, x, mesh=mesh, axis="pp",
+                              num_microbatches=2)
+            return jnp.sum(y ** 2)
+
+        def loss_seq(ws, x):
+            h = x
+            for i in range(2):
+                h = jnp.tanh(jnp.matmul(h, ws[i]))
+            return jnp.sum(h ** 2)
+
+        gp = jax.grad(loss_pipe)(ws, x)
+        gs = jax.grad(loss_seq)(ws, x)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_remat_matches(self):
+        mesh = pp_mesh(2)
+        rng = np.random.RandomState(3)
+        ws = jnp.asarray(rng.randn(2, 4, 4) * 0.3, jnp.float32)
+        x = jnp.asarray(rng.randn(4, 4), jnp.float32)
+
+        def stage(p, h):
+            def body(hc, w):
+                return jnp.tanh(jnp.matmul(hc, w)), None
+            h, _ = jax.lax.scan(body, h, p["w"])
+            return h
+
+        def loss(ws, remat):
+            y = pipeline_spmd(stage, {"w": ws}, x, mesh=mesh, axis="pp",
+                              num_microbatches=2, remat=remat)
+            return jnp.sum(y ** 2)
+
+        np.testing.assert_allclose(float(loss(ws, False)),
+                                   float(loss(ws, True)), rtol=1e-6)
+        gp = jax.grad(lambda w: loss(w, False))(ws)
+        gr = jax.grad(lambda w: loss(w, True))(ws)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_batch_not_divisible_raises(self):
+        mesh = pp_mesh(2)
+        params = {"w": jnp.zeros((2, 4, 4))}
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_spmd(lambda p, h: h, params, jnp.zeros((5, 4)),
+                          mesh=mesh, axis="pp", num_microbatches=2)
+
+
+class TestLlamaPipe:
+    def _data(self, batch=4, seq=12):
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (batch, seq + 1)).astype(np.int64)
+        return (paddle.to_tensor(ids[:, :-1]),
+                paddle.to_tensor(ids[:, 1:]))
+
+    def test_forward_matches_dense(self):
+        paddle.seed(11)
+        cfg = tiny_llama_config(num_hidden_layers=4)
+        dense = LlamaForCausalLM(cfg)
+        mesh = pp_mesh(4)
+        pipe = LlamaForCausalLMPipe.from_dense(dense, mesh,
+                                               num_microbatches=2)
+        ids, labels = self._data()
+        ld, _ = dense(ids, labels)
+        lp, _ = pipe(ids, labels)
+        np.testing.assert_allclose(float(ld), float(lp), rtol=1e-5)
+
+    def test_training_matches_dense(self):
+        # the reference's dist-vs-single loss-curve bar, for pp
+        paddle.seed(12)
+        cfg = tiny_llama_config(num_hidden_layers=4)
+        dense = LlamaForCausalLM(cfg)
+        mesh = pp_mesh(4)
+        pipe = LlamaForCausalLMPipe.from_dense(dense, mesh,
+                                               num_microbatches=2)
+        ids, labels = self._data()
+
+        def train(m):
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=m.parameters())
+            losses = []
+            for _ in range(3):
+                loss, _ = m(ids, labels)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            return losses
+
+        ld = train(dense)
+        lp = train(pipe)
+        np.testing.assert_allclose(ld, lp, rtol=1e-4, atol=1e-5)
+        assert lp[-1] < lp[0]
+
+    def test_grads_match_dense_per_layer(self):
+        paddle.seed(13)
+        cfg = tiny_llama_config(num_hidden_layers=2)
+        dense = LlamaForCausalLM(cfg)
+        mesh = pp_mesh(2)
+        pipe = LlamaForCausalLMPipe.from_dense(dense, mesh,
+                                               num_microbatches=2)
+        ids, labels = self._data(batch=2, seq=8)
+        ld, _ = dense(ids, labels)
+        ld.backward()
+        lp, _ = pipe(ids, labels)
+        lp.backward()
+        for l in range(2):
+            gd = dense.model.layers[l].self_attn.q_proj.weight.grad.numpy()
+            gp = pipe.wq.grad.numpy()[l]
+            np.testing.assert_allclose(gp, gd, rtol=2e-4, atol=1e-5)
+
+    def test_stacked_params_sharded_on_pp(self):
+        paddle.seed(14)
+        cfg = tiny_llama_config(num_hidden_layers=4)
+        mesh = pp_mesh(4)
+        pipe = LlamaForCausalLMPipe(cfg, mesh)
+        assert pipe.wq.is_dist
+        assert pipe.wq._data.sharding.spec[0] == "pp"
+
+    def test_to_static_pipe_step(self):
+        paddle.seed(15)
+        cfg = tiny_llama_config(num_hidden_layers=2)
+        mesh = pp_mesh(2)
+        pipe = LlamaForCausalLMPipe(cfg, mesh, num_microbatches=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=pipe.parameters())
+        ids, labels = self._data()
+
+        def step(ids, labels):
+            loss, _ = pipe(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        compiled = paddle.jit.to_static(step, state=[pipe, opt])
+        losses = [float(compiled(ids, labels)) for _ in range(4)]
+        assert losses[-1] < losses[0]
+        assert all(np.isfinite(losses))
