@@ -27,6 +27,8 @@ from .mp_layers import (  # noqa: F401
 )
 from . import p2p  # noqa: F401
 from . import pipeline  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .pipeline import pipeline_spmd  # noqa: F401
 from . import collective  # noqa: F401
 

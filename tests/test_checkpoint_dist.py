@@ -1,0 +1,243 @@
+"""FSDP numeric verification + distributed checkpoint with resharding.
+
+Reference bars: `group_sharded_stage3.py` (ZeRO-3 training must match
+dense), `distributed/checkpoint/save_state_dict.py:104` +
+`load_state_dict.py:247` (save on one mesh, load onto another,
+bitwise-equal state).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import (ProcessMesh, Shard, Replicate,
+                                    shard_tensor, save_state_dict,
+                                    load_state_dict, unshard_dtensor,
+                                    shard_optimizer)
+from paddle_tpu.models import (LlamaForCausalLM, tiny_llama_config,
+                               shard_llama)
+
+import jax.numpy as jnp
+
+
+def llama_data(batch=4, seq=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, 128, (batch, seq + 1)).astype(np.int64)
+    return paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
+
+
+class TestFSDPTraining:
+    """The round-3 gap: fsdp placements existed but were never trained."""
+
+    def _train(self, mode):
+        paddle.seed(31)
+        cfg = tiny_llama_config(num_hidden_layers=2)
+        m = LlamaForCausalLM(cfg)
+        if mode == "fsdp":
+            mesh = ProcessMesh(np.arange(8), dim_names=["fsdp"])
+            shard_llama(m, mesh, tp_axis=None, fsdp_axis="fsdp")
+        elif mode == "tp_fsdp":
+            mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                               dim_names=["fsdp", "mp"])
+            shard_llama(m, mesh, tp_axis="mp", fsdp_axis="fsdp")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        ids, labels = llama_data()
+        losses = []
+        for _ in range(4):
+            loss, _ = m(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return m, opt, losses
+
+    def test_fsdp_training_matches_dense(self):
+        _, _, dense = self._train("none")
+        _, _, fsdp = self._train("fsdp")
+        np.testing.assert_allclose(dense, fsdp, rtol=1e-4, atol=1e-5)
+        assert fsdp[-1] < fsdp[0]
+
+    def test_tp_fsdp_training_matches_dense(self):
+        _, _, dense = self._train("none")
+        _, _, both = self._train("tp_fsdp")
+        np.testing.assert_allclose(dense, both, rtol=1e-4, atol=1e-5)
+
+    def test_fsdp_optimizer_state_inherits_sharding(self):
+        m, opt, _ = self._train("fsdp")
+        w = m.model.layers[0].self_attn.q_proj.weight
+        mom = opt._accumulators["moment1"][id(w)]
+        assert mom._data.sharding.is_equivalent_to(w._data.sharding,
+                                                   w._data.ndim)
+
+
+class TestShardOptimizerHook:
+    def test_custom_shard_fn_overrides_accumulator(self):
+        paddle.seed(5)
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        lin = paddle.nn.Linear(16, 8)
+        lin.weight = shard_tensor(lin.weight, mesh, [Shard(0)])
+        opt = paddle.optimizer.AdamW(learning_rate=0.1,
+                                     parameters=lin.parameters())
+        calls = []
+
+        def shard_fn(name, param, acc):
+            calls.append(name)
+            if name == "moment1" and param is lin.weight:
+                return shard_tensor(acc, mesh, [Shard(1)])
+            return None
+
+        shard_optimizer(opt, shard_fn)
+        x = paddle.to_tensor(np.random.randn(4, 16).astype("float32"))
+        loss = (lin(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        assert "moment1" in calls
+        m1 = opt._accumulators["moment1"][id(lin.weight)]
+        assert m1._data.sharding.spec[1] == "x"   # the override applied
+        m2 = opt._accumulators["moment2"][id(lin.weight)]
+        assert m2._data.sharding.spec[0] == "x"   # default inheritance
+
+
+class TestDistributedCheckpoint:
+    def test_save_load_same_mesh(self, tmp_path):
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                           dim_names=["dp", "mp"])
+        w = shard_tensor(
+            paddle.to_tensor(np.arange(64, dtype=np.float32).reshape(8, 8)),
+            mesh, [Shard(0), Shard(1)])
+        save_state_dict({"w": w}, str(tmp_path))
+        w2 = shard_tensor(paddle.to_tensor(np.zeros((8, 8), np.float32)),
+                          mesh, [Shard(0), Shard(1)])
+        load_state_dict({"w": w2}, str(tmp_path))
+        np.testing.assert_array_equal(w2.numpy(), w.numpy())
+
+    def test_reshard_on_load_2x4_to_1x8(self, tmp_path):
+        # the reference's headline capability: save on one mesh, load onto
+        # a DIFFERENT mesh with different placements, bitwise equal
+        mesh_a = ProcessMesh(np.arange(8).reshape(2, 4),
+                             dim_names=["dp", "mp"])
+        src = shard_tensor(
+            paddle.to_tensor(np.random.RandomState(0)
+                             .randn(16, 8).astype(np.float32)),
+            mesh_a, [Shard(0), Shard(1)])
+        save_state_dict({"w": src}, str(tmp_path))
+
+        mesh_b = ProcessMesh(np.arange(8), dim_names=["x"])
+        dst = shard_tensor(paddle.to_tensor(np.zeros((16, 8), np.float32)),
+                           mesh_b, [Shard(1)])
+        load_state_dict({"w": dst}, str(tmp_path))
+        np.testing.assert_array_equal(dst.numpy(), src.numpy())
+        assert dst._data.sharding.spec[1] == "x"  # placement preserved
+
+    def test_bf16_roundtrip(self, tmp_path):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        src = shard_tensor(
+            paddle.to_tensor(np.random.RandomState(1).randn(8, 4)
+                             .astype(np.float32)).astype("bfloat16"),
+            mesh, [Shard(0)])
+        save_state_dict({"w": src}, str(tmp_path))
+        dst = shard_tensor(
+            paddle.to_tensor(np.zeros((8, 4), np.float32))
+            .astype("bfloat16"), mesh, [Shard(0)])
+        load_state_dict({"w": dst}, str(tmp_path))
+        np.testing.assert_array_equal(
+            dst.numpy().view(np.uint16), src.numpy().view(np.uint16))
+
+    def test_model_state_dict_reshard_roundtrip(self, tmp_path):
+        # whole-model: save a tp-sharded llama, load into an fsdp-sharded
+        # one; losses must be identical
+        ids, labels = llama_data()
+
+        paddle.seed(41)
+        cfg = tiny_llama_config(num_hidden_layers=1)
+        src_model = LlamaForCausalLM(cfg)
+        mesh_a = ProcessMesh(np.arange(8).reshape(2, 4),
+                             dim_names=["dp", "mp"])
+        shard_llama(src_model, mesh_a, tp_axis="mp")
+        save_state_dict(src_model.state_dict(), str(tmp_path))
+        src_loss = float(src_model(ids, labels)[0])
+
+        paddle.seed(99)  # different init — must be fully overwritten
+        dst_model = LlamaForCausalLM(cfg)
+        mesh_b = ProcessMesh(np.arange(8), dim_names=["fsdp"])
+        shard_llama(dst_model, mesh_b, tp_axis=None, fsdp_axis="fsdp")
+        load_state_dict(dst_model.state_dict(), str(tmp_path))
+        dst_loss = float(dst_model(ids, labels)[0])
+        np.testing.assert_allclose(src_loss, dst_loss, rtol=1e-6)
+
+    def test_missing_tensor_raises(self, tmp_path):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        w = shard_tensor(paddle.to_tensor(np.ones((8, 2), np.float32)),
+                         mesh, [Shard(0)])
+        save_state_dict({"w": w}, str(tmp_path))
+        other = shard_tensor(paddle.to_tensor(np.ones((8, 2), np.float32)),
+                             mesh, [Shard(0)])
+        with pytest.raises(KeyError, match="missing"):
+            load_state_dict({"nope": other}, str(tmp_path))
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        w = shard_tensor(paddle.to_tensor(np.ones((8, 2), np.float32)),
+                         mesh, [Shard(0)])
+        save_state_dict({"w": w}, str(tmp_path))
+        bad = shard_tensor(paddle.to_tensor(np.ones((8, 4), np.float32)),
+                           mesh, [Shard(0)])
+        with pytest.raises(ValueError, match="shape"):
+            load_state_dict({"w": bad}, str(tmp_path))
+
+    def test_plain_tensor_checkpoint(self, tmp_path):
+        # non-dist tensors go through the same path
+        t = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        save_state_dict({"t": t}, str(tmp_path))
+        dst = paddle.to_tensor(np.zeros((4, 4), np.float32))
+        load_state_dict({"t": dst}, str(tmp_path))
+        np.testing.assert_array_equal(dst.numpy(), t.numpy())
+
+
+    def test_object_values_roundtrip(self, tmp_path):
+        # non-Tensor values (floats, np scalars/arrays) survive save/load
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        w = shard_tensor(paddle.to_tensor(np.ones((8, 2), np.float32)),
+                         mesh, [Shard(0)])
+        state = {"w": w, "step": 7, "lr": np.float32(0.5),
+                 "hist": np.arange(3)}
+        save_state_dict(state, str(tmp_path))
+        w2 = shard_tensor(paddle.to_tensor(np.zeros((8, 2), np.float32)),
+                          mesh, [Shard(0)])
+        target = {"w": w2, "step": 0, "lr": 0.0, "hist": None}
+        load_state_dict(target, str(tmp_path))
+        assert target["step"] == 7
+        assert float(target["lr"]) == 0.5
+        np.testing.assert_array_equal(target["hist"], np.arange(3))
+
+    def test_merge_multi_process_metadata(self, tmp_path):
+        # simulate a 2-host save: each "process" writes only half the
+        # shards; load must merge both metadata slices
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        full = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        w = shard_tensor(paddle.to_tensor(full), mesh, [Shard(0)])
+        save_state_dict({"w": w}, str(tmp_path), process_index=0)
+        # strip half the shards from p0's files and save them as p1's
+        import json as J, os
+        meta = J.load(open(os.path.join(str(tmp_path), "metadata_p0.json")))
+        shards = meta["tensors"]["w"]["shards"]
+        first, second = shards[:4], shards[4:]
+        data = np.load(os.path.join(str(tmp_path), "shards_p0.npz"))
+        d0 = {s["array"]: data[s["array"]] for s in first}
+        d1 = {s["array"]: data[s["array"]] for s in second}
+        for s in second:
+            s["file"] = "shards_p1.npz"
+        meta0 = {"tensors": {"w": {**meta["tensors"]["w"], "shards": first}}}
+        meta1 = {"tensors": {"w": {**meta["tensors"]["w"], "shards": second}}}
+        J.dump(meta0, open(os.path.join(str(tmp_path), "metadata_p0.json"), "w"))
+        J.dump(meta1, open(os.path.join(str(tmp_path), "metadata_p1.json"), "w"))
+        np.savez(os.path.join(str(tmp_path), "shards_p0.npz"), **d0)
+        np.savez(os.path.join(str(tmp_path), "shards_p1.npz"), **d1)
+
+        dst = shard_tensor(paddle.to_tensor(np.zeros((8, 4), np.float32)),
+                           mesh, [Shard(0)])
+        load_state_dict({"w": dst}, str(tmp_path))
+        np.testing.assert_array_equal(dst.numpy(), full)
