@@ -1,28 +1,28 @@
-"""MNIST LeNet end-to-end training (SURVEY.md §7 milestone 2).
+"""MNIST LeNet end-to-end training via the hapi high-level API.
 
-Exercises the full stack: vision dataset -> DataLoader -> nn.Layer model ->
-CrossEntropyLoss -> AdamW -> jit.to_static compiled train step -> eval.
+Exercises the full stack: vision dataset -> DataLoader -> nn.Layer ->
+`paddle.Model.fit` (compiled train step through jit.to_static) with a
+streaming `paddle.metric.Accuracy` and callback-reported progress —
+the reference's `hapi/model.py:1750` usage shape.
 
 Run:  python examples/mnist_lenet.py [--epochs 5] [--eager]
 CPU:  env -u PYTHONPATH JAX_PLATFORMS=cpu python examples/mnist_lenet.py
 """
 
 import argparse
-import sys
-import time
 import os
+import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-
-import numpy as np  # noqa: E402
 
 import paddle_tpu as paddle  # noqa: E402
 import paddle_tpu.nn as nn  # noqa: E402
 import paddle_tpu.optimizer as optim  # noqa: E402
-import paddle_tpu.jit as jit  # noqa: E402
+from paddle_tpu.hapi import Model  # noqa: E402
 from paddle_tpu.io import DataLoader  # noqa: E402
-from paddle_tpu.vision.datasets import MNIST  # noqa: E402
+from paddle_tpu.metric import Accuracy  # noqa: E402
 from paddle_tpu.vision import transforms as T  # noqa: E402
+from paddle_tpu.vision.datasets import MNIST  # noqa: E402
 from paddle_tpu.vision.models import LeNet  # noqa: E402
 
 
@@ -34,62 +34,37 @@ def main():
     ap.add_argument("--eager", action="store_true",
                     help="skip jit compilation (debug mode)")
     ap.add_argument("--n-per-class", type=int, default=600)
+    ap.add_argument("--save-dir", default=None)
     args = ap.parse_args()
 
     paddle.seed(0)
     tf = T.Compose([T.ToTensor(), T.Normalize(0.5, 0.5)])
-    train_ds = MNIST(mode="train", transform=tf, n_per_class=args.n_per_class)
+    train_ds = MNIST(mode="train", transform=tf,
+                     n_per_class=args.n_per_class)
     test_ds = MNIST(mode="test", transform=tf,
                     n_per_class=max(args.n_per_class // 6, 50))
-    train_dl = DataLoader(train_ds, batch_size=args.batch_size, shuffle=True,
-                          drop_last=True, num_workers=2)
+    train_dl = DataLoader(train_ds, batch_size=args.batch_size,
+                          shuffle=True, drop_last=True, num_workers=2)
     test_dl = DataLoader(test_ds, batch_size=256)
     print(f"train={len(train_ds)} test={len(test_ds)} "
           f"synthetic={train_ds.synthetic}")
 
-    model = LeNet(num_classes=10)
+    net = LeNet(num_classes=10)
     sched = optim.lr.CosineAnnealingDecay(args.lr, T_max=args.epochs)
-    opt = optim.AdamW(learning_rate=sched, parameters=model.parameters(),
-                      weight_decay=1e-4)
-    loss_fn = nn.CrossEntropyLoss()
+    model = Model(net)
+    model.prepare(
+        optimizer=optim.AdamW(learning_rate=sched,
+                              parameters=net.parameters(),
+                              weight_decay=1e-4),
+        loss=nn.CrossEntropyLoss(),
+        metrics=[Accuracy()],
+        jit=not args.eager)
+    model.summary()
 
-    def train_step(x, y):
-        logits = model(x)
-        loss = loss_fn(logits, y)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
+    model.fit(train_dl, eval_data=test_dl, epochs=args.epochs,
+              log_freq=20, verbose=2, save_dir=args.save_dir)
 
-    if not args.eager:
-        train_step = jit.to_static(train_step, state=[model, opt])
-
-    def evaluate():
-        model.eval()
-        correct = total = 0
-        with paddle.no_grad():
-            for img, lab in test_dl:
-                logits = model(paddle.to_tensor(img))
-                pred = logits.numpy().argmax(axis=1)
-                correct += int((pred == lab).sum())
-                total += len(lab)
-        model.train()
-        return correct / total
-
-    for epoch in range(args.epochs):
-        t0 = time.time()
-        losses = []
-        for img, lab in train_dl:
-            loss = train_step(paddle.to_tensor(img), paddle.to_tensor(lab))
-            losses.append(loss)
-        sched.step()
-        acc = evaluate()
-        dt = time.time() - t0
-        ips = len(train_ds) / dt
-        print(f"epoch {epoch}: loss={float(losses[-1]):.4f} "
-              f"test_acc={acc * 100:.2f}% ({dt:.1f}s, {ips:.0f} img/s)")
-
-    final = evaluate()
+    final = model.evaluate(test_dl, verbose=0)["acc"]
     print(f"FINAL test accuracy: {final * 100:.2f}%")
     assert final > 0.97, f"convergence gate failed: {final}"
     print("MNIST milestone PASSED (>97%)")

@@ -41,6 +41,9 @@ from . import distributed  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import device  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from .hapi import Model  # noqa: F401,E402
 from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402
 

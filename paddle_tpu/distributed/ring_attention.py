@@ -129,10 +129,9 @@ def ring_attention(q, k, v, mesh, axis="sep", causal=True, scale=None):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_ulysses(jmesh, axis, causal, scale):
+def _build_ulysses(jmesh, axis, causal, scale, use_flash):
     from ..nn.functional.attention import _naive_attention
     from ..ops import flash_attention as FA
-    from .. import flags
 
     def per_device(q, k, v):
         # [B, S/P, H, D] local -> all-to-all -> [B, S, H/P, D] local
@@ -142,8 +141,7 @@ def _build_ulysses(jmesh, axis, causal, scale):
                                 tiled=True)
         v2 = jax.lax.all_to_all(v, axis, split_axis=2, concat_axis=1,
                                 tiled=True)
-        if flags.flag("use_pallas_kernels") \
-                and FA.supported(q2, k2, v2, None, causal):
+        if use_flash and FA.supported(q2, k2, v2, None, causal):
             h, hk = q2.shape[2], k2.shape[2]
             out = FA._make_flash(scale, causal, h // hk)(q2, k2, v2)
         else:
@@ -173,5 +171,7 @@ def ulysses_attention(q, k, v, mesh, axis="sep", causal=True, scale=None):
             f"kv heads {ks[2]} not divisible by sep axis size {P}")
     d = ks[-1]
     s = float(scale) if scale is not None else 1.0 / math.sqrt(d)
-    fn = _build_ulysses(jmesh, axis, bool(causal), s)
+    from .. import flags
+    fn = _build_ulysses(jmesh, axis, bool(causal), s,
+                        bool(flags.flag("use_pallas_kernels")))
     return run_op("ulysses_attention", fn, (q, k, v))

@@ -50,8 +50,17 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
-        rng = np.random.default_rng(
-            self.generator if isinstance(self.generator, int) else None)
+        if isinstance(self.generator, int):
+            seed = self.generator
+        else:
+            # derive from the framework generator so paddle.seed() governs
+            # shuffle order (the reference shuffles from the global
+            # generator; OS entropy here would make runs unreproducible)
+            import jax
+            from ..framework import random as frandom
+            seed = int(jax.random.randint(frandom.next_key(), (), 0,
+                                          2 ** 31 - 1))
+        rng = np.random.default_rng(seed)
         if self.replacement:
             yield from rng.integers(0, n, size=self.num_samples).tolist()
         else:

@@ -13,6 +13,7 @@ from .layer.activation import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.container import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
 )

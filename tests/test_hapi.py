@@ -1,0 +1,127 @@
+"""hapi Model.fit/evaluate/predict + paddle.metric.
+
+Reference bar: `python/paddle/hapi/model.py:1052,1750,1999` — fit drives
+train/eval with callbacks and streaming metrics.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import (Model, EarlyStopping, History, ModelCheckpoint)
+from paddle_tpu.metric import Accuracy, Precision, Recall, Auc
+from paddle_tpu.io import Dataset
+
+
+class ToyData(Dataset):
+    """Linearly separable 2-class problem."""
+
+    def __init__(self, n=128, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 4).astype("float32")
+        w = np.asarray([1.0, -2.0, 0.5, 1.5], "float32")
+        self.y = (self.x @ w > 0).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def make_model(jit=True):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.AdamW(learning_rate=0.03,
+                                         parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=[Accuracy()], jit=jit)
+    return model
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.asarray([[0.1, 0.7, 0.2], [0.6, 0.3, 0.1]])
+        label = np.asarray([1, 2])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert top1 == 0.5   # second sample wrong at top1
+        assert top2 == 0.5   # label 2 not in top-2 of second sample
+        assert m.name() == ["acc_top1", "acc_top2"]
+
+    def test_accuracy_streaming(self):
+        m = Accuracy()
+        m.update(m.compute(np.asarray([[0.9, 0.1]]), np.asarray([0])))
+        m.update(m.compute(np.asarray([[0.9, 0.1]]), np.asarray([1])))
+        assert m.accumulate() == 0.5
+        m.reset()
+        assert m.accumulate() == 0.0
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.asarray([0.9, 0.8, 0.2, 0.7])
+        labels = np.asarray([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == pytest.approx(2 / 3)
+        assert r.accumulate() == pytest.approx(2 / 3)
+
+    def test_auc_perfect_separation(self):
+        a = Auc()
+        a.update(np.asarray([0.9, 0.8, 0.1, 0.2]),
+                 np.asarray([1, 1, 0, 0]))
+        assert a.accumulate() == pytest.approx(1.0, abs=1e-3)
+
+
+class TestModelFit:
+    @pytest.mark.parametrize("jit", [True, False])
+    def test_fit_improves_accuracy(self, jit):
+        model = make_model(jit)
+        hist = model.fit(ToyData(), epochs=10, batch_size=32, verbose=0)
+        assert len(hist.history) == 10
+        assert hist.history[-1]["acc"] > 0.8
+        assert hist.history[-1]["loss"] < hist.history[0]["loss"]
+
+    def test_fit_with_eval_data(self):
+        model = make_model()
+        hist = model.fit(ToyData(), eval_data=ToyData(seed=1), epochs=2,
+                         batch_size=32, verbose=0)
+        assert "eval_acc" in hist.history[-1]
+        assert hist.history[-1]["eval_acc"] > 0.7
+
+    def test_evaluate_and_predict(self):
+        model = make_model()
+        model.fit(ToyData(), epochs=3, batch_size=32, verbose=0)
+        logs = model.evaluate(ToyData(seed=2), batch_size=32, verbose=0)
+        assert logs["acc"] > 0.7 and "loss" in logs
+        preds = model.predict(ToyData(seed=2), batch_size=32)
+        assert preds[0].shape == (128, 2)
+
+    def test_early_stopping(self):
+        model = make_model()
+        es = EarlyStopping(monitor="loss", patience=0, min_delta=10.0)
+        hist = model.fit(ToyData(), epochs=10, batch_size=32, verbose=0,
+                         callbacks=[es])
+        # min_delta=10 means "never improves": stops after patience+1+1
+        assert len(hist.history) < 10
+
+    def test_checkpoint_and_load(self, tmp_path):
+        model = make_model()
+        model.fit(ToyData(), epochs=1, batch_size=32, verbose=0,
+                  save_dir=str(tmp_path))
+        import os
+        assert os.path.exists(str(tmp_path / "final.pdparams"))
+        model2 = make_model()
+        model2.load(str(tmp_path / "final"))
+        a = model.predict(ToyData(seed=3), batch_size=64)[0]
+        b = model2.predict(ToyData(seed=3), batch_size=64)[0]
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_summary(self, capsys):
+        model = make_model()
+        info = model.summary()
+        assert info["total_params"] == 4 * 16 + 16 + 16 * 2 + 2

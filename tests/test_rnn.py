@@ -1,0 +1,269 @@
+"""RNN family: SimpleRNN/LSTM/GRU cells + scanned multi-layer networks.
+
+Reference bar: `python/paddle/nn/layer/rnn.py` — NumPy-parity forward and
+numeric-gradient backward (the tests/test_ops.py style).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm(x, h, c, wi, wh, bi, bh):
+    T = x.shape[1]
+    ys = []
+    for t in range(T):
+        z = x[:, t] @ wi.T + h @ wh.T + bi + bh
+        i, f, g, o = np.split(z, 4, axis=-1)
+        i, f, o = sigmoid(i), sigmoid(f), sigmoid(o)
+        g = np.tanh(g)
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        ys.append(h)
+    return np.stack(ys, 1), h, c
+
+
+def np_gru(x, h, wi, wh, bi, bh):
+    T = x.shape[1]
+    ys = []
+    for t in range(T):
+        gi = x[:, t] @ wi.T + bi
+        gh = h @ wh.T + bh
+        ri, zi, ci = np.split(gi, 3, -1)
+        rh, zh, ch = np.split(gh, 3, -1)
+        r, z = sigmoid(ri + rh), sigmoid(zi + zh)
+        cand = np.tanh(ci + r * ch)
+        h = (1 - z) * cand + z * h
+        ys.append(h)
+    return np.stack(ys, 1), h
+
+
+def np_simple(x, h, wi, wh, bi, bh):
+    T = x.shape[1]
+    ys = []
+    for t in range(T):
+        h = np.tanh(x[:, t] @ wi.T + h @ wh.T + bi + bh)
+        ys.append(h)
+    return np.stack(ys, 1), h
+
+
+def data(b=3, t=5, i=4, seed=0):
+    return np.random.RandomState(seed).randn(b, t, i).astype("float32")
+
+
+class TestForwardParity:
+    def test_lstm_matches_numpy(self):
+        paddle.seed(0)
+        m = nn.LSTM(4, 6)
+        x = data()
+        out, (h, c) = m(paddle.to_tensor(x))
+        cell = m.cells[0]
+        ref_out, ref_h, ref_c = np_lstm(
+            x, np.zeros((3, 6), "float32"), np.zeros((3, 6), "float32"),
+            cell.weight_ih.numpy(), cell.weight_hh.numpy(),
+            cell.bias_ih.numpy(), cell.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(h.numpy()[0], ref_h, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(c.numpy()[0], ref_c, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_gru_matches_numpy(self):
+        paddle.seed(1)
+        m = nn.GRU(4, 6)
+        x = data(seed=1)
+        out, h = m(paddle.to_tensor(x))
+        cell = m.cells[0]
+        ref_out, ref_h = np_gru(
+            x, np.zeros((3, 6), "float32"),
+            cell.weight_ih.numpy(), cell.weight_hh.numpy(),
+            cell.bias_ih.numpy(), cell.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(h.numpy()[0], ref_h, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_simple_rnn_matches_numpy(self):
+        paddle.seed(2)
+        m = nn.SimpleRNN(4, 6)
+        x = data(seed=2)
+        out, h = m(paddle.to_tensor(x))
+        cell = m.cells[0]
+        ref_out, ref_h = np_simple(
+            x, np.zeros((3, 6), "float32"),
+            cell.weight_ih.numpy(), cell.weight_hh.numpy(),
+            cell.bias_ih.numpy(), cell.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_two_layer_stacks(self):
+        paddle.seed(3)
+        m = nn.LSTM(4, 6, num_layers=2)
+        x = data(seed=3)
+        out, (h, c) = m(paddle.to_tensor(x))
+        assert out.shape == [3, 5, 6]
+        assert h.shape == [2, 3, 6] and c.shape == [2, 3, 6]
+        # layer 1's input is layer 0's output
+        c0 = m.cells[0]
+        o0, _, _ = np_lstm(x, np.zeros((3, 6), "float32"),
+                           np.zeros((3, 6), "float32"),
+                           c0.weight_ih.numpy(), c0.weight_hh.numpy(),
+                           c0.bias_ih.numpy(), c0.bias_hh.numpy())
+        c1 = m.cells[1]
+        o1, _, _ = np_lstm(o0, np.zeros((3, 6), "float32"),
+                           np.zeros((3, 6), "float32"),
+                           c1.weight_ih.numpy(), c1.weight_hh.numpy(),
+                           c1.bias_ih.numpy(), c1.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy(), o1, rtol=1e-5, atol=1e-6)
+
+    def test_bidirect_concat(self):
+        paddle.seed(4)
+        m = nn.GRU(4, 6, direction="bidirect")
+        x = data(seed=4)
+        out, h = m(paddle.to_tensor(x))
+        assert out.shape == [3, 5, 12]
+        assert h.shape == [2, 3, 6]
+        # backward direction == forward run on time-reversed input
+        cell = m.cells[1]
+        ref_rev, ref_h = np_gru(
+            x[:, ::-1], np.zeros((3, 6), "float32"),
+            cell.weight_ih.numpy(), cell.weight_hh.numpy(),
+            cell.bias_ih.numpy(), cell.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy()[:, :, 6:],
+                                   ref_rev[:, ::-1], rtol=1e-5, atol=1e-6)
+
+    def test_time_major(self):
+        paddle.seed(5)
+        m = nn.LSTM(4, 6, time_major=True)
+        x = data(seed=5)
+        out_tm, _ = m(paddle.to_tensor(np.swapaxes(x, 0, 1)))
+        m2 = nn.LSTM(4, 6)
+        for p2, p in zip(m2.parameters(), m.parameters()):
+            p2.set_value(p.numpy())
+        out_bm, _ = m2(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.swapaxes(out_tm.numpy(), 0, 1),
+                                   out_bm.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_sequence_length_freezes_states(self):
+        paddle.seed(6)
+        m = nn.GRU(4, 6)
+        x = data(b=2, t=5, seed=6)
+        seq = paddle.to_tensor(np.asarray([3, 5], "int64"))
+        out, h = m(paddle.to_tensor(x), sequence_length=seq)
+        cell = m.cells[0]
+        ref_out, _ = np_gru(x, np.zeros((2, 6), "float32"),
+                            cell.weight_ih.numpy(), cell.weight_hh.numpy(),
+                            cell.bias_ih.numpy(), cell.bias_hh.numpy())
+        # sample 0: outputs after t=3 equal the t=2 state (frozen)
+        np.testing.assert_allclose(out.numpy()[0, 3], ref_out[0, 2],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(h.numpy()[0, 0], ref_out[0, 2],
+                                   rtol=1e-5, atol=1e-6)
+        # sample 1 runs the full length
+        np.testing.assert_allclose(out.numpy()[1], ref_out[1], rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestCells:
+    def test_lstm_cell_single_step(self):
+        paddle.seed(7)
+        cell = nn.LSTMCell(4, 6)
+        x = paddle.to_tensor(np.random.RandomState(7)
+                             .randn(3, 4).astype("float32"))
+        y, (h, c) = cell(x)
+        ref, rh, rc = np_lstm(x.numpy()[:, None],
+                              np.zeros((3, 6), "float32"),
+                              np.zeros((3, 6), "float32"),
+                              cell.weight_ih.numpy(),
+                              cell.weight_hh.numpy(),
+                              cell.bias_ih.numpy(), cell.bias_hh.numpy())
+        np.testing.assert_allclose(y.numpy(), rh, rtol=1e-5, atol=1e-6)
+
+    def test_rnn_wrapper_matches_network(self):
+        paddle.seed(8)
+        cell = nn.GRUCell(4, 6)
+        rnn = nn.RNN(cell)
+        x = data(seed=8)
+        out, h = rnn(paddle.to_tensor(x))
+        ref_out, ref_h = np_gru(x, np.zeros((3, 6), "float32"),
+                                cell.weight_ih.numpy(),
+                                cell.weight_hh.numpy(),
+                                cell.bias_ih.numpy(),
+                                cell.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestGradients:
+    @pytest.mark.parametrize("cls", [nn.SimpleRNN, nn.GRU, nn.LSTM])
+    def test_numeric_gradient_weight_ih(self, cls):
+        paddle.seed(9)
+        m = cls(3, 4)
+        x = data(b=2, t=3, i=3, seed=9)
+
+        def loss_np(w):
+            cell = m.cells[0]
+            wi = w
+            wh = cell.weight_hh.numpy()
+            bi = cell.bias_ih.numpy()
+            bh = cell.bias_hh.numpy()
+            if cls is nn.LSTM:
+                out, _, _ = np_lstm(x, np.zeros((2, 4), "float32"),
+                                    np.zeros((2, 4), "float32"),
+                                    wi, wh, bi, bh)
+            elif cls is nn.GRU:
+                out, _ = np_gru(x, np.zeros((2, 4), "float32"),
+                                wi, wh, bi, bh)
+            else:
+                out, _ = np_simple(x, np.zeros((2, 4), "float32"),
+                                   wi, wh, bi, bh)
+            return float((out ** 2).sum())
+
+        out, _ = m(paddle.to_tensor(x))
+        (out ** 2).sum().backward()
+        g = m.cells[0].weight_ih.grad.numpy()
+
+        w0 = m.cells[0].weight_ih.numpy().astype("float64")
+        eps = 1e-4
+        # spot-check a handful of coordinates with central differences
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            r = rng.randint(w0.shape[0])
+            c = rng.randint(w0.shape[1])
+            wp, wm = w0.copy(), w0.copy()
+            wp[r, c] += eps
+            wm[r, c] -= eps
+            num = (loss_np(wp.astype("float32"))
+                   - loss_np(wm.astype("float32"))) / (2 * eps)
+            np.testing.assert_allclose(g[r, c], num, rtol=2e-2, atol=1e-3)
+
+    def test_training_converges(self):
+        # tiny seq2one regression: LSTM must fit it
+        paddle.seed(10)
+        m = nn.LSTM(2, 8)
+        head = nn.Linear(8, 1)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.02,
+            parameters=list(m.parameters()) + list(head.parameters()))
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 6, 2).astype("float32")
+        y = x.sum(axis=(1, 2), keepdims=False)[:, None].astype("float32")
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        first = last = None
+        for i in range(80):
+            out, (h, c) = m(xt)
+            pred = head(out[:, -1])
+            loss = ((pred - yt) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = float(loss) if first is None else first
+            last = float(loss)
+        assert last < first * 0.25, (first, last)
