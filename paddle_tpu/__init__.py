@@ -42,6 +42,8 @@ from . import jit  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401,E402

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from . import dtype as dtypes
 from . import amp_state
+from . import enforce
 from .. import flags
 
 __all__ = ["Tensor", "Parameter", "GradNode", "is_grad_enabled", "set_grad_enabled",
@@ -155,7 +156,10 @@ def run_op(name, fn, args, kwargs=None, differentiable=True):
     if not diff_tensors:
         raw_args = substitute(spec_args, [])
         raw_kwargs = {k: substitute([v], [])[0] for k, v in spec_kwargs.items()}
-        out = fn(*raw_args, **raw_kwargs)
+        try:
+            out = fn(*raw_args, **raw_kwargs)
+        except Exception as e:
+            raise enforce.attach_op_context(e, name)
         return _wrap_outputs(name, out, stop_gradient=True)
 
     def pure(*diff_arrays):
@@ -164,7 +168,10 @@ def run_op(name, fn, args, kwargs=None, differentiable=True):
         return fn(*raw_args, **raw_kwargs)
 
     primal_arrays = [t._data for t in diff_tensors]
-    out, vjp_fn = jax.vjp(pure, *primal_arrays)
+    try:
+        out, vjp_fn = jax.vjp(pure, *primal_arrays)
+    except Exception as e:
+        raise enforce.attach_op_context(e, name)
 
     is_multi = isinstance(out, (tuple, list))
     outs = list(out) if is_multi else [out]

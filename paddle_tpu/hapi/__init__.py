@@ -127,10 +127,8 @@ class Model:
         loss, outputs = self._train_step(*inputs, *labels)
         logs = {"loss": float(loss)}
         for m in self._metrics:
-            m.update(*m.compute(
-                outputs if not isinstance(outputs, (list, tuple))
-                else outputs[0], *labels))
-            logs[_mname(m)] = m.accumulate()
+            _metric_update(m, outputs, labels)
+            logs.update(_metric_logs(m))
         return logs
 
     def eval_batch(self, inputs, labels=None):
@@ -145,10 +143,8 @@ class Model:
         if self._loss is not None and labels:
             logs["loss"] = float(self._compute_loss(outputs, labels))
         for m in self._metrics:
-            m.update(*m.compute(
-                outputs if not isinstance(outputs, (list, tuple))
-                else outputs[0], *labels))
-            logs[_mname(m)] = m.accumulate()
+            _metric_update(m, outputs, labels)
+            logs.update(_metric_logs(m))
         return logs
 
     def predict_batch(self, inputs):
@@ -189,7 +185,7 @@ class Model:
         cbks = config_callbacks(
             callbacks, self, epochs=epochs, steps=steps,
             log_freq=log_freq, verbose=verbose, save_freq=save_freq,
-            save_dir=save_dir, metrics=[_mname(m) for m in self._metrics])
+            save_dir=save_dir, metrics=_metric_names(self._metrics))
         self.stop_training = False
         cbks.on_train_begin()
         logs = {}
@@ -220,7 +216,7 @@ class Model:
         loader = self._loader(eval_data, batch_size, False,
                               num_workers=num_workers)
         cbks = config_callbacks(callbacks, self, verbose=verbose,
-                                metrics=[_mname(m) for m in self._metrics])
+                                metrics=_metric_names(self._metrics))
         for m in self._metrics:
             m.reset()
         cbks.on_eval_begin()
@@ -236,7 +232,7 @@ class Model:
         if losses:
             logs["loss"] = float(np.average(losses, weights=weights))
         for m in self._metrics:
-            logs[_mname(m)] = m.accumulate()
+            logs.update(_metric_logs(m))
         cbks.on_eval_end(logs)
         return logs
 
@@ -287,3 +283,32 @@ class Model:
 def _mname(m):
     n = m.name()
     return n if isinstance(n, str) else n[0]
+
+
+def _metric_names(metrics):
+    out = []
+    for m in metrics:
+        n = m.name()
+        out.extend([n] if isinstance(n, str) else list(n))
+    return out
+
+
+def _metric_update(m, outputs, labels):
+    """Feed one batch to a metric. compute() may return a single array or
+    a tuple — only a tuple is splatted into update() (star-unpacking a
+    bare [B, k] array would feed update one ROW per positional arg)."""
+    pred = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+    res = m.compute(pred, *labels)
+    if isinstance(res, tuple):
+        m.update(*res)
+    else:
+        m.update(res)
+
+
+def _metric_logs(m):
+    names = m.name()
+    vals = m.accumulate()
+    if isinstance(names, str):
+        return {names: vals}
+    return dict(zip(names, vals if isinstance(vals, (list, tuple))
+                    else [vals]))

@@ -174,6 +174,8 @@ class EarlyStopping(Callback):
         if cur is None:
             return
         cur = float(np.asarray(cur).reshape(-1)[0])
+        if self.best is None and self.baseline is not None:
+            self.best = float(self.baseline)  # must beat the baseline
         if self.best is None or self._better(cur, self.best):
             self.best = cur
             self.wait = 0

@@ -277,9 +277,27 @@ class RNN(Layer):
         if states is None:
             states = self.cell.get_initial_states(x[:, 0])
         ys = []
+        prev_y = None
         rng = range(T - 1, -1, -1) if self.is_reverse else range(T)
         for t in rng:
-            y, states = self.cell(x[:, t], states)
+            y, new_states = self.cell(x[:, t], states)
+            if sequence_length is not None:
+                # same freeze-past-length semantics as the scanned path
+                valid = (sequence_length > t).astype(y.dtype) \
+                    .reshape([-1, 1])
+
+                def mix(new, old):
+                    return new * valid + old * (1.0 - valid)
+
+                if isinstance(new_states, (tuple, list)):
+                    new_states = type(new_states)(
+                        mix(n, o) for n, o in zip(new_states, states))
+                else:
+                    new_states = mix(new_states, states)
+                if prev_y is not None:
+                    y = mix(y, prev_y)
+            states = new_states
+            prev_y = y
             ys.append(y)
         if self.is_reverse:
             ys = ys[::-1]

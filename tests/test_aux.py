@@ -1,0 +1,200 @@
+"""Auxiliary subsystems: recompute, weight/spectral norm, enforce,
+profiler bridge.
+
+Reference bars: `fleet/recompute/recompute.py` (checkpointed segment
+grads match plain grads), `nn/utils/weight_norm_hook.py`,
+`common/enforce.h` (typed errors with operator context),
+`profiler/profiler.py:346` (chrome-trace export).
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import recompute
+
+
+class TestRecompute:
+    def _block(self, seed):
+        paddle.seed(seed)
+        return nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 8))
+
+    def test_grads_match_plain(self):
+        m1 = self._block(3)
+        m2 = self._block(3)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 8).astype("float32"))
+        l1 = (m1(x) ** 2).mean()
+        l1.backward()
+        l2 = (recompute(m2, x) ** 2).mean()
+        l2.backward()
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(p1.grad.numpy(), p2.grad.numpy(),
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_input_grad_flows(self):
+        m = self._block(4)
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(4, 8).astype("float32"),
+                             stop_gradient=False)
+        out = recompute(m, x)
+        (out ** 2).sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    def test_under_to_static(self):
+        m = self._block(5)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(8, 8).astype("float32"))
+
+        def step(x):
+            loss = (recompute(m, x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        compiled = paddle.jit.to_static(step, state=[m, opt])
+        losses = [float(compiled(x)) for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_llama_layer_recompute(self):
+        from paddle_tpu.models import LlamaDecoderLayer, tiny_llama_config
+        paddle.seed(6)
+        cfg = tiny_llama_config()
+        layer = LlamaDecoderLayer(cfg)
+        x = paddle.to_tensor(np.random.RandomState(3)
+                             .randn(2, 16, cfg.hidden_size)
+                             .astype("float32"))
+        out_plain = layer(x)
+        out_ckpt = recompute(layer, x)
+        np.testing.assert_allclose(out_plain.numpy(), out_ckpt.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestWeightNorm:
+    def test_weight_norm_reparameterizes(self):
+        paddle.seed(7)
+        lin = nn.Linear(6, 4)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin, dim=0)
+        names = dict(lin.named_parameters())
+        assert "weight_g" in names and "weight_v" in names
+        assert "weight" not in names
+        x = paddle.to_tensor(np.random.RandomState(4)
+                             .randn(3, 6).astype("float32"))
+        y = lin(x)
+        # initially g*v/||v|| == original weight
+        np.testing.assert_allclose(y.numpy(), x.numpy() @ w0
+                                   + lin.bias.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        # gradients flow to g and v
+        (y ** 2).mean().backward()
+        assert lin.weight_g.grad is not None
+        assert lin.weight_v.grad is not None
+
+    def test_weight_norm_trains(self):
+        paddle.seed(8)
+        lin = nn.Linear(4, 1)
+        nn.utils.weight_norm(lin)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        x = paddle.to_tensor(np.random.RandomState(5)
+                             .randn(16, 4).astype("float32"))
+        y = paddle.to_tensor((np.random.RandomState(5)
+                              .randn(16, 4).astype("float32")
+                              @ np.ones((4, 1), "float32")))
+        first = last = None
+        for _ in range(25):
+            loss = ((lin(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = float(loss) if first is None else first
+            last = float(loss)
+        assert last < first * 0.5
+
+    def test_remove_weight_norm_roundtrip(self):
+        paddle.seed(9)
+        lin = nn.Linear(6, 4)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin)
+        nn.utils.remove_weight_norm(lin)
+        names = dict(lin.named_parameters())
+        assert "weight" in names and "weight_g" not in names
+        np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_spectral_norm_unit_sigma(self):
+        paddle.seed(10)
+        lin = nn.Linear(8, 8)
+        nn.utils.spectral_norm(lin, n_power_iterations=20)
+        x = paddle.to_tensor(np.eye(8, dtype="float32"))
+        lin(x)  # hook computed weight
+        w = lin.__dict__["weight"].numpy()
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(sigma, 1.0, rtol=1e-2)
+
+
+class TestEnforce:
+    def test_typed_errors(self):
+        from paddle_tpu.framework import enforce
+        with pytest.raises(enforce.InvalidArgumentError):
+            enforce.enforce(False, "bad value {}", 3)
+        assert issubclass(enforce.InvalidArgumentError, ValueError)
+        with pytest.raises(enforce.InvalidArgumentError):
+            enforce.check_type(3, "x", (str,), "concat")
+        with pytest.raises(enforce.InvalidArgumentError):
+            enforce.check_dtype("int8", "x", ["float32", "float16"],
+                                "matmul")
+
+    def test_op_context_note_attached(self):
+        # shape mismatch inside an op carries the operator name as a note
+        a = paddle.to_tensor(np.ones((2, 3), "float32"))
+        b = paddle.to_tensor(np.ones((4, 5), "float32"))
+        with pytest.raises(Exception) as ei:
+            paddle.matmul(a, b)
+        notes = getattr(ei.value, "__notes__", [])
+        assert any("matmul" in n for n in notes)
+
+
+class TestProfiler:
+    def test_trace_and_summary(self, tmp_path, capsys):
+        from paddle_tpu import profiler
+        p = profiler.Profiler(
+            on_trace_ready=profiler.export_chrome_tracing(str(tmp_path)))
+        p.start()
+        x = paddle.to_tensor(np.random.randn(64, 64).astype("float32"))
+        for _ in range(3):
+            with profiler.RecordEvent("train_step"):
+                y = paddle.matmul(x, x)
+            p.step(num_samples=64)
+        p.stop()
+        stats = p.summary()
+        assert stats["steps"] == 3 and stats["ips"] > 0
+        traces = p.chrome_trace_paths()
+        assert traces and traces[0].endswith(".trace.json.gz")
+        assert os.path.exists(traces[0])
+
+    def test_benchmark_timer(self):
+        from paddle_tpu.profiler import Benchmark
+        b = Benchmark()
+        b.begin()
+        import time
+        for _ in range(3):
+            time.sleep(0.01)
+            b.step(num_samples=10)
+        r = b.report()
+        assert r["steps"] == 3 and r["ips"] > 0
+
+    def test_make_scheduler(self):
+        from paddle_tpu.profiler import make_scheduler
+        s = make_scheduler(closed=1, ready=1, record=2, skip_first=1)
+        assert [s(i) for i in range(6)] == [False, False, False, True,
+                                            True, False]

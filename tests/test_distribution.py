@@ -1,0 +1,201 @@
+"""paddle.distribution: sampling, densities, kl, transforms.
+
+Reference bar: `python/paddle/distribution/` — parameters are
+differentiable through log_prob/rsample; kl pairs match closed forms;
+sampling follows paddle.seed.
+"""
+
+import numpy as np
+import pytest
+from scipy import stats as spstats
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, dtype="float32"))
+
+
+class TestDensities:
+    def test_normal_log_prob(self):
+        d = D.Normal(t(1.0), t(2.0))
+        v = np.asarray([0.5, 1.0, 3.0], "float32")
+        np.testing.assert_allclose(
+            d.log_prob(t(v)).numpy(),
+            spstats.norm.logpdf(v, 1.0, 2.0), rtol=1e-5)
+
+    def test_uniform_log_prob(self):
+        d = D.Uniform(t(0.0), t(4.0))
+        got = d.log_prob(t([1.0, 5.0])).numpy()
+        np.testing.assert_allclose(got[0], np.log(0.25), rtol=1e-6)
+        assert got[1] == -np.inf
+
+    def test_gamma_beta_exponential_laplace_logpdfs(self):
+        v = np.asarray([0.2, 0.7, 1.5], "float32")
+        np.testing.assert_allclose(
+            D.Gamma(t(2.0), t(3.0)).log_prob(t(v)).numpy(),
+            spstats.gamma.logpdf(v, 2.0, scale=1 / 3.0), rtol=1e-5)
+        vb = np.asarray([0.2, 0.5, 0.9], "float32")
+        np.testing.assert_allclose(
+            D.Beta(t(2.0), t(3.0)).log_prob(t(vb)).numpy(),
+            spstats.beta.logpdf(vb, 2.0, 3.0), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.Exponential(t(1.5)).log_prob(t(v)).numpy(),
+            spstats.expon.logpdf(v, scale=1 / 1.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.Laplace(t(0.5), t(1.2)).log_prob(t(v)).numpy(),
+            spstats.laplace.logpdf(v, 0.5, 1.2), rtol=1e-5)
+
+    def test_discrete_log_probs(self):
+        np.testing.assert_allclose(
+            D.Bernoulli(probs=t(0.3)).log_prob(t([0.0, 1.0])).numpy(),
+            spstats.bernoulli.logpmf([0, 1], 0.3), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.Poisson(t(2.5)).log_prob(t([0.0, 2.0, 5.0])).numpy(),
+            spstats.poisson.logpmf([0, 2, 5], 2.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.Geometric(t(0.25)).log_prob(t([0.0, 3.0])).numpy(),
+            spstats.geom.logpmf([1, 4], 0.25), rtol=1e-5)
+        logits = t([[0.1, 0.5, -0.2]])
+        cat = D.Categorical(logits=logits)
+        probs = np.exp(logits.numpy()) / np.exp(logits.numpy()).sum()
+        np.testing.assert_allclose(
+            cat.log_prob(t([1])).numpy(), np.log(probs[0, 1]), rtol=1e-5)
+
+    def test_categorical_entropy(self):
+        cat = D.Categorical(probs=t([0.25, 0.25, 0.25, 0.25]))
+        np.testing.assert_allclose(float(cat.entropy()), np.log(4.0),
+                                   rtol=1e-5)
+
+
+class TestSampling:
+    def test_seeded_reproducible(self):
+        paddle.seed(123)
+        a = D.Normal(t(0.0), t(1.0)).sample((8,)).numpy()
+        paddle.seed(123)
+        b = D.Normal(t(0.0), t(1.0)).sample((8,)).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.parametrize("dist,mean,std", [
+        (lambda: D.Normal(t(2.0), t(0.5)), 2.0, 0.5),
+        (lambda: D.Uniform(t(0.0), t(2.0)), 1.0, 2 / np.sqrt(12)),
+        (lambda: D.Exponential(t(2.0)), 0.5, 0.5),
+        (lambda: D.Laplace(t(1.0), t(0.5)), 1.0, 0.5 * np.sqrt(2)),
+        (lambda: D.Gamma(t(4.0), t(2.0)), 2.0, 1.0),
+    ])
+    def test_sample_moments(self, dist, mean, std):
+        paddle.seed(0)
+        s = dist().sample((20000,)).numpy()
+        np.testing.assert_allclose(s.mean(), mean, atol=4 * std / 140)
+        np.testing.assert_allclose(s.std(), std, rtol=0.05)
+
+    def test_multinomial_counts(self):
+        paddle.seed(1)
+        m = D.Multinomial(100, t([0.2, 0.3, 0.5]))
+        s = m.sample((50,)).numpy()
+        assert (s.sum(-1) == 100).all()
+        np.testing.assert_allclose(s.mean(0), [20, 30, 50], rtol=0.2)
+
+    def test_dirichlet_simplex(self):
+        paddle.seed(2)
+        d = D.Dirichlet(t([2.0, 3.0, 5.0]))
+        s = d.sample((200,)).numpy()
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [0.2, 0.3, 0.5], atol=0.05)
+
+    def test_categorical_frequencies(self):
+        paddle.seed(3)
+        cat = D.Categorical(probs=t([0.1, 0.6, 0.3]))
+        s = cat.sample((5000,)).numpy()
+        freq = np.bincount(s, minlength=3) / 5000
+        np.testing.assert_allclose(freq, [0.1, 0.6, 0.3], atol=0.03)
+
+
+class TestGradients:
+    def test_rsample_reparameterized(self):
+        loc = t(0.5)
+        loc.stop_gradient = False
+        scale = t(1.0)
+        scale.stop_gradient = False
+        paddle.seed(4)
+        s = D.Normal(loc, scale).rsample((1000,))
+        s.mean().backward()
+        np.testing.assert_allclose(loc.grad.numpy(), 1.0, rtol=1e-5)
+
+    def test_log_prob_grad_wrt_params(self):
+        loc = t(0.0)
+        loc.stop_gradient = False
+        d = D.Normal(loc, t(1.0))
+        lp = d.log_prob(t(2.0))
+        lp.backward()
+        np.testing.assert_allclose(loc.grad.numpy(), 2.0, rtol=1e-5)
+
+
+class TestKL:
+    def test_normal_kl_closed_form(self):
+        p = D.Normal(t(0.0), t(1.0))
+        q = D.Normal(t(1.0), t(2.0))
+        expected = (np.log(2.0) + (1 + 1) / (2 * 4) - 0.5)
+        np.testing.assert_allclose(float(D.kl_divergence(p, q)), expected,
+                                   rtol=1e-5)
+
+    def test_kl_nonnegative_families(self):
+        pairs = [
+            (D.Bernoulli(probs=t(0.3)), D.Bernoulli(probs=t(0.7))),
+            (D.Categorical(probs=t([0.2, 0.8])),
+             D.Categorical(probs=t([0.5, 0.5]))),
+            (D.Gamma(t(2.0), t(1.0)), D.Gamma(t(3.0), t(2.0))),
+            (D.Beta(t(2.0), t(2.0)), D.Beta(t(5.0), t(1.0))),
+            (D.Exponential(t(1.0)), D.Exponential(t(2.0))),
+            (D.Laplace(t(0.0), t(1.0)), D.Laplace(t(1.0), t(2.0))),
+            (D.Dirichlet(t([1.0, 2.0])), D.Dirichlet(t([3.0, 1.0]))),
+        ]
+        for p, q in pairs:
+            assert float(D.kl_divergence(p, q)) > 0
+            np.testing.assert_allclose(float(D.kl_divergence(p, p)), 0.0,
+                                       atol=1e-5)
+
+    def test_kl_monte_carlo_agreement(self):
+        paddle.seed(5)
+        p = D.Gamma(t(3.0), t(2.0))
+        q = D.Gamma(t(2.0), t(1.0))
+        s = p.sample((20000,))
+        mc = float((p.log_prob(s) - q.log_prob(s)).mean())
+        np.testing.assert_allclose(float(D.kl_divergence(p, q)), mc,
+                                   rtol=0.1)
+
+    def test_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(t(0.0), t(1.0)),
+                            D.Gamma(t(1.0), t(1.0)))
+
+
+class TestTransforms:
+    def test_lognormal_via_transform(self):
+        base = D.Normal(t(0.2), t(0.7))
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ln = D.LogNormal(t(0.2), t(0.7))
+        v = t([0.5, 1.0, 2.0])
+        np.testing.assert_allclose(td.log_prob(v).numpy(),
+                                   ln.log_prob(v).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            ln.log_prob(v).numpy(),
+            spstats.lognorm.logpdf(v.numpy(), 0.7, scale=np.exp(0.2)),
+            rtol=1e-5)
+
+    def test_affine_transform(self):
+        base = D.Normal(t(0.0), t(1.0))
+        td = D.TransformedDistribution(
+            base, [D.AffineTransform(t(3.0), t(2.0))])
+        ref = D.Normal(t(3.0), t(2.0))
+        v = t([1.0, 3.0, 6.0])
+        np.testing.assert_allclose(td.log_prob(v).numpy(),
+                                   ref.log_prob(v).numpy(), rtol=1e-5)
+
+    def test_sigmoid_transform_samples_in_unit_interval(self):
+        paddle.seed(6)
+        td = D.TransformedDistribution(D.Normal(t(0.0), t(1.0)),
+                                       [D.SigmoidTransform()])
+        s = td.sample((100,)).numpy()
+        assert (s > 0).all() and (s < 1).all()

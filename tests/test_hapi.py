@@ -125,3 +125,28 @@ class TestModelFit:
         model = make_model()
         info = model.summary()
         assert info["total_params"] == 4 * 16 + 16 + 16 * 2 + 2
+
+
+def test_metric_counts_every_sample_per_batch():
+    # regression: star-unpacking compute()'s [B, k] array once fed update
+    # a single ROW per batch, silently computing accuracy from one sample
+    model = make_model()
+    model.fit(ToyData(n=96), epochs=1, batch_size=32, verbose=0)
+    m = model._metrics[0]
+    assert m.count[0] == 96   # every sample of every batch was counted
+
+
+def test_multi_topk_metric_logged_under_each_name():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 4))
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.AdamW(learning_rate=0.01,
+                                         parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=[Accuracy(topk=(1, 2))])
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype("float32")
+    y = rng.randint(0, 4, (32,)).astype("int64")
+    logs = model.train_batch([paddle.to_tensor(x)], [paddle.to_tensor(y)])
+    assert "acc_top1" in logs and "acc_top2" in logs
+    assert logs["acc_top2"] >= logs["acc_top1"]
