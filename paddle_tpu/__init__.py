@@ -8,6 +8,24 @@ GSPMD mesh sharding for DP/FSDP/TP/SP/CP/EP parallelism.
 
 from __future__ import annotations
 
+import os as _os
+
+# Multi-host bootstrap MUST precede any jax call that initializes the XLA
+# backend (importing the framework draws a PRNG key). The launch CLI
+# (`python -m paddle_tpu.distributed.launch`) sets these env vars; plain
+# single-process runs skip this entirely. Reference analog:
+# parallel.py:943 init_parallel_env over TCPStore — here the JAX
+# coordination service.
+_distributed_bootstrapped = False
+if "PADDLE_LOCAL_RANK" in _os.environ:
+    # PADDLE_LOCAL_RANK marks an actual WORKER process (the launch CLI
+    # sets it; set it manually when starting workers by hand). The guard
+    # keeps the launcher parent — and any tool that merely imports the
+    # package on a cluster with PADDLE_* pre-exported — from joining the
+    # coordination service and colliding with the real rank.
+    from ._bootstrap import bootstrap_distributed as _bd
+    _distributed_bootstrapped = _bd()
+
 from . import flags as _flags_mod
 from .flags import set_flags, get_flags  # noqa: F401
 
@@ -44,6 +62,8 @@ from . import device  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401,E402

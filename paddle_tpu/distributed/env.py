@@ -31,13 +31,12 @@ def init_parallel_env():
     global _initialized
     if _initialized:
         return ParallelEnv()
-    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    if n > 1:
-        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-        master = os.environ.get("PADDLE_MASTER") or \
-            os.environ.get("PADDLE_CURRENT_ENDPOINT")
-        jax.distributed.initialize(coordinator_address=master,
-                                   num_processes=n, process_id=rank)
+    # same helper the import-time worker bootstrap uses (one
+    # implementation: gloo-on-cpu config + coordinator join, idempotent).
+    # This late path only works if nothing initialized the XLA backend
+    # yet — prefer launching via the CLI, which bootstraps at import.
+    from .._bootstrap import bootstrap_distributed
+    bootstrap_distributed()
     _initialized = True
     return ParallelEnv()
 

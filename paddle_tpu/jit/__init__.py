@@ -33,7 +33,7 @@ from ..framework import random as frandom
 from ..framework import amp_state
 
 __all__ = ["to_static", "not_to_static", "ignore_module", "StaticFunction",
-           "enable_to_static"]
+           "enable_to_static", "save", "load", "TranslatedLayer"]
 
 _to_static_enabled = True
 
@@ -329,3 +329,6 @@ def not_to_static(fn):
 
 def ignore_module(modules):
     pass
+
+
+from .serialization import save, load, TranslatedLayer  # noqa: F401,E402

@@ -1,0 +1,163 @@
+"""jit.save/load (StableHLO export) + inference Predictor.
+
+Reference bars: `python/paddle/jit/api.py` save/load +
+`jit/translated_layer.py`; `fluid/inference/api/analysis_predictor.h:100`.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+
+def make_net(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+
+
+class TestJitSaveLoad:
+    def test_roundtrip_matches(self, tmp_path):
+        net = make_net()
+        path = str(tmp_path / "model")
+        paddle.jit.save(net, path,
+                        input_spec=[InputSpec([None, 8], "float32")])
+        loaded = paddle.jit.load(path)
+        x = np.random.RandomState(0).randn(5, 8).astype("float32")
+        ref = net(paddle.to_tensor(x)).numpy()
+        got = loaded(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_polymorphic_batch(self, tmp_path):
+        net = make_net(1)
+        path = str(tmp_path / "model")
+        paddle.jit.save(net, path,
+                        input_spec=[InputSpec([None, 8], "float32")])
+        loaded = paddle.jit.load(path)
+        for b in (1, 3, 17):
+            out = loaded(paddle.to_tensor(
+                np.zeros((b, 8), "float32")))
+            assert out.shape == [b, 4]
+
+    def test_no_python_model_needed(self, tmp_path):
+        # loading uses only the serialized program + params
+        net = make_net(2)
+        path = str(tmp_path / "m")
+        paddle.jit.save(net, path,
+                        input_spec=[InputSpec([None, 8], "float32")])
+        loaded = paddle.jit.load(path)
+        assert len(loaded.parameters()) == len(list(net.parameters()))
+        with pytest.raises(RuntimeError, match="inference"):
+            loaded.train()
+
+    def test_save_requires_input_spec(self, tmp_path):
+        with pytest.raises(ValueError, match="input_spec"):
+            paddle.jit.save(make_net(), str(tmp_path / "m"))
+
+    def test_llama_decoder_export(self, tmp_path):
+        from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+        paddle.seed(3)
+        m = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=1))
+        m.eval()
+        path = str(tmp_path / "llama")
+        paddle.jit.save(m, path,
+                        input_spec=[InputSpec([1, 16], "int32")])
+        loaded = paddle.jit.load(path)
+        ids = np.random.RandomState(1).randint(0, 128, (1, 16),
+                                               dtype=np.int32)
+        ref = m(paddle.to_tensor(ids)).numpy()
+        got = loaded(paddle.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+class TestPredictor:
+    def test_predictor_run(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        net = make_net(4)
+        path = str(tmp_path / "served")
+        paddle.jit.save(net, path,
+                        input_spec=[InputSpec([None, 8], "float32")])
+        pred = create_predictor(Config(path + ".pdmodel"))
+        x = np.random.RandomState(2).randn(6, 8).astype("float32")
+        names = pred.get_input_names()
+        pred.get_input_handle(names[0]).copy_from_cpu(x)
+        outs = pred.run()
+        np.testing.assert_allclose(
+            outs[0], net(paddle.to_tensor(x)).numpy(), rtol=1e-5,
+            atol=1e-6)
+        h = pred.get_output_handle(pred.get_output_names()[0])
+        assert h.shape() == [6, 4]
+
+    def test_load_inference_model_alias(self, tmp_path):
+        from paddle_tpu.static import load_inference_model
+        net = make_net(5)
+        path = str(tmp_path / "alias")
+        paddle.jit.save(net, path,
+                        input_spec=[InputSpec([None, 8], "float32")])
+        loaded = load_inference_model(path)
+        out = loaded(paddle.to_tensor(np.zeros((2, 8), "float32")))
+        assert out.shape == [2, 4]
+
+
+def test_multi_input_polymorphic(tmp_path):
+    # two shape-polymorphic inputs must share one symbolic scope
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(8, 4)
+
+        def forward(self, a, b):
+            return self.lin(a) + self.lin(b)
+
+    paddle.seed(6)
+    net = TwoIn()
+    path = str(tmp_path / "two")
+    paddle.jit.save(net, path,
+                    input_spec=[InputSpec(["batch", 8], "float32"),
+                                InputSpec(["batch", 8], "float32")])
+    loaded = paddle.jit.load(path)
+    a = np.random.RandomState(0).randn(3, 8).astype("float32")
+    b = np.random.RandomState(1).randn(3, 8).astype("float32")
+    ref = net(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    got = loaded(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_set_state_dict_structured_names(tmp_path):
+    net = make_net(7)
+    path = str(tmp_path / "sd")
+    paddle.jit.save(net, path,
+                    input_spec=[InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    # fine-tune the original, push its state into the loaded program
+    for p in net.parameters():
+        p._data = p._data * 2.0
+    loaded.set_state_dict(net.state_dict())
+    x = np.random.RandomState(3).randn(2, 8).astype("float32")
+    np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(),
+                               net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+    with pytest.raises(KeyError, match="matched no"):
+        loaded.set_state_dict({"bogus": net.parameters()[0]})
+
+
+def test_tuple_output_structure(tmp_path):
+    class TwoOut(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(8, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            return h, h.mean()
+
+    paddle.seed(8)
+    net = TwoOut()
+    path = str(tmp_path / "tup")
+    paddle.jit.save(net, path,
+                    input_spec=[InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    out = loaded(paddle.to_tensor(np.zeros((2, 8), "float32")))
+    assert isinstance(out, tuple) and len(out) == 2
+    assert out[0].shape == [2, 4]

@@ -1,0 +1,65 @@
+"""Launch CLI + multi-process jax.distributed bootstrap.
+
+Reference bar: `launch/controllers/collective.py:22` spawning workers
+with PADDLE_* env; `test_dist_base.py` multi-process-on-one-host pattern.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+
+WORKER_OK = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+    sys.path.insert(0, %r)
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import init_parallel_env, get_rank, \\
+        get_world_size
+    env = init_parallel_env()
+    import jax, jax.numpy as jnp
+    assert jax.process_count() == 2
+    assert jax.device_count() == 2   # global view across both processes
+    # cross-process collective: gather every rank's value on every host
+    from jax.experimental import multihost_utils
+    vals = multihost_utils.process_allgather(
+        jnp.asarray([float(get_rank())]))
+    total = float(vals.sum())
+    assert get_world_size() == 2, get_world_size()
+    assert total == 1.0, total
+    print("rank", get_rank(), "of", get_world_size(), "psum", total)
+""") % os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+WORKER_FAIL = "import sys; sys.exit(3)"
+
+
+def run_launch(tmp_path, worker_src, nproc=2, extra=()):
+    script = tmp_path / "worker.py"
+    script.write_text(worker_src)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(nproc),
+           "--log_dir", str(tmp_path / "log"), *extra, str(script)]
+    return subprocess.run(cmd, cwd=repo, env=env, capture_output=True,
+                          text=True, timeout=300), tmp_path / "log"
+
+
+def test_two_process_psum(tmp_path):
+    res, log_dir = run_launch(tmp_path, WORKER_OK)
+    logs = "\n".join((log_dir / f"workerlog.{r}").read_text()
+                     for r in range(2))
+    assert res.returncode == 0, logs
+    assert "rank 0 of 2 psum 1.0" in logs
+    assert "rank 1 of 2 psum 1.0" in logs
+
+
+def test_failure_propagates(tmp_path):
+    res, _ = run_launch(tmp_path, WORKER_FAIL, nproc=1)
+    assert res.returncode == 3
