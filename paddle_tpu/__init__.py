@@ -64,8 +64,11 @@ from . import profiler  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
 from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402
 

@@ -31,6 +31,10 @@ from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
 from .recompute import recompute  # noqa: F401
+from . import fleet  # noqa: F401
+from .parallel import DataParallel, shard_dataloader, ShardDataloader  # noqa: F401
+from . import auto_tuner  # noqa: F401
+from .watchdog import StepWatchdog, ElasticManager, FileStore  # noqa: F401
 from .pipeline import pipeline_spmd  # noqa: F401
 from . import collective  # noqa: F401
 

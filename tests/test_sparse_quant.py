@@ -1,0 +1,200 @@
+"""paddle.sparse (BCOO/BCSR) + paddle.quantization (QAT/PTQ).
+
+Reference bars: `python/paddle/sparse/creation.py` +
+`phi/kernels/sparse/`; `python/paddle/quantization/qat.py` with STE
+fake-quant.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.sparse as sparse
+from paddle_tpu.quantization import (QAT, PTQ, QuantConfig, AbsmaxObserver,
+                                     PerChannelAbsmaxObserver,
+                                     quant_dequant)
+
+
+def coo():
+    # [[0, 2, 0], [1, 0, 3]]
+    idx = np.asarray([[0, 1, 1], [1, 0, 2]])
+    vals = np.asarray([2.0, 1.0, 3.0], "float32")
+    return sparse.sparse_coo_tensor(idx, vals, (2, 3))
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        sp = coo()
+        assert sp.nnz == 3 and sp.shape == [2, 3]
+        np.testing.assert_array_equal(
+            sp.to_dense().numpy(), [[0, 2, 0], [1, 0, 3]])
+
+    def test_csr_roundtrip(self):
+        sp = sparse.sparse_csr_tensor([0, 1, 3], [1, 0, 2],
+                                      [2.0, 1.0, 3.0], (2, 3))
+        np.testing.assert_array_equal(
+            sp.to_dense().numpy(), [[0, 2, 0], [1, 0, 3]])
+        coo2 = sp.to_sparse_coo()
+        np.testing.assert_array_equal(
+            coo2.to_dense().numpy(), sp.to_dense().numpy())
+
+    def test_coo_to_csr(self):
+        c = coo().to_sparse_csr()
+        np.testing.assert_array_equal(
+            c.to_dense().numpy(), [[0, 2, 0], [1, 0, 3]])
+
+    def test_matmul_grads(self):
+        sp = coo()
+        sp._values.stop_gradient = False
+        d = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(3, 4).astype("float32"),
+                             stop_gradient=False)
+        out = sparse.matmul(sp, d)
+        assert out.shape == [2, 4]
+        ref = coo().to_dense().numpy() @ d.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+        out.sum().backward()
+        assert sp.values().grad is not None
+        assert d.grad is not None
+
+    def test_unary_keeps_sparsity(self):
+        sp = coo()
+        out = sparse.neg(sp)
+        assert isinstance(out, sparse.SparseCooTensor)
+        np.testing.assert_array_equal(
+            out.to_dense().numpy(), [[0, -2, 0], [-1, 0, -3]])
+
+    def test_add_sparse_sparse(self):
+        out = sparse.add(coo(), coo())
+        np.testing.assert_array_equal(out.numpy(), [[0, 4, 0], [2, 0, 6]])
+
+
+class TestQuantization:
+    def test_quant_dequant_ste(self):
+        x = paddle.to_tensor(np.asarray([0.1, -0.5, 0.9], "float32"),
+                             stop_gradient=False)
+        s = paddle.to_tensor(np.float32(1.0))
+        y = quant_dequant(x, s)
+        # values land on the 127-level grid
+        grid = y.numpy() * 127.0
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-4)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 1.0)  # STE passthrough
+
+    def test_observers(self):
+        o = AbsmaxObserver()
+        o.observe(np.asarray([1.0, -3.0]))
+        o.observe(np.asarray([2.0]))
+        assert o.scale() == 3.0
+        pc = PerChannelAbsmaxObserver()
+        pc.observe(np.asarray([[1.0, -4.0], [2.0, 3.0]]))
+        np.testing.assert_array_equal(pc.scale(), [2.0, 4.0])
+
+    def test_qat_trains_and_converts(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+        qat = QAT(QuantConfig())
+        net = qat.quantize(net)
+        from paddle_tpu.quantization import QuantedLinear
+        assert isinstance(net[0], QuantedLinear)
+        opt = paddle.optimizer.AdamW(learning_rate=0.02,
+                                     parameters=net.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(32, 4).astype("float32"))
+        y = paddle.to_tensor(
+            (x.numpy() @ np.ones((4, 1), "float32")).astype("float32"))
+        first = last = None
+        for _ in range(30):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = float(loss) if first is None else first
+            last = float(loss)
+        assert last < first * 0.3   # trains THROUGH fake quant (STE)
+
+        ref = net(x).numpy()
+        deployed = qat.convert(net)
+        got = deployed(x).numpy()
+        assert deployed[0].weight_int8.dtype.name == "int8"
+        # int8 deployment tracks the QAT model closely
+        err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6)
+        assert err < 0.1
+
+    def test_ptq_calibrate_convert(self):
+        paddle.seed(1)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(64, 4).astype("float32"))
+        ref = net(x).numpy()
+        ptq = PTQ()
+        net = ptq.quantize(net)
+        net(x)  # calibration pass feeds the observers
+        assert ptq._observers and all(
+            o.scale() > 0 for o in ptq._observers.values())
+        deployed = ptq.convert(net)
+        got = deployed(x).numpy()
+        rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6)
+        assert rel < 0.1  # int8 weight error bound
+
+
+class TestReviewRegressions:
+    def test_sparse_multiply_keeps_sparsity(self):
+        sp = coo()
+        d = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        out = sparse.multiply(sp, d)
+        assert isinstance(out, sparse.SparseCooTensor)
+        np.testing.assert_array_equal(out.to_dense().numpy(),
+                                      [[0, 2, 0], [3, 0, 15]])
+        csr = coo().to_sparse_csr()
+        out2 = sparse.multiply(csr, d)
+        np.testing.assert_array_equal(out2.to_dense().numpy(),
+                                      [[0, 2, 0], [3, 0, 15]])
+
+    def test_quantize_not_inplace_by_default(self):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(4, 4))
+        q = QAT(QuantConfig()).quantize(net)
+        assert isinstance(net[0], nn.Linear)       # original untouched
+        from paddle_tpu.quantization import QuantedLinear
+        assert isinstance(q[0], QuantedLinear)
+
+    def test_ptq_activation_scale_applied(self):
+        paddle.seed(4)
+        net = nn.Sequential(nn.Linear(4, 4))
+        ptq = PTQ()
+        qnet = ptq.quantize(net)
+        x = paddle.to_tensor(np.random.RandomState(5)
+                             .randn(16, 4).astype("float32"))
+        qnet(x)
+        deployed = ptq.convert(qnet)
+        assert deployed[0].act_scale is not None
+        assert float(deployed[0].act_scale) == pytest.approx(
+            float(np.abs(x.numpy()).max()))
+
+    def test_shard_dataloader_int_dim_and_dict(self):
+        from paddle_tpu.distributed import shard_dataloader, ProcessMesh
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                           dim_names=["mp", "dp"])
+
+        class DictLoader:
+            def __iter__(self):
+                yield {"x": np.zeros((8, 2), "float32"),
+                       "y": np.zeros((8,), "int64")}
+
+            def __len__(self):
+                return 1
+
+        sharded = shard_dataloader(DictLoader(), mesh, shard_dims=1,
+                                   input_keys=["x"])
+        batch = next(iter(sharded))
+        assert batch["x"]._data.sharding.spec[0] == "dp"   # dim 1 -> 'dp'
+        assert not getattr(batch["y"], "is_dist", False)
+
+    def test_scale_loss_is_identity_method(self):
+        from paddle_tpu.distributed import DataParallel, ProcessMesh
+        m = DataParallel(nn.Linear(2, 1),
+                         mesh=ProcessMesh(np.arange(8), dim_names=["dp"]))
+        loss = paddle.to_tensor(np.float32(3.0))
+        assert float(m.scale_loss(loss)) == 3.0
