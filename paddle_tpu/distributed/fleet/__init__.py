@@ -120,13 +120,12 @@ class HybridCommunicateGroup:
     def _axis_rank(self, axis):
         if axis not in self._mesh.dim_names:
             return 0
-        idx = self._mesh.dim_names.index(axis)
         # the mesh holds global DEVICE ids; locate this process by its
         # first local device (process_index would misplace multi-host)
         import jax
         did = jax.local_devices()[0].id
-        pos = np.argwhere(self._mesh.mesh == did)
-        return int(pos[0][idx]) if len(pos) else 0
+        rank = self._mesh.get_rank_by_dim_and_process_id(axis, did)
+        return max(0, int(rank))
 
     def _axis_size(self, axis):
         if axis not in self._mesh.dim_names:

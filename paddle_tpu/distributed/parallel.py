@@ -97,7 +97,10 @@ class ShardDataloader:
         if isinstance(item, dict):
             return {k: self._commit(v, key=k) for k, v in item.items()}
         if isinstance(item, (list, tuple)):
-            return type(item)(self._commit(e) for e in item)
+            elems = [self._commit(e, key=key) for e in item]
+            if hasattr(item, "_fields"):     # namedtuple
+                return type(item)(*elems)
+            return type(item)(elems)
         t = item if isinstance(item, Tensor) else Tensor(np.asarray(item))
         if t.ndim == 0:
             return t

@@ -31,8 +31,15 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
     """
     from ..nn import Layer
 
-    params = list(function.parameters()) if isinstance(function, Layer) \
-        else []
+    if isinstance(function, Layer):
+        params = list(function.parameters())
+    else:
+        # a bound method of a Layer (e.g. ``layer.forward``) must thread
+        # its owner's parameters too — otherwise they bake into the
+        # checkpointed jaxpr as constants and silently stop training
+        owner = getattr(function, "__self__", None)
+        params = list(owner.parameters()) if isinstance(owner, Layer) \
+            else []
     tensor_args = list(args)
     n_args = len(tensor_args)
 

@@ -125,15 +125,25 @@ class QuantConfig:
         self.activation = activation or FakeQuanterWithAbsMax(8)
         self.weight = weight or FakeQuanterWithAbsMax(8)
         self._types = (nn.Linear,)
+        self._per_type = {}   # layer type -> (activation, weight)
 
     def add_type_config(self, layer_types, activation=None, weight=None):
         if not isinstance(layer_types, (list, tuple)):
             layer_types = [layer_types]
+        for t in layer_types:
+            if not (isinstance(t, type) and issubclass(t, nn.Linear)):
+                raise NotImplementedError(
+                    f"quantization of {getattr(t, '__name__', t)} is not "
+                    "supported yet (only Linear-family layers); the "
+                    "QuantedLinear wrapper computes F.linear")
+            self._per_type[t] = (activation, weight)
         self._types = tuple(set(self._types) | set(layer_types))
-        if activation is not None:
-            self.activation = activation
-        if weight is not None:
-            self.weight = weight
+
+    def quanters_for(self, layer):
+        for t, (a, w) in self._per_type.items():
+            if isinstance(layer, t):
+                return (a or self.activation, w or self.weight)
+        return (self.activation, self.weight)
 
 
 class QuantedLinear(nn.Layer):
@@ -143,8 +153,7 @@ class QuantedLinear(nn.Layer):
         super().__init__()
         self.weight = linear.weight
         self.bias = linear.bias
-        self._act_q = config.activation
-        self._w_q = config.weight
+        self._act_q, self._w_q = config.quanters_for(linear)
 
     def forward(self, x):
         xq = self._act_q(x)

@@ -47,8 +47,6 @@ class _SparseBase:
         return self._values
 
     def to_dense(self):
-        mat = self._rebuild()
-
         def fn(v):
             return self._with_values(v).todense()
 
@@ -73,7 +71,8 @@ class SparseCooTensor(_SparseBase):
         super().__init__(mat, values_tensor)
 
     def indices(self):
-        return Tensor(self._indices.T, stop_gradient=True)
+        # paddle layout: [sparse_dim, nnz] (what sparse_coo_tensor takes)
+        return Tensor(self._indices, stop_gradient=True)
 
     def _with_values(self, v):
         return jsparse.BCOO((v, self._indices.T), shape=self._mat.shape)
@@ -159,14 +158,22 @@ def matmul(x, y, name=None):
 
 
 def add(x, y, name=None):
+    """coo + coo -> coo (concatenated coordinates, duplicates implicit —
+    ``to_dense`` sums them, like an uncoalesced reference tensor);
+    sparse + dense -> dense."""
     if isinstance(y, _SparseBase):
-        xr, yr = x._with_values, y._with_values
-
-        def fn(xv, yv):
-            s = (xr(xv).todense() + yr(yv).todense())
-            return s
-
-        return run_op("sparse_add", fn, (x._values, y._values))
+        if not (isinstance(x, SparseCooTensor)
+                and isinstance(y, SparseCooTensor)):
+            raise NotImplementedError(
+                "sparse add of CSR tensors: convert with to_sparse_coo()")
+        if list(x.shape) != list(y.shape):
+            raise ValueError(f"shape mismatch: {x.shape} vs {y.shape}")
+        vals = run_op("sparse_add_values",
+                      lambda a, b: jnp.concatenate([a, b]),
+                      (x._values, y._values))
+        idx = np.concatenate([np.asarray(x._indices),
+                              np.asarray(y._indices)], axis=1)
+        return SparseCooTensor(idx, vals, x._mat.shape)
     return run_op("sparse_add_dense",
                   lambda v, d: x._with_values(v).todense() + d,
                   (x._values, y))

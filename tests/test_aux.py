@@ -198,3 +198,16 @@ class TestProfiler:
         s = make_scheduler(closed=1, ready=1, record=2, skip_first=1)
         assert [s(i) for i in range(6)] == [False, False, False, True,
                                             True, False]
+
+
+def test_recompute_bound_method_threads_owner_params():
+    # regression: a bound method's owning Layer's params must keep grads
+    import paddle_tpu.nn as nn2
+    paddle.seed(12)
+    lin = nn2.Linear(4, 4)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 4).astype("float32"))
+    out = recompute(type(lin).forward.__get__(lin), x)
+    (out ** 2).mean().backward()
+    assert lin.weight.grad is not None
+    assert np.abs(lin.weight.grad.numpy()).sum() > 0

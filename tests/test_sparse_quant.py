@@ -65,9 +65,30 @@ class TestSparse:
         np.testing.assert_array_equal(
             out.to_dense().numpy(), [[0, -2, 0], [-1, 0, -3]])
 
-    def test_add_sparse_sparse(self):
+    def test_add_sparse_sparse_stays_sparse(self):
         out = sparse.add(coo(), coo())
-        np.testing.assert_array_equal(out.numpy(), [[0, 4, 0], [2, 0, 6]])
+        assert isinstance(out, sparse.SparseCooTensor)
+        np.testing.assert_array_equal(out.to_dense().numpy(),
+                                      [[0, 4, 0], [2, 0, 6]])
+        # grads flow to both operands' values
+        a, b = coo(), coo()
+        a._values.stop_gradient = False
+        b._values.stop_gradient = False
+        sparse.add(a, b).to_dense().sum().backward()
+        assert a.values().grad is not None and b.values().grad is not None
+
+    def test_indices_paddle_layout_roundtrip(self):
+        sp = coo()
+        assert sp.indices().shape == [2, 3]   # [sparse_dim, nnz]
+        sp2 = sparse.sparse_coo_tensor(sp.indices().numpy(),
+                                       sp.values().numpy(), sp.shape)
+        np.testing.assert_array_equal(sp2.to_dense().numpy(),
+                                      sp.to_dense().numpy())
+
+    def test_add_type_config_rejects_non_linear(self):
+        cfg = QuantConfig()
+        with pytest.raises(NotImplementedError):
+            cfg.add_type_config(nn.Conv2D)
 
 
 class TestQuantization:
