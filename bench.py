@@ -97,6 +97,9 @@ def bench_train_step(cfg_kw, batch, seq, steps=10, amp=True):
     flops = model.flops_per_token(seq) * tokens
     peak, kind = device_peak()
     mfu = flops / step_time / peak
+    # pin the model for the decode bench only on SUCCESS — a failed
+    # candidate must be garbage-collected before the fallback allocates
+    bench_train_step.last_model = model
     return {
         "model": f"llama-h{cfg.hidden_size}-L{cfg.num_hidden_layers}",
         "n_params": model.num_params(),
@@ -109,6 +112,38 @@ def bench_train_step(cfg_kw, batch, seq, steps=10, amp=True):
         "compile_s": round(compile_s, 1),
         "device": kind,
         "peak_flops": peak,
+    }
+
+
+def bench_decode(model, batch=4, prompt=128, new_tokens=64):
+    """Static-KV-cache serving throughput: steady-state decode tok/s."""
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(
+        0, model.config.vocab_size, (batch, prompt)).astype(np.int64))
+    model.eval()
+    # warm both shapes (prefill + single-token step) to steady state
+    model.generate(ids, max_new_tokens=new_tokens)
+    model.generate(ids, max_new_tokens=new_tokens)
+    model.generate(ids, max_new_tokens=1)
+    t0 = time.perf_counter()
+    model.generate(ids, max_new_tokens=1)            # prefill-dominated
+    t_prefill = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    model.generate(ids, max_new_tokens=new_tokens)
+    t_full = time.perf_counter() - t0
+    model.train()
+    # steady-state decode: the extra (new_tokens - 1) steps beyond the
+    # prefill-only call
+    dt = max(t_full - t_prefill, 1e-9)
+    steps = new_tokens - 1
+    return {
+        "decode_batch": batch,
+        "decode_new_tokens": new_tokens,
+        "decode_prefill_ms": round(t_prefill * 1e3, 3),
+        "decode_tokens_per_sec": round(batch * steps / dt, 1),
+        "decode_ms_per_token": round(dt / steps * 1e3, 3),
     }
 
 
@@ -213,6 +248,16 @@ def main():
     except Exception as e:
         log(f"flash micro-bench failed: {e!r:.300}")
         result["flash_error"] = repr(e)[:200]
+
+    try:
+        model = bench_train_step.last_model
+        result.update(bench_decode(
+            model, batch=4 if on_tpu else 1,
+            prompt=128 if on_tpu else 16,
+            new_tokens=64 if on_tpu else 4))
+    except Exception as e:
+        log(f"decode bench failed: {e!r:.300}")
+        result["decode_error"] = repr(e)[:200]
 
     mfu = result["mfu"]
     line = {"metric": "llama_train_mfu", "value": mfu,

@@ -54,10 +54,12 @@ def _discover_state(fn, extra):
     from ..nn import Layer
     from ..optimizer import Optimizer
 
+    import types
+
     seen = set()
     layers, optimizers, tensors = [], [], []
 
-    def visit(obj):
+    def visit(obj, depth=0):
         if id(obj) in seen:
             return
         seen.add(id(obj))
@@ -70,13 +72,22 @@ def _discover_state(fn, extra):
         elif hasattr(obj, "__state_tensors__"):
             # stateful helpers (e.g. amp.GradScaler) expose their Tensors
             for t in obj.__state_tensors__():
-                visit(t)
+                visit(t, depth)
         elif isinstance(obj, (list, tuple)):
             for e in obj:
-                visit(e)
+                visit(e, depth)
         elif isinstance(obj, dict):
             for e in obj.values():
-                visit(e)
+                visit(e, depth)
+        elif depth < 2 and not isinstance(
+                obj, (types.ModuleType, types.FunctionType,
+                      types.MethodType, type, str, bytes, int, float,
+                      bool, complex)) and hasattr(obj, "__dict__"):
+            # plain container objects (a Trainer holding .model/.opt):
+            # scan one attribute level so state reached through object
+            # attributes is not silently missed (the stale-training trap)
+            for e in vars(obj).values():
+                visit(e, depth + 1)
 
     for obj in extra or ():
         visit(obj)
@@ -89,13 +100,18 @@ def _discover_state(fn, extra):
     if self_obj is not None:
         visit(self_obj)
     # module-level model/optimizer referenced as globals (the common script
-    # pattern): only names the function actually loads, to keep this cheap
+    # pattern): only names the function actually loads, to keep this cheap.
+    # visit() does the type filtering — including the holder-object
+    # attribute scan, so a module-level Trainer is discovered too
     code = getattr(fn, "__code__", None)
     if code is not None:
         g = getattr(fn, "__globals__", {})
         for name in code.co_names:
             obj = g.get(name)
-            if isinstance(obj, (Layer, Optimizer, Tensor, list, tuple, dict)):
+            if obj is not None and not isinstance(
+                    obj, (types.ModuleType, types.FunctionType,
+                          types.BuiltinFunctionType, type, str, bytes,
+                          int, float, bool)):
                 visit(obj)
     return layers, optimizers, tensors
 

@@ -11,9 +11,18 @@ from .llama import (  # noqa: F401
     LlamaForCausalLM, shard_llama, llama3_8b_config, tiny_llama_config,
 )
 from .llama_pipe import LlamaForCausalLMPipe  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForSequenceClassification,
+    BertForTokenClassification, ErnieModel,
+    ErnieForSequenceClassification, ernie_base_config, tiny_bert_config,
+)
 
 __all__ = [
     "LlamaConfig", "LlamaMLP", "LlamaAttention", "LlamaDecoderLayer",
     "LlamaModel", "LlamaForCausalLM", "shard_llama", "llama3_8b_config",
     "tiny_llama_config", "LlamaForCausalLMPipe",
+    "BertConfig", "BertModel", "BertForSequenceClassification",
+    "BertForTokenClassification", "ErnieModel",
+    "ErnieForSequenceClassification", "ernie_base_config",
+    "tiny_bert_config",
 ]

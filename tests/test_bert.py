@@ -1,0 +1,104 @@
+"""BERT/ERNIE encoder family: forward, finetune, masks, to_static.
+
+Reference bar: the BASELINE.md "ERNIE-3.0-base finetune functional
+parity" row — encoder path through nn.TransformerEncoder.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (BertForSequenceClassification,
+                               BertForTokenClassification, BertModel,
+                               ErnieForSequenceClassification,
+                               ernie_base_config, tiny_bert_config)
+
+
+def data(batch=4, seq=12, vocab=128, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, (batch, seq)).astype(np.int64)
+    labels = rng.randint(0, 2, (batch,)).astype(np.int64)
+    return paddle.to_tensor(ids), paddle.to_tensor(labels)
+
+
+def test_forward_shapes():
+    paddle.seed(0)
+    m = BertModel(tiny_bert_config())
+    ids, _ = data()
+    seq, pooled = m(ids)
+    assert seq.shape == [4, 12, 32]
+    assert pooled.shape == [4, 32]
+
+
+def test_ernie_base_config():
+    cfg = ernie_base_config()
+    assert cfg.hidden_size == 768 and cfg.num_hidden_layers == 12
+
+
+def test_padding_mask_blocks_attention():
+    paddle.seed(1)
+    m = BertModel(tiny_bert_config())
+    m.eval()
+    ids, _ = data(batch=1, seq=8)
+    mask = paddle.to_tensor(np.asarray([[1, 1, 1, 1, 0, 0, 0, 0]],
+                                       "int64"))
+    seq_a, _ = m(ids, attention_mask=mask)
+    # changing PAD tokens must not change the attended positions
+    ids2 = paddle.to_tensor(np.concatenate(
+        [ids.numpy()[:, :4], ids.numpy()[:, 4:] * 0 + 7], axis=1))
+    seq_b, _ = m(ids2, attention_mask=mask)
+    np.testing.assert_allclose(seq_a.numpy()[:, :4], seq_b.numpy()[:, :4],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_finetune_converges():
+    paddle.seed(2)
+    m = BertForSequenceClassification(tiny_bert_config(), num_classes=2)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-4,
+                                 parameters=m.parameters())
+    ids, labels = data()
+    losses = []
+    for _ in range(12):
+        loss, _ = m(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_token_classification_ignore_index():
+    paddle.seed(3)
+    m = BertForTokenClassification(tiny_bert_config(), num_classes=3)
+    ids, _ = data()
+    labels = np.random.RandomState(1).randint(0, 3, (4, 12))
+    labels[:, -3:] = -100
+    loss, logits = m(ids, labels=paddle.to_tensor(labels.astype(np.int64)))
+    assert logits.shape == [4, 12, 3]
+    assert np.isfinite(float(loss))
+    loss.backward()
+    assert m.classifier.weight.grad is not None
+
+
+def test_to_static_finetune_matches_eager():
+    ids, labels = data(seed=5)
+
+    def train(compiled):
+        paddle.seed(6)
+        m = ErnieForSequenceClassification(tiny_bert_config())
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+
+        def step(ids, labels):
+            loss, _ = m(ids, labels=labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        if compiled:
+            step = paddle.jit.to_static(step, state=[m, opt])
+        return [float(step(ids, labels)) for _ in range(4)]
+
+    np.testing.assert_allclose(train(False), train(True), rtol=2e-4,
+                               atol=2e-5)

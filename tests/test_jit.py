@@ -238,3 +238,68 @@ def test_jit_failed_warmup_does_not_mark_warm():
     first = float(step_jit(x, y))
     second = float(step_jit(x, y))  # now compiled
     assert np.isfinite(first) and np.isfinite(second)
+
+
+def test_jit_discovers_state_behind_object_attributes():
+    # the stale-training trap: model/optimizer reached only through a
+    # plain holder object's attributes must still be captured as state
+    class Trainer:
+        def __init__(self):
+            self.model, self.opt = make_model(13)
+
+    tr = Trainer()
+    m_ref, o_ref = make_model(13)
+
+    def step(x, y):
+        loss = ((tr.model(x) - y) ** 2).mean()
+        loss.backward()
+        tr.opt.step()
+        tr.opt.clear_grad()
+        return loss
+
+    def step_ref(x, y):
+        loss = ((m_ref(x) - y) ** 2).mean()
+        loss.backward()
+        o_ref.step()
+        o_ref.clear_grad()
+        return loss
+
+    compiled = jit.to_static(step)   # no explicit state=[...]
+    x, y = paddle.to_tensor(X), paddle.to_tensor(Y)
+    for _ in range(4):
+        lc = float(compiled(x, y))
+        le = float(step_ref(x, y))
+        np.testing.assert_allclose(lc, le, rtol=1e-5, atol=1e-6)
+    # the compiled steps actually moved the attribute-reachable weights
+    assert not np.allclose(tr.model[0].weight.numpy(),
+                           make_model(13)[0][0].weight.numpy())
+
+
+_global_trainer = None
+
+
+def test_jit_discovers_module_level_holder_object():
+    # module-level holder (the common script pattern): state reached as
+    # _global_trainer.model must be discovered through globals too
+    global _global_trainer
+
+    class Trainer:
+        def __init__(self):
+            self.model, self.opt = make_model(17)
+
+    _global_trainer = Trainer()
+
+    def step(x, y):
+        loss = ((_global_trainer.model(x) - y) ** 2).mean()
+        loss.backward()
+        _global_trainer.opt.step()
+        _global_trainer.opt.clear_grad()
+        return loss
+
+    compiled = jit.to_static(step)
+    x, y = paddle.to_tensor(X), paddle.to_tensor(Y)
+    losses = [float(compiled(x, y)) for _ in range(4)]
+    assert losses[-1] < losses[0]          # weights actually move
+    w = _global_trainer.model[0].weight.numpy()   # no leaked tracers
+    assert np.isfinite(w).all()
+    _global_trainer = None
