@@ -108,11 +108,18 @@ def _discover_state(fn, extra):
         g = getattr(fn, "__globals__", {})
         for name in code.co_names:
             obj = g.get(name)
-            if obj is not None and not isinstance(
+            if obj is None or isinstance(
                     obj, (types.ModuleType, types.FunctionType,
                           types.BuiltinFunctionType, type, str, bytes,
                           int, float, bool)):
-                visit(obj)
+                continue
+            mod = type(obj).__module__ or ""
+            if mod.split(".")[0] in ("numpy", "jax", "builtins"):
+                continue  # library objects are never training state
+            # co_names mixes globals with attribute names, so this scan
+            # can over-approximate; start holder objects at depth 1 (their
+            # DIRECT Layer/Optimizer/Tensor attrs only) to bound capture
+            visit(obj, depth=1)
     return layers, optimizers, tensors
 
 

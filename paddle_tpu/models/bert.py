@@ -95,7 +95,8 @@ class BertModel(nn.Layer):
             config.intermediate_size, dropout=config.hidden_dropout_prob,
             activation=config.hidden_act,
             attn_dropout=config.attention_probs_dropout_prob,
-            act_dropout=0.0, normalize_before=False)
+            act_dropout=0.0, normalize_before=False,
+            layer_norm_eps=config.layer_norm_eps)
         self.encoder = nn.TransformerEncoder(layer,
                                              config.num_hidden_layers)
         self.pooler = nn.Linear(config.hidden_size, config.hidden_size)

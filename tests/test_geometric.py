@@ -1,0 +1,105 @@
+"""paddle.geometric message passing over segment ops.
+
+Reference bar: `python/paddle/geometric/message_passing/send_recv.py` +
+`math.py` segment reductions.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.geometric as G
+
+
+def t(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+def ti(x):
+    return paddle.to_tensor(np.asarray(x, "int32"))
+
+
+class TestSegment:
+    def test_sum_mean_max_min(self):
+        data = t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        ids = ti([0, 0, 1])
+        np.testing.assert_array_equal(
+            G.segment_sum(data, ids).numpy(), [[4, 6], [5, 6]])
+        np.testing.assert_array_equal(
+            G.segment_mean(data, ids).numpy(), [[2, 3], [5, 6]])
+        np.testing.assert_array_equal(
+            G.segment_max(data, ids).numpy(), [[3, 4], [5, 6]])
+        np.testing.assert_array_equal(
+            G.segment_min(data, ids).numpy(), [[1, 2], [5, 6]])
+
+    def test_empty_segment_fills_zero(self):
+        data = t([[1.0], [2.0]])
+        ids = ti([0, 2])
+        out = G.segment_max(data, ids, num_segments=3).numpy()
+        np.testing.assert_array_equal(out, [[1], [0], [2]])
+
+    def test_segment_sum_grad(self):
+        data = t([[1.0], [2.0], [3.0]])
+        data.stop_gradient = False
+        out = G.segment_sum(data, ti([0, 1, 0]))
+        (out * t([[2.0], [3.0]])).sum().backward()
+        np.testing.assert_array_equal(data.grad.numpy(),
+                                      [[2], [3], [2]])
+
+
+class TestSendRecv:
+    def test_send_u_recv_sum(self):
+        x = t([[1.0], [2.0], [3.0]])
+        src, dst = ti([0, 1, 2]), ti([1, 2, 1])
+        out = G.send_u_recv(x, src, dst, "sum")
+        np.testing.assert_array_equal(out.numpy(), [[0], [4], [2]])
+
+    def test_send_u_recv_mean_matches_manual(self):
+        rng = np.random.RandomState(0)
+        x = t(rng.randn(5, 3))
+        src = ti([0, 1, 1, 4])
+        dst = ti([2, 2, 3, 3])
+        out = G.send_u_recv(x, src, dst, "mean").numpy()
+        xm = x.numpy()
+        np.testing.assert_allclose(out[2], (xm[0] + xm[1]) / 2, rtol=1e-6)
+        np.testing.assert_allclose(out[3], (xm[1] + xm[4]) / 2, rtol=1e-6)
+        np.testing.assert_array_equal(out[0], 0)
+
+    def test_send_ue_recv_and_send_uv(self):
+        x = t([[1.0], [2.0]])
+        e = t([[10.0], [20.0]])
+        src, dst = ti([0, 1]), ti([1, 0])
+        out = G.send_ue_recv(x, e, src, dst, "add", "sum")
+        np.testing.assert_array_equal(out.numpy(), [[22], [11]])
+        uv = G.send_uv(x, x, src, dst, "mul")
+        np.testing.assert_array_equal(uv.numpy(), [[2], [2]])
+
+    def test_gcn_layer_trains(self):
+        # one message-passing "GCN" layer fits a toy signal
+        paddle.seed(0)
+        rng = np.random.RandomState(1)
+        n, d = 12, 4
+        feats = t(rng.randn(n, d))
+        src = ti(rng.randint(0, n, 30))
+        dst = ti(rng.randint(0, n, 30))
+        from paddle_tpu.framework.tensor import Parameter
+        w = Parameter(rng.randn(d, 1).astype("float32") * 0.3)
+        target = t(rng.randn(n, 1))
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=[w])
+        first = last = None
+        for _ in range(40):
+            h = G.send_u_recv(paddle.matmul(feats, w), src, dst, "mean")
+            loss = ((h - target) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = float(loss) if first is None else first
+            last = float(loss)
+        assert last < first
+
+    def test_send_ue_recv_max_empty_fills_zero(self):
+        x = t([[1.0], [2.0]])
+        e = t([[5.0], [6.0]])
+        out = G.send_ue_recv(x, e, ti([0, 1]), ti([1, 1]), "add", "max",
+                             out_size=3)
+        np.testing.assert_array_equal(out.numpy(), [[0], [8], [0]])
