@@ -22,6 +22,7 @@ from .env import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, ParallelEnv, is_initialized,
 )
 from .mp_layers import (  # noqa: F401
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear,
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy,
 )

@@ -116,3 +116,74 @@ class ParallelCrossEntropy(nn.Layer):
     def forward(self, logits, labels):
         import paddle_tpu.nn.functional as F
         return F.cross_entropy(logits, labels, reduction="none")
+
+
+def _constrain(t, mesh, spec_dims):
+    """Tape-recorded sharding constraint (the TPU analog of the
+    reference's ScatterOp/AllGatherOp markers in
+    `fleet/utils/sequence_parallel_utils.py:85,111`)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from ..framework.tensor import run_op
+
+    ns = NamedSharding(mesh.to_jax_mesh(), PartitionSpec(*spec_dims))
+    return run_op("sharding_constraint",
+                  lambda a: jax.lax.with_sharding_constraint(a, ns), (t,))
+
+
+def _sp_spec(ndim, axis, kind):
+    """PartitionSpec dims for sequence-/head-sharded activations: 3-D
+    batch-major [B, S, H] or 2-D flattened [S(*B), H] (the layout the
+    reference's SP region uses)."""
+    if ndim == 3:
+        return (None, axis, None) if kind == "seq" else (None, None, axis)
+    if ndim == 2:
+        return (axis, None) if kind == "seq" else (None, axis)
+    raise ValueError(
+        f"sequence-parallel linear expects 2-D or 3-D activations, "
+        f"got rank {ndim}")
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Megatron-SP column linear (reference
+    `sequence_parallel_utils.py:395`): the incoming activation is
+    SEQUENCE-sharded over the mp axis; the matmul needs the full
+    sequence, so GSPMD inserts the all-gather the reference codes as
+    ``AllGatherOp`` — and the output leaves head-sharded for the paired
+    row layer."""
+
+    def __init__(self, in_features, out_features, mesh, axis_name="mp",
+                 weight_attr=None, has_bias=True, gather_output=False,
+                 name=None):
+        super().__init__(in_features, out_features, mesh, axis_name,
+                         weight_attr, has_bias, gather_output, name)
+        self._axis = axis_name
+
+    def forward(self, x):
+        x = _constrain(x, self.mesh, _sp_spec(x.ndim, self._axis, "seq"))
+        y = self.linear(x)
+        return _constrain(y, self.mesh,
+                          _sp_spec(y.ndim, self._axis, "head"))
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Megatron-SP row linear (reference
+    `sequence_parallel_utils.py:528`): input arrives head-sharded, the
+    contraction psum fuses with a scatter back to sequence-sharded
+    output — the reference's ``ReduceScatterOp``, emitted by GSPMD as
+    one reduce-scatter."""
+
+    def __init__(self, in_features, out_features, mesh, axis_name="mp",
+                 weight_attr=None, has_bias=True, input_is_parallel=True,
+                 name=None):
+        super().__init__(in_features, out_features, mesh, axis_name,
+                         weight_attr, has_bias, input_is_parallel, name)
+        self._axis = axis_name
+
+    def forward(self, x):
+        y = self.linear(x)
+        return _constrain(y, self.mesh,
+                          _sp_spec(y.ndim, self._axis, "seq"))
+
+
+__all__ += ["ColumnSequenceParallelLinear", "RowSequenceParallelLinear"]

@@ -24,20 +24,25 @@ _SEG = {
     "min": jax.ops.segment_min,
 }
 
+_COMBINE = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}
+
 
 def _reduce(msgs, ids, num_segments, op):
     """THE segment reduction (shared by every public op): paddle
     semantics — mean divides by counts, empty max/min segments fill 0
-    (jax fills +-inf)."""
-    counts = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids,
-                                 num_segments)
-    counts = counts.reshape((-1,) + (1,) * (msgs.ndim - 1))
+    (jax fills +-inf). Counts only computed when the op needs them."""
+    def counts():
+        c = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids,
+                                num_segments)
+        return c.reshape((-1,) + (1,) * (msgs.ndim - 1))
+
     if op == "mean":
         return jax.ops.segment_sum(msgs, ids, num_segments) \
-            / jnp.maximum(counts, 1.0)
+            / jnp.maximum(counts(), 1.0)
     out = _SEG[op](msgs, ids, num_segments)
     if op in ("max", "min"):
-        out = jnp.where(counts == 0, jnp.zeros_like(out), out)
+        out = jnp.where(counts() == 0, jnp.zeros_like(out), out)
     return out
 
 
@@ -94,8 +99,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
     (reference send_ue_recv)."""
     n = out_size if out_size is not None else (
         x.shape[0] if isinstance(x, Tensor) else jnp.asarray(x).shape[0])
-    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
-               "div": jnp.divide}[message_op]
+    combine = _COMBINE[message_op]
 
     def fn(xa, ya, s, d):
         return _reduce(combine(xa[s], ya), d, n, reduce_op)
@@ -105,8 +109,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
 
 def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     """Edge messages from both endpoints (reference send_uv)."""
-    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
-               "div": jnp.divide}[message_op]
+    combine = _COMBINE[message_op]
 
     def fn(xa, ya, s, d):
         return combine(xa[s], ya[d])

@@ -113,6 +113,10 @@ def _discover_state(fn, extra):
                           types.BuiltinFunctionType, type, str, bytes,
                           int, float, bool)):
                 continue
+            if isinstance(obj, (Layer, Optimizer, Tensor, list, tuple,
+                                dict)):
+                visit(obj)        # direct state / containers: full scan
+                continue
             mod = type(obj).__module__ or ""
             if mod.split(".")[0] in ("numpy", "jax", "builtins"):
                 continue  # library objects are never training state

@@ -1,0 +1,152 @@
+"""paddle.fft, paddle.audio features, Megatron-SP layers.
+
+Reference bars: `python/paddle/fft.py`; `python/paddle/audio/features/
+layers.py`; `fleet/utils/sequence_parallel_utils.py:395,528`.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, audio
+from paddle_tpu.distributed import (ProcessMesh, Shard, Replicate,
+                                    shard_tensor,
+                                    ColumnSequenceParallelLinear,
+                                    RowSequenceParallelLinear)
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(8, 16).astype("float32"))
+        X = fft.fft(x)
+        back = fft.ifft(X)
+        np.testing.assert_allclose(np.real(back.numpy()), x.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        x = np.random.RandomState(1).randn(4, 32).astype("float32")
+        got = fft.rfft(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, np.fft.rfft(x), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_fft2_and_shift(self):
+        x = np.random.RandomState(2).randn(4, 8, 8).astype("float32")
+        got = fft.fftshift(fft.fft2(paddle.to_tensor(x)),
+                           axes=(-2, -1)).numpy()
+        ref = np.fft.fftshift(np.fft.fft2(x), axes=(-2, -1))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(fft.fftfreq(8, 0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5), rtol=1e-6)
+
+    def test_spectral_loss_differentiable(self):
+        x = paddle.to_tensor(np.random.RandomState(3)
+                             .randn(2, 64).astype("float32"),
+                             stop_gradient=False)
+        loss = fft.rfft(x).abs().sum()
+        loss.backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+class TestAudio:
+    def test_spectrogram_matches_manual_stft(self):
+        sr, n_fft, hop = 8000, 128, 64
+        t = np.arange(sr // 4) / sr
+        sig = np.sin(2 * np.pi * 1000 * t).astype("float32")[None]
+        spec = audio.Spectrogram(n_fft=n_fft, hop_length=hop,
+                                 center=False, power=2.0)
+        out = spec(paddle.to_tensor(sig)).numpy()[0]
+        assert out.shape[0] == n_fft // 2 + 1
+        # energy concentrates at the 1 kHz bin
+        peak_bin = out.mean(axis=1).argmax()
+        assert abs(peak_bin - round(1000 * n_fft / sr)) <= 1
+
+    def test_mel_shapes_and_fbank(self):
+        fb = audio.compute_fbank_matrix(16000, 512, n_mels=40)
+        assert fb.shape == [40, 257]
+        assert float(fb.numpy().min()) >= 0
+        mel = audio.MelSpectrogram(sr=16000, n_fft=512, n_mels=40)
+        sig = paddle.to_tensor(np.random.RandomState(0)
+                               .randn(2, 16000).astype("float32"))
+        out = mel(sig)
+        assert out.shape[:2] == [2, 40]
+
+    def test_log_mel_and_mfcc(self):
+        sig = paddle.to_tensor(np.random.RandomState(1)
+                               .randn(1, 8000).astype("float32"))
+        lm = audio.LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(sig)
+        assert np.isfinite(lm.numpy()).all()
+        mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)(sig)
+        assert mfcc.shape[:2] == [1, 13]
+
+    def test_hz_mel_roundtrip(self):
+        freqs = np.asarray([100.0, 440.0, 4000.0])
+        np.testing.assert_allclose(
+            audio.mel_to_hz(audio.hz_to_mel(freqs)), freqs, rtol=1e-5)
+
+
+class TestSequenceParallel:
+    def test_sp_pair_matches_dense(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["mp"])
+        paddle.seed(0)
+        col = ColumnSequenceParallelLinear(16, 32, mesh, has_bias=False)
+        row = RowSequenceParallelLinear(32, 16, mesh, has_bias=False)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 8, 16).astype("float32"))
+        xs = shard_tensor(x, mesh, [Shard(1)])       # sequence-sharded
+        out = row(col(xs).relu())
+        # dense reference with the same weights
+        ref = np.maximum(
+            x.numpy() @ col.linear.weight.numpy(), 0.0) \
+            @ row.linear.weight.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4,
+                                   atol=1e-5)
+        # output returns sequence-sharded for the surrounding SP region
+        assert out._data.sharding.spec[1] == "mp"
+
+    def test_sp_training_matches_dense(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["mp"])
+        x_np = np.random.RandomState(1).randn(2, 8, 16).astype("float32")
+
+        def train(sp):
+            paddle.seed(5)
+            if sp:
+                col = ColumnSequenceParallelLinear(16, 32, mesh,
+                                                   has_bias=False)
+                row = RowSequenceParallelLinear(32, 16, mesh,
+                                                has_bias=False)
+                x = shard_tensor(paddle.to_tensor(x_np), mesh, [Shard(1)])
+            else:
+                col = paddle.nn.Linear(16, 32, bias_attr=False)
+                row = paddle.nn.Linear(32, 16, bias_attr=False)
+                x = paddle.to_tensor(x_np)
+            params = list(col.parameters()) + list(row.parameters())
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=params)
+            losses = []
+            for _ in range(4):
+                loss = (row(col(x).relu()) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            return losses
+
+        np.testing.assert_allclose(train(False), train(True), rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_sp_2d_flattened_layout(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["mp"])
+        paddle.seed(2)
+        col = ColumnSequenceParallelLinear(16, 32, mesh, has_bias=False)
+        row = RowSequenceParallelLinear(32, 16, mesh, has_bias=False)
+        x = paddle.to_tensor(np.random.RandomState(3)
+                             .randn(16, 16).astype("float32"))
+        xs = shard_tensor(x, mesh, [Shard(0)])     # [tokens, hidden]
+        out = row(col(xs).relu())
+        ref = np.maximum(x.numpy() @ col.linear.weight.numpy(), 0.0) \
+            @ row.linear.weight.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+        assert out._data.sharding.spec[0] == "mp"

@@ -303,3 +303,30 @@ def test_jit_discovers_module_level_holder_object():
     w = _global_trainer.model[0].weight.numpy()   # no leaked tracers
     assert np.isfinite(w).all()
     _global_trainer = None
+
+
+_global_param_list = None
+
+
+def test_jit_discovers_module_level_container_globals():
+    # regression: the library-module filter must not swallow builtin
+    # containers — a module-level [w] list is training state
+    global _global_param_list
+    from paddle_tpu.framework.tensor import Parameter
+    w = Parameter(np.asarray([[1.0], [2.0]], "float32"))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    _global_param_list = [w, opt]
+
+    def step(x):
+        loss = (paddle.matmul(x, _global_param_list[0]) ** 2).mean()
+        loss.backward()
+        _global_param_list[1].step()
+        _global_param_list[1].clear_grad()
+        return loss
+
+    compiled = jit.to_static(step)
+    x = paddle.to_tensor(np.ones((4, 2), "float32"))
+    losses = [float(compiled(x)) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    assert np.isfinite(w.numpy()).all()
+    _global_param_list = None
