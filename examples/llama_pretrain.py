@@ -56,6 +56,9 @@ def main():
                     help="checkpoint every decoder layer")
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--data", default=None,
+                    help="flat binary int32 token file (io.TokenFeed, "
+                         "C++ prefetch); default: synthetic random ids")
     args = ap.parse_args()
 
     import jax
@@ -105,10 +108,18 @@ def main():
                                     warmup="once")
 
     rng = np.random.RandomState(0)
+    feed = None
+    if args.data:
+        from paddle_tpu.io import TokenFeed
+        feed = TokenFeed(args.data, sample_elems=seq + 1,
+                         batch_size=args.batch, dtype=np.int32, seed=0)
 
     def batch():
-        ids = rng.randint(0, cfg.vocab_size,
-                          (args.batch, seq + 1)).astype(np.int64)
+        if feed is not None:
+            ids = next(feed).astype(np.int64)
+        else:
+            ids = rng.randint(0, cfg.vocab_size,
+                              (args.batch, seq + 1)).astype(np.int64)
         x = paddle.to_tensor(ids[:, :-1])
         y = paddle.to_tensor(ids[:, 1:])
         if mesh is not None and "dp" in mesh.dim_names:

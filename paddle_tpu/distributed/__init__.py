@@ -38,8 +38,10 @@ from . import auto_tuner  # noqa: F401
 from .watchdog import StepWatchdog, ElasticManager, FileStore  # noqa: F401
 from .pipeline import pipeline_spmd  # noqa: F401
 from . import collective  # noqa: F401
+from ..native import TCPStore  # noqa: F401  (C++ rendezvous store)
 
 __all__ = [
+    "TCPStore",
     "ProcessMesh", "get_mesh", "set_mesh", "init_mesh",
     "Placement", "Shard", "Replicate", "Partial",
     "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",

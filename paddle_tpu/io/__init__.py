@@ -12,8 +12,10 @@ from .sampler import (  # noqa: F401
     SubsetRandomSampler, BatchSampler, DistributedBatchSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .token_feed import TokenFeed, PyTokenFeed  # noqa: F401
 
 __all__ = [
+    "TokenFeed", "PyTokenFeed",
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "ConcatDataset", "Subset", "random_split",
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",

@@ -1,0 +1,233 @@
+"""Tests for the C++ native runtime (paddle_tpu.native).
+
+TCPStore semantics mirror the reference's rendezvous store
+(`phi/core/distributed/store/tcp_store.h:121`): blocking get/wait,
+atomic add, counter barrier — exercised here across threads and across
+real processes. TokenFeed mirrors the C++ feed-thread contract
+(`fluid/framework/data_feed.cc`): every sample visited once per epoch,
+deterministic under a seed, drop-last.
+"""
+
+import multiprocessing
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.io import PyTokenFeed, TokenFeed
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native library unavailable: {native.build.load_error()}")
+
+
+@pytest.fixture
+def store():
+    master = native.TCPStore(is_master=True)
+    yield master
+    master.close()
+
+
+class TestTCPStore:
+    def test_set_get_roundtrip(self, store):
+        store.set("k", b"\x00\x01binary\xff")
+        assert store.get("k") == b"\x00\x01binary\xff"
+        store.set("k", "overwritten")  # str values encode to bytes
+        assert store.get("k") == b"overwritten"
+
+    def test_empty_value(self, store):
+        store.set("empty", b"")
+        assert store.get("empty") == b""
+
+    def test_second_client_sees_masters_keys(self, store):
+        worker = native.TCPStore(port=store.port)
+        store.set("from_master", b"a")
+        assert worker.get("from_master") == b"a"
+        worker.set("from_worker", b"b")
+        assert store.get("from_worker") == b"b"
+        worker.close()
+
+    def test_get_blocks_until_set(self, store):
+        worker = native.TCPStore(port=store.port)
+        out = []
+        t = threading.Thread(target=lambda: out.append(
+            worker.get("late_key", timeout=10)))
+        t.start()
+        time.sleep(0.1)
+        assert not out, "get returned before the key existed"
+        store.set("late_key", b"now")
+        t.join(5)
+        assert out == [b"now"]
+        worker.close()
+
+    def test_get_timeout(self, store):
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            store.get("never_set", timeout=0.2)
+        assert time.monotonic() - t0 < 5
+
+    def test_wait_timeout_and_success(self, store):
+        with pytest.raises(TimeoutError):
+            store.wait("missing", timeout=0.2)
+        store.set("present", b"x")
+        store.wait(["present"], timeout=1)  # returns without raising
+
+    def test_add_is_atomic_across_threads(self, store):
+        clients = [native.TCPStore(port=store.port) for _ in range(4)]
+        per_thread = 25
+
+        def bump(c):
+            for _ in range(per_thread):
+                c.add("counter", 1)
+
+        ts = [threading.Thread(target=bump, args=(c,)) for c in clients]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert store.add("counter", 0) == 4 * per_thread
+        [c.close() for c in clients]
+
+    def test_add_negative_delta(self, store):
+        store.add("n", 10)
+        assert store.add("n", -3) == 7
+
+    def test_delete_and_numkeys(self, store):
+        base = store.num_keys()
+        store.set("a", b"1")
+        store.set("b", b"2")
+        assert store.num_keys() == base + 2
+        assert store.delete_key("a")
+        assert not store.delete_key("a")
+        assert store.num_keys() == base + 1
+
+    def test_barrier_releases_all(self, store):
+        n = 3
+        clients = [native.TCPStore(port=store.port) for _ in range(n)]
+        released = []
+
+        def arrive(i):
+            clients[i].barrier(n, tag="b0", timeout=10)
+            released.append(i)
+
+        ts = [threading.Thread(target=arrive, args=(i,)) for i in range(n)]
+        ts[0].start()
+        time.sleep(0.1)
+        assert not released, "barrier released before all arrived"
+        [t.start() for t in ts[1:]]
+        [t.join(5) for t in ts]
+        assert sorted(released) == list(range(n))
+        [c.close() for c in clients]
+
+    def test_close_with_live_idle_client_does_not_hang(self):
+        master = native.TCPStore(is_master=True)
+        worker = native.TCPStore(port=master.port)
+        worker.set("x", b"1")
+        t0 = time.monotonic()
+        master.close()  # worker still connected and idle
+        assert time.monotonic() - t0 < 5, "server close hung on live client"
+        worker.close()
+
+    def test_hostname_connect(self, store):
+        worker = native.TCPStore(host="localhost", port=store.port)
+        store.set("via_hostname", b"yes")
+        assert worker.get("via_hostname") == b"yes"
+        worker.close()
+
+    def test_connect_failure_then_gc_is_clean(self):
+        with pytest.raises(TimeoutError):
+            native.TCPStore(host="127.0.0.1", port=1, timeout=0.3)
+        import gc
+        gc.collect()  # must not double-free a half-constructed store
+
+    def test_cross_process(self, store):
+        """Real multi-process rendezvous: workers count in, rank 0
+        publishes, all read — the bootstrap pattern of
+        `distributed/parallel.py:943`."""
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        world = 3
+        ps = [ctx.Process(target=_worker_body,
+                          args=(store.port, r, world, q))
+              for r in range(world)]
+        [p.start() for p in ps]
+        results = [q.get(timeout=60) for _ in range(world)]
+        [p.join(10) for p in ps]
+        assert sorted(r[0] for r in results) == list(range(world))
+        assert all(r[1] == b"coordinator-payload" for r in results)
+
+
+def _worker_body(port, rank, world, q):
+    os.environ["PADDLE_TPU_WORKER"] = "1"
+    from paddle_tpu import native as n
+    c = n.TCPStore(port=port, timeout=30)
+    c.barrier(world, tag="boot")
+    if rank == 0:
+        c.set("payload", b"coordinator-payload")
+    val = c.get("payload", timeout=30)
+    q.put((rank, val))
+    c.close()
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    path = tmp_path / "tokens.bin"
+    np.arange(1200, dtype=np.int32).tofile(path)
+    return path
+
+
+class TestTokenFeed:
+    def test_unshuffled_order_is_file_order(self, corpus):
+        feed = TokenFeed(corpus, sample_elems=12, batch_size=5,
+                         shuffle=False, epochs=1)
+        assert feed.batches_per_epoch == 20
+        batches = list(feed)
+        assert len(batches) == 20
+        assert batches[0].shape == (5, 12)
+        flat = np.concatenate(batches).ravel()
+        np.testing.assert_array_equal(flat, np.arange(1200, dtype=np.int32))
+
+    def test_each_epoch_visits_every_sample_once(self, corpus):
+        feed = TokenFeed(corpus, 12, 5, shuffle=True, seed=3, epochs=2)
+        batches = list(feed)
+        assert len(batches) == 40
+        for epoch in (batches[:20], batches[20:]):
+            firsts = sorted(int(b[i, 0]) for b in epoch
+                            for i in range(b.shape[0]))
+            assert firsts == [12 * i for i in range(100)]
+
+    def test_seed_determinism(self, corpus):
+        a = list(TokenFeed(corpus, 12, 5, shuffle=True, seed=9, epochs=1))
+        b = list(TokenFeed(corpus, 12, 5, shuffle=True, seed=9, epochs=1))
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_drop_last(self, tmp_path):
+        path = tmp_path / "odd.bin"
+        np.arange(130, dtype=np.int64).tofile(path)  # 13 samples of 10
+        feed = TokenFeed(path, 10, 4, dtype=np.int64, shuffle=False,
+                         epochs=1)
+        assert feed.batches_per_epoch == 3  # 13 // 4, last partial dropped
+        assert len(list(feed)) == 3
+
+    def test_too_small_raises(self, tmp_path):
+        path = tmp_path / "tiny.bin"
+        np.arange(8, dtype=np.int32).tofile(path)
+        with pytest.raises(ValueError):
+            TokenFeed(path, 10, 4)
+
+    def test_python_fallback_same_contract(self, corpus):
+        feed = PyTokenFeed(corpus, 12, 5, shuffle=True, seed=3, epochs=1)
+        batches = list(feed)
+        assert len(batches) == 20
+        firsts = sorted(int(b[i, 0]) for b in batches
+                        for i in range(b.shape[0]))
+        assert firsts == [12 * i for i in range(100)]
+
+    def test_infinite_epochs_keeps_yielding(self, corpus):
+        feed = TokenFeed(corpus, 12, 5, shuffle=True, seed=0, epochs=-1)
+        for _ in range(45):  # past two epoch boundaries
+            b = next(feed)
+            assert b.shape == (5, 12)
+        feed.close()
