@@ -42,7 +42,10 @@ def defop(name=None, differentiable=True, method=False, method_name=None,
             return run_op(opname, fn, args, kwargs, differentiable=differentiable)
 
         OPS[opname] = {"fn": fn, "wrapper": wrapper,
-                       "differentiable": differentiable}
+                       "differentiable": differentiable,
+                       "method": (method_name or opname) if method else None,
+                       "inplace": inplace_method,
+                       "module": fn.__module__}
         if method:
             attach_tensor_method(method_name or opname, wrapper)
         if inplace_method:
