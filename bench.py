@@ -203,6 +203,52 @@ def bench_flash(batch=4, seq=2048, heads=16, kv_heads=8, dim=128, iters=20):
     }
 
 
+def bench_paged(batch=8, heads=16, kv_heads=8, dim=128, page=64,
+                ctx=2048, iters=50):
+    """Paged-attention decode kernel vs XLA gather path, on device."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import paged_attention as PA
+
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    max_pages = ctx // page
+    num_pages = batch * max_pages + 8
+    q = jnp.asarray(rng.randn(batch, heads, dim), dt)
+    kp = jnp.asarray(rng.randn(num_pages, page, kv_heads, dim), dt)
+    vp = jnp.asarray(rng.randn(num_pages, page, kv_heads, dim), dt)
+    perm = rng.permutation(num_pages)[:batch * max_pages]
+    tables = jnp.asarray(perm.reshape(batch, max_pages), jnp.int32)
+    lens = jnp.asarray(
+        rng.randint(ctx // 2, ctx + 1, (batch,)), jnp.int32)
+
+    def timeit(f):
+        g = jax.jit(f)
+        out = g(q, kp, vp, tables, lens)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g(q, kp, vp, tables, lens)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3, out
+
+    def pallas_path(q, kp, vp, tables, lens):
+        return PA._paged_impl(q, kp, vp, tables, lens,
+                              scale=1.0 / float(np.sqrt(dim)))
+
+    pallas_ms, o_p = timeit(pallas_path)
+    xla_ms, o_x = timeit(PA.paged_attention_xla)
+    err = float(jnp.max(jnp.abs(o_p.astype(jnp.float32)
+                                - o_x.astype(jnp.float32))))
+    scale = float(jnp.max(jnp.abs(o_x.astype(jnp.float32))))
+    return {
+        "paged_pallas_ms": round(pallas_ms, 3),
+        "paged_xla_ms": round(xla_ms, 3),
+        "paged_speedup": round(xla_ms / pallas_ms, 3),
+        "paged_parity_ok": bool(err < 0.05 * max(scale, 1.0)),
+    }
+
+
 # (config kwargs, batch, seq) from largest to smallest; the first that
 # completes on this chip wins (HBM-driven fallback)
 CANDIDATES = [
@@ -248,6 +294,16 @@ def main():
     except Exception as e:
         log(f"flash micro-bench failed: {e!r:.300}")
         result["flash_error"] = repr(e)[:200]
+
+    try:
+        if on_tpu:
+            result.update(bench_paged())
+        else:
+            result.update(bench_paged(batch=2, heads=4, kv_heads=2, dim=32,
+                                      page=8, ctx=64, iters=2))
+    except Exception as e:
+        log(f"paged bench failed: {e!r:.300}")
+        result["paged_error"] = repr(e)[:200]
 
     try:
         model = bench_train_step.last_model

@@ -13,7 +13,9 @@ import numpy as np
 
 from ..framework.tensor import Tensor
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+from .paged_cache import PagedKVCache  # noqa: F401
+
+__all__ = ["Config", "Predictor", "create_predictor", "PagedKVCache"]
 
 
 class Config:
