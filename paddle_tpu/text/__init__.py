@@ -1,0 +1,8 @@
+"""``paddle.text`` (reference: `python/paddle/text/__init__.py`):
+Viterbi decoding + classic NLP datasets."""
+
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+from .datasets import UCIHousing, Imdb, Imikolov  # noqa: F401
+
+__all__ = ["ViterbiDecoder", "viterbi_decode",
+           "UCIHousing", "Imdb", "Imikolov"]

@@ -1,0 +1,202 @@
+"""Text datasets (reference: `python/paddle/text/datasets/`).
+
+The reference auto-downloads corpora; this build runs with zero egress,
+so every dataset takes ``data_file`` pointing at the same archive the
+reference would download (formats identical — an aclImdb tar for
+:class:`Imdb`, the simple-examples PTB tar for :class:`Imikolov`, the
+whitespace table for :class:`UCIHousing`). Parsing, vocabulary building,
+and example layout match the reference classes cited per dataset.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov"]
+
+
+class UCIHousing(Dataset):
+    """Boston-housing regression table (reference
+    `text/datasets/uci_housing.py`): 14 whitespace-separated columns,
+    features mean-centered and range-normalized over the full table,
+    80/20 train/test split."""
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        if data_file is None:
+            raise ValueError(
+                "data_file is required (no network in this build): pass "
+                "the housing.data table the reference downloads")
+        self.data_file = data_file
+        self._load_data()
+
+    def _load_data(self, feature_num=14, ratio=0.8):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maxs, mins, avgs = (data.max(0), data.min(0),
+                            data.sum(0) / data.shape[0])
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (row[:-1].astype("float32"), row[-1:].astype("float32"))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment corpus from the aclImdb tar (reference
+    `text/datasets/imdb.py`): vocabulary of words with frequency >
+    ``cutoff`` over train+test, docs as id arrays, label 0=pos 1=neg."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        if data_file is None:
+            raise ValueError(
+                "data_file is required (no network in this build): pass "
+                "the aclImdb_v1.tar.gz archive the reference downloads")
+        self.data_file = data_file
+        self.word_idx = self._build_word_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, pattern):
+        docs = []
+        with tarfile.open(self.data_file) as tarf:
+            member = tarf.next()
+            while member is not None:
+                if pattern.match(member.name):
+                    docs.append(
+                        tarf.extractfile(member).read()
+                        .rstrip(b"\n\r")
+                        .translate(None,
+                                   string.punctuation.encode("latin-1"))
+                        .lower().split())
+                member = tarf.next()
+        return docs
+
+    def _build_word_dict(self, cutoff):
+        freq = collections.defaultdict(int)
+        pattern = re.compile(
+            r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for doc in self._tokenize(pattern):
+            for w in doc:
+                freq[w] += 1
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        # keys are bytes (tar payload); the reference mixes a str '<unk>'
+        # into a bytes vocab — uniform bytes here
+        word_idx[b"<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx[b"<unk>"]
+        self.docs, self.labels = [], []
+        for label, sub in ((0, "pos"), (1, "neg")):
+            pattern = re.compile(rf"aclImdb/{self.mode}/{sub}/.*\.txt$")
+            for doc in self._tokenize(pattern):
+                self.docs.append(
+                    [self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language-model corpus from the simple-examples tar (reference
+    `text/datasets/imikolov.py`): vocabulary over train+valid with
+    ``<s>``/``<e>`` markers, examples as N-grams or (src, trg) pairs."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=False):
+        if data_type.upper() not in ("NGRAM", "SEQ"):
+            raise ValueError(
+                f"data_type should be 'NGRAM' or 'SEQ', got {data_type}")
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.data_type = data_type.upper()
+        self.mode = mode.lower()
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        if data_file is None:
+            raise ValueError(
+                "data_file is required (no network in this build): pass "
+                "the simple-examples.tgz archive the reference downloads")
+        self.data_file = data_file
+        self.word_idx = self._build_word_dict(min_word_freq)
+        self._load_anno()
+
+    @staticmethod
+    def _word_count(f, freq=None):
+        freq = freq if freq is not None else collections.defaultdict(int)
+        for line in f:
+            for w in line.strip().split():
+                freq[w] += 1
+            freq[b"<s>"] += 1
+            freq[b"<e>"] += 1
+        return freq
+
+    def _build_word_dict(self, cutoff):
+        with tarfile.open(self.data_file) as tf:
+            freq = self._word_count(
+                tf.extractfile("./simple-examples/data/ptb.valid.txt"),
+                self._word_count(
+                    tf.extractfile("./simple-examples/data/ptb.train.txt")))
+        freq.pop(b"<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx[b"<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        self.data = []
+        unk = self.word_idx[b"<unk>"]
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(
+                f"./simple-examples/data/ptb.{self.mode}.txt")
+            for line in f:
+                if self.data_type == "NGRAM":
+                    if self.window_size < 0:
+                        raise ValueError("NGRAM needs window_size > 0")
+                    toks = [b"<s>"] + line.strip().split() + [b"<e>"]
+                    if len(toks) < self.window_size:
+                        continue
+                    ids = [self.word_idx.get(w, unk) for w in toks]
+                    for i in range(self.window_size, len(ids) + 1):
+                        self.data.append(
+                            tuple(ids[i - self.window_size:i]))
+                else:
+                    ids = [self.word_idx.get(w, unk)
+                           for w in line.strip().split()]
+                    src = [self.word_idx[b"<s>"]] + ids
+                    trg = ids + [self.word_idx[b"<e>"]]
+                    if 0 < self.window_size < len(src):
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,209 @@
+"""paddle.text (viterbi + datasets) and incubate.asp tests.
+
+Viterbi is checked against a brute-force path enumeration (the
+reference's own test oracle style, `test/legacy_test/test_viterbi_decode_op.py`);
+datasets parse synthetic archives laid out exactly like the corpora the
+reference downloads.
+"""
+
+import io
+import itertools
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import text
+from paddle_tpu.incubate import asp
+
+
+# ---------------------------------------------------------------------------
+# viterbi
+# ---------------------------------------------------------------------------
+def _brute_force(pot, trans, length, include_tag):
+    """Enumerate all tag paths; return (best_score, best_path)."""
+    n = pot.shape[-1]
+    best = (-np.inf, None)
+    for path in itertools.product(range(n), repeat=length):
+        score = pot[0, path[0]] + (trans[-1, path[0]] if include_tag else 0)
+        for t in range(1, length):
+            score += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if include_tag:
+            score += trans[path[-1], -2]
+        if score > best[0]:
+            best = (score, path)
+    return best
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("include_tag", [False, True])
+    def test_matches_brute_force(self, include_tag):
+        rng = np.random.RandomState(0)
+        b, l, n = 3, 5, 4
+        pot = rng.randn(b, l, n).astype("float32")
+        trans = rng.randn(n, n).astype("float32")
+        lengths = np.array([5, 3, 1], "int64")
+        scores, paths = text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=include_tag)
+        scores, paths = scores.numpy(), paths.numpy()
+        assert paths.shape == (b, 5)  # truncated to max(lengths)
+        for i in range(b):
+            want_score, want_path = _brute_force(
+                pot[i], trans, int(lengths[i]), include_tag)
+            np.testing.assert_allclose(scores[i], want_score, rtol=1e-5)
+            np.testing.assert_array_equal(
+                paths[i, :lengths[i]], want_path)
+            assert (paths[i, lengths[i]:] == 0).all()
+
+    def test_decoder_layer_wrapper(self):
+        rng = np.random.RandomState(1)
+        trans = rng.randn(3, 3).astype("float32")
+        dec = text.ViterbiDecoder(paddle.to_tensor(trans),
+                                  include_bos_eos_tag=False)
+        pot = paddle.to_tensor(rng.randn(2, 4, 3).astype("float32"))
+        lens = paddle.to_tensor(np.array([4, 4], "int64"))
+        scores, paths = dec(pot, lens)
+        assert tuple(paths.shape) == (2, 4)
+
+
+# ---------------------------------------------------------------------------
+# datasets
+# ---------------------------------------------------------------------------
+def _make_imdb_tar(path):
+    docs = {
+        "aclImdb/train/pos/0.txt": b"a good good film",
+        "aclImdb/train/neg/0.txt": b"a bad film, truly bad!",
+        "aclImdb/test/pos/0.txt": b"good",
+        "aclImdb/test/neg/0.txt": b"bad bad bad",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+def _make_ptb_tar(path):
+    files = {
+        "./simple-examples/data/ptb.train.txt":
+            b"the cat sat\nthe dog sat\n",
+        "./simple-examples/data/ptb.valid.txt": b"the cat ran\n",
+        "./simple-examples/data/ptb.test.txt": b"the dog ran\n",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+class TestDatasets:
+    def test_uci_housing_split_and_normalization(self, tmp_path):
+        rng = np.random.RandomState(0)
+        table = rng.rand(50, 14) * 10
+        f = tmp_path / "housing.data"
+        np.savetxt(f, table, fmt="%.6f")
+        train = text.UCIHousing(data_file=str(f), mode="train")
+        test = text.UCIHousing(data_file=str(f), mode="test")
+        assert len(train) == 40 and len(test) == 10
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # features are centered over the WHOLE table
+        allx = np.concatenate([np.stack([train[i][0] for i in range(40)]),
+                               np.stack([test[i][0] for i in range(10)])])
+        np.testing.assert_allclose(allx.mean(0), 0.0, atol=1e-5)
+
+    def test_imdb_vocab_and_labels(self, tmp_path):
+        f = tmp_path / "aclImdb_v1.tar.gz"
+        _make_imdb_tar(f)
+        ds = text.Imdb(data_file=str(f), mode="train", cutoff=1)
+        # words with freq > 1 over train+test: good(3), bad(5), a(2), film(2)
+        assert set(ds.word_idx) == {b"a", b"bad", b"film", b"good",
+                                    b"<unk>"}
+        assert len(ds) == 2
+        docs = {tuple(ds[i][0].tolist()): int(ds[i][1][0])
+                for i in range(2)}
+        assert set(docs.values()) == {0, 1}  # one pos, one neg
+
+    def test_imikolov_ngram(self, tmp_path):
+        f = tmp_path / "simple-examples.tgz"
+        _make_ptb_tar(f)
+        ds = text.Imikolov(data_file=str(f), data_type="NGRAM",
+                           window_size=2, mode="train", min_word_freq=0)
+        # each train line "the X sat" -> <s> the X sat <e> -> 4 bigrams
+        assert len(ds) == 8
+        ex = ds[0]
+        assert len(ex) == 2 and all(isinstance(v, np.ndarray) for v in ex)
+
+    def test_imikolov_seq(self, tmp_path):
+        f = tmp_path / "simple-examples.tgz"
+        _make_ptb_tar(f)
+        ds = text.Imikolov(data_file=str(f), data_type="SEQ",
+                           window_size=-1, mode="test", min_word_freq=0)
+        src, trg = ds[0]
+        assert src[0] == ds.word_idx[b"<s>"]
+        assert trg[-1] == ds.word_idx[b"<e>"]
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+
+    def test_requires_data_file(self):
+        with pytest.raises(ValueError, match="data_file is required"):
+            text.UCIHousing()
+
+
+# ---------------------------------------------------------------------------
+# ASP
+# ---------------------------------------------------------------------------
+class TestASP:
+    def setup_method(self, _):
+        asp._reset_state()
+
+    def test_get_mask_1d_pattern(self):
+        rng = np.random.RandomState(0)
+        mat = rng.randn(6, 12)
+        mask = asp.get_mask_1d(mat, 2, 4)
+        assert asp.check_mask_1d(mat * mask, 2, 4)
+        # exactly 2 of every 4 kept, and they are the largest-|.| two
+        chunks = np.abs(mat).reshape(6, 3, 4)
+        kept = mask.reshape(6, 3, 4).astype(bool)
+        for r in range(6):
+            for c in range(3):
+                top2 = set(np.argsort(chunks[r, c])[-2:])
+                assert set(np.where(kept[r, c])[0]) == top2
+
+    def test_prune_model_halves_density(self):
+        paddle.seed(0)
+        model = paddle.nn.Sequential(paddle.nn.Linear(16, 8),
+                                     paddle.nn.Linear(8, 4))
+        dens = asp.prune_model(model, n=2, m=4)
+        assert len(dens) == 2
+        assert all(abs(d - 0.5) < 1e-6 for d in dens.values())
+        w = np.asarray(model[0].weight._data)
+        assert asp.check_mask_1d(w.T, 2, 4)
+
+    def test_decorated_optimizer_preserves_mask(self):
+        paddle.seed(0)
+        model = paddle.nn.Linear(16, 8)
+        asp.prune_model(model, n=2, m=4)
+        before = np.asarray(model.weight._data).copy()
+        opt = asp.decorate(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=model.parameters()))
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 16).astype("float32"))
+        for _ in range(3):
+            loss = (model(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        after = np.asarray(model.weight._data)
+        assert asp.check_mask_1d(after.T, 2, 4)
+        assert not np.allclose(before, after)
+
+    def test_excluded_layers_skipped(self):
+        model = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+        asp.set_excluded_layers(["0.weight"])
+        dens = asp.prune_model(model)
+        assert dens == {}
+        asp.reset_excluded_layers()
+        assert len(asp.prune_model(model)) == 1

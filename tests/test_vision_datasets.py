@@ -1,0 +1,76 @@
+"""Cifar dataset tests: real-archive parsing (synthesized archive in the
+reference's exact layout) + the synthetic no-network fallback."""
+
+import io
+import pickle
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.vision.datasets import Cifar10, Cifar100
+
+
+def _cifar10_archive(path, n=20):
+    rng = np.random.RandomState(0)
+    with tarfile.open(path, "w:gz") as tf:
+        for name in [f"cifar-10-batches-py/data_batch_{i}"
+                     for i in range(1, 6)] + \
+                ["cifar-10-batches-py/test_batch"]:
+            payload = pickle.dumps({
+                b"data": rng.randint(0, 256, (n, 3072), dtype=np.uint8),
+                b"labels": rng.randint(0, 10, (n,)).tolist(),
+            })
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+
+
+def _cifar100_archive(path, n=20):
+    rng = np.random.RandomState(0)
+    with tarfile.open(path, "w:gz") as tf:
+        for name in ["cifar-100-python/train", "cifar-100-python/test"]:
+            payload = pickle.dumps({
+                b"data": rng.randint(0, 256, (n, 3072), dtype=np.uint8),
+                b"fine_labels": rng.randint(0, 100, (n,)).tolist(),
+            })
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+
+
+def test_cifar10_archive_parsing(tmp_path):
+    f = tmp_path / "cifar-10-python.tar.gz"
+    _cifar10_archive(f)
+    train = Cifar10(data_file=str(f), mode="train")
+    test = Cifar10(data_file=str(f), mode="test")
+    assert len(train) == 100 and len(test) == 20  # 5 batches vs 1
+    img, label = train[0]
+    assert img.shape == (3, 32, 32) and img.dtype == np.float32
+    assert label.shape == (1,) and 0 <= int(label) < 10
+
+
+def test_cifar100_archive_parsing(tmp_path):
+    f = tmp_path / "cifar-100-python.tar.gz"
+    _cifar100_archive(f)
+    ds = Cifar100(data_file=str(f), mode="train")
+    assert len(ds) == 20
+    _, label = ds[0]
+    assert 0 <= int(label) < 100
+
+
+def test_synthetic_fallback_is_learnable_split():
+    train = Cifar10(mode="train")
+    test = Cifar10(mode="test")
+    assert len(train) == 2000 and len(test) == 500
+    labels = {int(train[i][1]) for i in range(100)}
+    assert len(labels) > 3  # shuffled, multiple classes present
+    # deterministic across constructions
+    a = Cifar10(mode="train")[0][0]
+    b = Cifar10(mode="train")[0][0]
+    np.testing.assert_array_equal(a, b)
+
+
+def test_transform_hook():
+    ds = Cifar10(mode="test", transform=lambda img: img / 255.0)
+    img, _ = ds[0]
+    assert float(img.max()) <= 1.0

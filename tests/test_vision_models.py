@@ -1,0 +1,61 @@
+"""Vision model zoo forward/backward checks (reference test style:
+`test/legacy_test/test_vision_models.py` — build each family, forward a
+small image, check logits shape; backward on a representative subset).
+Small inputs + smallest width multipliers keep CPU compile time sane.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+
+def _img(n=1, size=64):
+    return paddle.to_tensor(
+        np.random.RandomState(0).randn(n, 3, size, size).astype("float32"))
+
+
+def _check_forward(model, x, want_shape):
+    model.eval()
+    out = model(x)
+    assert tuple(out.shape) == want_shape, (type(model).__name__, out.shape)
+    return out
+
+
+def test_alexnet_and_squeezenet():
+    _check_forward(M.alexnet(num_classes=10), _img(size=80), (1, 10))
+    _check_forward(M.squeezenet1_1(num_classes=10), _img(), (1, 10))
+
+
+def test_mobilenet_v1_v3():
+    _check_forward(M.mobilenet_v1(scale=0.25, num_classes=10), _img(),
+                   (1, 10))
+    _check_forward(M.mobilenet_v3_small(num_classes=10), _img(), (1, 10))
+
+
+def test_shufflenet_backward():
+    net = M.shufflenet_v2_x0_25(num_classes=10)
+    net.eval()
+    out = net(_img())
+    assert tuple(out.shape) == (1, 10)
+    (out ** 2).mean().backward()
+    grads = [p.grad for p in net.parameters() if p.trainable]
+    assert grads and all(g is not None for g in grads)
+
+
+def test_densenet():
+    _check_forward(M.DenseNet(layers=121, num_classes=10), _img(), (1, 10))
+
+
+def test_googlenet_aux_heads():
+    g = M.googlenet(num_classes=10)
+    g.eval()
+    out, a1, a2 = g(_img())
+    assert tuple(out.shape) == (1, 10) and a1 is None and a2 is None
+    g.train()
+    out, a1, a2 = g(_img())
+    assert tuple(a1.shape) == (1, 10) and tuple(a2.shape) == (1, 10)
+
+
+def test_inception_v3():
+    _check_forward(M.inception_v3(num_classes=10), _img(size=96), (1, 10))
