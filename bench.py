@@ -215,8 +215,8 @@ def bench_paged(batch=8, heads=16, kv_heads=8, dim=128, page=64,
     max_pages = ctx // page
     num_pages = batch * max_pages + 8
     q = jnp.asarray(rng.randn(batch, heads, dim), dt)
-    kp = jnp.asarray(rng.randn(num_pages, page, kv_heads, dim), dt)
-    vp = jnp.asarray(rng.randn(num_pages, page, kv_heads, dim), dt)
+    kp = jnp.asarray(rng.randn(num_pages, kv_heads, page, dim), dt)
+    vp = jnp.asarray(rng.randn(num_pages, kv_heads, page, dim), dt)
     perm = rng.permutation(num_pages)[:batch * max_pages]
     tables = jnp.asarray(perm.reshape(batch, max_pages), jnp.int32)
     lens = jnp.asarray(

@@ -1,0 +1,268 @@
+"""Continuous-batching serving engine for the Llama family.
+
+Reference capability: the reference's serving path — AnalysisPredictor +
+paged `block_multi_head_attention` / `masked_multihead_attention`
+kernels (`fluid/inference/api/analysis_predictor.h:100`,
+`phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu`). The
+reference has no in-tree continuous-batching scheduler; this engine goes
+beyond it (vLLM-style): requests are admitted and retired on the fly,
+every live sequence decodes one token per engine step in a single
+batched program, and KV lives in a shared paged pool so ragged contexts
+waste no HBM.
+
+Design (TPU-first):
+- ONE :class:`PageAllocator` shared by all layers (page structure is
+  identical per layer); per-layer K/V pools are device arrays updated
+  functionally.
+- Prefill runs the model's own submodules densely (flash/XLA attention)
+  while collecting post-rope K/V per layer, then scatters them into
+  pages — per request, compiled per prompt-length bucket.
+- The decode step is ONE ``to_static`` program of static shape
+  [max_batch]: embed → per layer (rms_norm → qkv → rope at per-row
+  positions → page write → Pallas ``paged_attention`` → o_proj →
+  swiglu MLP) → logits → greedy argmax. Inactive batch slots point at a
+  reserved trash page with length 1, so shapes never change and the
+  executable is reused for the engine's lifetime.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, no_grad, run_op
+from ..incubate.nn import functional as FI
+from ..nn import functional as F
+from ..ops.paged_attention import paged_attention
+from .paged_cache import PageAllocator
+
+__all__ = ["LlamaServingEngine", "Request"]
+
+
+def _page_write(pages, new, page_ids, offs):
+    """Functional scatter of ``new [B, Hk, D]`` into head-major ``pages
+    [P, Hk, page, D]`` at (page_ids[b], h, offs[b]) — one token per live
+    sequence."""
+    def fn(pages, new, page_ids, offs):
+        hidx = jnp.arange(pages.shape[1])[None, :]
+        return pages.at[page_ids[:, None], hidx, offs[:, None]].set(
+            new.astype(pages.dtype))
+
+    return run_op("paged_kv_write", fn, (pages, new, page_ids, offs),
+                  differentiable=False)
+
+
+class Request:
+    """One generation request (seq_id is assigned by the engine)."""
+
+    def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None):
+        self.prompt_ids = np.asarray(prompt_ids, np.int64).reshape(-1)
+        if self.prompt_ids.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.output_ids: list[int] = []
+        self.seq_id = None
+        self.done = False
+
+
+class LlamaServingEngine:
+    def __init__(self, model, max_batch=4, page_size=16, num_pages=128,
+                 max_pages_per_seq=None):
+        self.model = model
+        cfg = model.config
+        self.max_batch = max_batch
+        self.page_size = page_size
+        # page num_pages-1 is the trash page for inactive batch slots
+        self.alloc = PageAllocator(num_pages - 1, page_size,
+                                   max_pages_per_seq)
+        self.width = self.alloc.max_pages_per_seq
+        self.trash_page = num_pages - 1
+        dt = model.parameters()[0].dtype
+        hk, d = cfg.num_key_value_heads, cfg.head_dim
+        # head-major [P, Hk, page, D] — the Pallas kernel's tiling layout
+        shape = (num_pages, hk, page_size, d)
+        self.k_pools = [Tensor(jnp.zeros(shape, jnp.dtype(str(dt))))
+                        for _ in range(cfg.num_hidden_layers)]
+        self.v_pools = [Tensor(jnp.zeros(shape, jnp.dtype(str(dt))))
+                        for _ in range(cfg.num_hidden_layers)]
+        self._live: dict[int, Request] = {}
+        self._next_id = 0
+        self._decode_static = None
+
+    # ------------------------------------------------------------------
+    # prefill
+    # ------------------------------------------------------------------
+    def _prefill_forward(self, ids):
+        """Dense forward of one prompt [1, S]; returns (last-token id,
+        per-layer post-rope (k, v) [S, Hk, D])."""
+        from ..tensor import creation, search
+
+        m = self.model.model
+        cfg = self.model.config
+        b, s = ids.shape[0], ids.shape[1]
+        pos = creation.arange(0, s, dtype="int64").reshape([1, s])
+        x = m.embed_tokens(ids)
+        kvs = []
+        for layer in m.layers:
+            h = layer.input_layernorm(x)
+            att = layer.self_attn
+            q = att.q_proj(h).reshape([b, s, att.num_heads, att.head_dim])
+            k = att.k_proj(h).reshape([b, s, att.num_kv_heads, att.head_dim])
+            v = att.v_proj(h).reshape([b, s, att.num_kv_heads, att.head_dim])
+            q, k, v = FI.fused_rotary_position_embedding(
+                q, k, v, position_ids=pos, rotary_emb_base=cfg.rope_theta)
+            kvs.append((k[0], v[0]))
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            x = x + att.o_proj(out.reshape([b, s, -1]))
+            x = x + layer.mlp(layer.post_attention_layernorm(x))
+        x = m.norm(x)
+        logits = self.model._logits(x[:, -1:])
+        nxt = search.argmax(logits, axis=-1).astype("int64")
+        return nxt, kvs
+
+    def _prefill(self, req):
+        ids = Tensor(jnp.asarray(req.prompt_ids[None, :]))
+        with no_grad():
+            nxt, kvs = self._prefill_forward(ids)
+        seq_id = req.seq_id
+        page_ids, offs = self.alloc.page_positions(
+            seq_id, 0, len(req.prompt_ids))
+        hidx = np.arange(self.model.config.num_key_value_heads)[None, :]
+        for li, (k, v) in enumerate(kvs):
+            kp, vp = self.k_pools[li]._data, self.v_pools[li]._data
+            self.k_pools[li] = Tensor(kp.at[
+                page_ids[:, None], hidx, offs[:, None]].set(
+                k._data.astype(kp.dtype)))
+            self.v_pools[li] = Tensor(vp.at[
+                page_ids[:, None], hidx, offs[:, None]].set(
+                v._data.astype(vp.dtype)))
+        first = int(np.asarray(nxt._data).reshape(-1)[0])
+        self._emit(req, first)
+
+    # ------------------------------------------------------------------
+    # decode
+    # ------------------------------------------------------------------
+    def _decode_step(self, tokens, tables, lens, k_pools, v_pools):
+        """Batched one-token decode: pure in its inputs so ``to_static``
+        compiles it once. tokens [B, 1] int64; tables [B, W]; lens [B]."""
+        from ..tensor import search
+
+        m = self.model.model
+        cfg = self.model.config
+        b = tokens.shape[0]
+        pos = (lens.astype("int64") - 1).reshape([b, 1])
+        page_ids = self._gather_tables(tables, lens)
+        offs = (lens - 1).astype("int32") % self.page_size
+        x = m.embed_tokens(tokens)
+        new_k, new_v = [], []
+        for li, layer in enumerate(m.layers):
+            h = layer.input_layernorm(x)
+            att = layer.self_attn
+            q = att.q_proj(h).reshape([b, 1, att.num_heads, att.head_dim])
+            k = att.k_proj(h).reshape([b, 1, att.num_kv_heads, att.head_dim])
+            v = att.v_proj(h).reshape([b, 1, att.num_kv_heads, att.head_dim])
+            q, k, v = FI.fused_rotary_position_embedding(
+                q, k, v, position_ids=pos, rotary_emb_base=cfg.rope_theta)
+            kp = _page_write(k_pools[li], k[:, 0], page_ids, offs)
+            vp = _page_write(v_pools[li], v[:, 0], page_ids, offs)
+            new_k.append(kp)
+            new_v.append(vp)
+            attn = paged_attention(q[:, 0], kp, vp, tables, lens)
+            x = x + att.o_proj(attn.reshape([b, 1, -1]))
+            x = x + layer.mlp(layer.post_attention_layernorm(x))
+        x = m.norm(x)
+        logits = self.model._logits(x)
+        nxt = search.argmax(logits, axis=-1).astype("int64")
+        return nxt, new_k, new_v
+
+    def _gather_tables(self, tables, lens):
+        """Page id holding each row's current token:
+        ``tables[b, (len-1) // page_size]``."""
+        page = self.page_size
+
+        def fn(tables, lens):
+            b = tables.shape[0]
+            idx = (lens.astype(jnp.int32) - 1) // page
+            return tables[jnp.arange(b), idx]
+
+        return run_op("paged_table_gather", fn, (tables, lens),
+                      differentiable=False)
+
+    # ------------------------------------------------------------------
+    # scheduling
+    # ------------------------------------------------------------------
+    def add_request(self, req):
+        """Admit a request (prefill immediately). Returns its seq_id."""
+        if len(self._live) >= self.max_batch:
+            raise MemoryError(
+                f"engine full ({self.max_batch} live requests)")
+        req.seq_id = self._next_id
+        self._next_id += 1
+        self.alloc.admit(req.seq_id, len(req.prompt_ids))
+        self._live[req.seq_id] = req
+        self._prefill(req)
+        return req.seq_id
+
+    def _emit(self, req, token):
+        req.output_ids.append(token)
+        if (req.eos_token_id is not None and token == req.eos_token_id) \
+                or len(req.output_ids) >= req.max_new_tokens:
+            req.done = True
+            self.alloc.release(req.seq_id)
+            del self._live[req.seq_id]
+
+    def step(self):
+        """Decode one token for every live request. Returns the number of
+        live requests served."""
+        live = [r for r in self._live.values() if not r.done]
+        if not live:
+            return 0
+        # account the new token BEFORE building views: the write offset
+        # and the kernel's context length both include it
+        for r in live:
+            self.alloc.extend(r.seq_id, 1)
+        b = self.max_batch
+        tokens = np.zeros((b, 1), np.int64)
+        for i, r in enumerate(live):
+            tokens[i, 0] = r.output_ids[-1] if r.output_ids \
+                else r.prompt_ids[-1]
+        tables, lens = self.alloc.batch_views(
+            [r.seq_id for r in live], width=self.width,
+            fill_page=self.trash_page)
+        pad = b - len(live)
+        if pad:
+            tables = jnp.concatenate(
+                [tables, jnp.full((pad, self.width), self.trash_page,
+                                  jnp.int32)])
+            lens = jnp.concatenate([lens, jnp.ones((pad,), jnp.int32)])
+
+        if self._decode_static is None:
+            from .. import jit
+            self._decode_static = jit.to_static(
+                self._decode_step, state=[self.model])
+        nxt, new_k, new_v = self._decode_static(
+            Tensor(jnp.asarray(tokens)), Tensor(tables), Tensor(lens),
+            self.k_pools, self.v_pools)
+        self.k_pools, self.v_pools = list(new_k), list(new_v)
+        out = np.asarray(nxt._data).reshape(-1)
+        for i, r in enumerate(live):
+            self._emit(r, int(out[i]))
+        return len(live)
+
+    def generate(self, prompts, max_new_tokens=16, eos_token_id=None):
+        """Convenience batch API: admit all prompts (continuous batching
+        handles ragged finish times), run to completion, return output id
+        lists in order."""
+        reqs = [Request(p, max_new_tokens, eos_token_id) for p in prompts]
+        pending = list(reqs)
+        while pending or any(not r.done for r in reqs):
+            while pending and len(self._live) < self.max_batch:
+                self.add_request(pending.pop(0))
+            if not self.step() and pending:
+                continue
+            if not pending and all(r.done for r in reqs):
+                break
+        return [r.output_ids for r in reqs]

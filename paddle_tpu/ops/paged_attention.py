@@ -11,8 +11,11 @@ decode in one launch.
 
 Shapes:
   q             [B, H, D]           one new token per sequence
-  k_pages       [P, page_size, Hk, D]   global pool, any page owner
-  v_pages       [P, page_size, Hk, D]
+  k_pages       [P, Hk, page_size, D]   global pool, any page owner
+                                        (head-major: the Mosaic lowering
+                                        needs the last two block dims to
+                                        tile as (page, D))
+  v_pages       [P, Hk, page_size, D]
   block_tables  [B, max_pages] int32    page ids per sequence (row-major
                                         position order; unused tail
                                         entries may hold anything)
@@ -66,7 +69,7 @@ def supported(q, k_pages, v_pages, block_tables, context_lens):
     if len(qs) != 3 or len(ks) != 4 or len(bt) != 2 or len(cl) != 1:
         return False
     b, h, d = qs
-    p, page_size, hk, dk = ks
+    p, hk, page_size, dk = ks
     if getattr(v_pages, "_data", v_pages).shape != tuple(ks):
         return False
     if d != dk or hk == 0 or h % hk or bt[0] != b or cl[0] != b:
@@ -95,8 +98,8 @@ def _decode_kernel(tables_ref, lens_ref,  # scalar prefetch
     @pl.when(page_start < ctx)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * scale      # [G, D]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)        # [page, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)              # [page, D]
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         kpos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -129,12 +132,12 @@ def _make_paged(scale, page_size, group, interpret):
                 pl.BlockSpec((1, 1, g, d),
                              lambda bi, hi, pi, tables, lens: (bi, hi, 0, 0)),
                 # the prefetched block table picks the HBM page to stream
-                pl.BlockSpec((1, page_size, 1, d),
+                pl.BlockSpec((1, 1, page_size, d),
                              lambda bi, hi, pi, tables, lens:
-                             (tables[bi, pi], 0, hi, 0)),
-                pl.BlockSpec((1, page_size, 1, d),
+                             (tables[bi, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
                              lambda bi, hi, pi, tables, lens:
-                             (tables[bi, pi], 0, hi, 0)),
+                             (tables[bi, pi], hi, 0, 0)),
             ],
             out_specs=pl.BlockSpec(
                 (1, 1, g, d),
@@ -158,9 +161,9 @@ def _make_paged(scale, page_size, group, interpret):
 
 def _paged_impl(q, k_pages, v_pages, block_tables, context_lens, scale):
     b, h, d = q.shape
-    hk = k_pages.shape[2]
+    hk = k_pages.shape[1]
     group = h // hk
-    page_size = k_pages.shape[1]
+    page_size = k_pages.shape[2]
     q4 = q.reshape(b, hk, group, d)
     call = _make_paged(scale, page_size, group, _interpret())
     out = call(q4, k_pages, v_pages,
@@ -176,7 +179,7 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     if not supported(q, k_pages, v_pages, block_tables, context_lens):
         raise ValueError(
             "paged_attention preconditions not met: need q [B,H,D], pages "
-            "[P,page,Hk,D] (page % 8 == 0, D % 8 == 0, D <= 256, "
+            "[P,Hk,page,D] (page % 8 == 0, D % 8 == 0, D <= 256, "
             "H % Hk == 0), tables [B,max_pages], lens [B]")
     d = getattr(q, "_data", q).shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -198,12 +201,12 @@ def paged_attention_xla(q, k_pages, v_pages, block_tables, context_lens,
         getattr(a, "_data", a)
         for a in (q, k_pages, v_pages, block_tables, context_lens))
     b, h, d = q.shape
-    p, page_size, hk, _ = k_pages.shape
+    p, hk, page_size, _ = k_pages.shape
     group = h // hk
     s = scale if scale is not None else 1.0 / math.sqrt(d)
-    # [B, max_pages, page, Hk, D] -> [B, S, Hk, D]
-    k = k_pages[block_tables].reshape(b, -1, hk, d)
-    v = v_pages[block_tables].reshape(b, -1, hk, d)
+    # [B, max_pages, Hk, page, D] -> [B, S, Hk, D]
+    k = jnp.swapaxes(k_pages[block_tables], 2, 3).reshape(b, -1, hk, d)
+    v = jnp.swapaxes(v_pages[block_tables], 2, 3).reshape(b, -1, hk, d)
     kq = jnp.repeat(k, group, axis=2)
     vq = jnp.repeat(v, group, axis=2)
     logits = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),

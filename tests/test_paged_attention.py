@@ -42,8 +42,9 @@ class TestPagedKernel:
         self.rng = np.random.RandomState(0)
 
     def _pool(self, P=16, page=8, hk=2, d=32):
-        return (_rand(self.rng, P, page, hk, d),
-                _rand(self.rng, P, page, hk, d))
+        # head-major [P, Hk, page, D]
+        return (_rand(self.rng, P, hk, page, d),
+                _rand(self.rng, P, hk, page, d))
 
     def test_parity_vs_xla_reference_ragged(self):
         kp, vp = self._pool()
@@ -64,8 +65,8 @@ class TestPagedKernel:
         tables = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
         lens = jnp.asarray([27], jnp.int32)
         out = paged_attention(q, kp, vp, tables, lens).numpy()[0]
-        k_lin = np.asarray(kp).reshape(-1, 2, 32)
-        v_lin = np.asarray(vp).reshape(-1, 2, 32)
+        k_lin = np.asarray(kp).swapaxes(1, 2).reshape(-1, 2, 32)
+        v_lin = np.asarray(vp).swapaxes(1, 2).reshape(-1, 2, 32)
         ref = _naive(np.asarray(q[0]), k_lin, v_lin, 27,
                      1.0 / math.sqrt(32))
         np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
@@ -78,12 +79,13 @@ class TestPagedKernel:
         out = paged_attention(q, kp, vp, tables, lens).numpy()
         # with one valid key, attention output == that key's value row
         for b, page in enumerate([4, 11]):
-            want = np.repeat(np.asarray(vp)[page, 0], 4, axis=0)  # group=4
+            # first token of the page, all kv heads: [Hk, D] -> group-major
+            want = np.repeat(np.asarray(vp)[page, :, 0], 4, axis=0)
             np.testing.assert_allclose(out[b], want, rtol=1e-5, atol=1e-5)
 
     def test_mqa_single_kv_head(self):
-        kp = _rand(self.rng, 8, 8, 1, 16)
-        vp = _rand(self.rng, 8, 8, 1, 16)
+        kp = _rand(self.rng, 8, 1, 8, 16)
+        vp = _rand(self.rng, 8, 1, 8, 16)
         q = _rand(self.rng, 2, 6, 16)
         tables = jnp.asarray([[2, 5], [7, 1]], jnp.int32)
         lens = jnp.asarray([13, 16], jnp.int32)
