@@ -70,6 +70,7 @@ from . import geometric  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
 from . import text  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402

@@ -173,3 +173,83 @@ class _CudaShim:
 
 
 cuda = _CudaShim()
+
+
+# ---------------------------------------------------------------------------
+# memory statistics (reference: `fluid/memory/stats.cc` — allocated/reserved
+# current + peak per device; `paddle.device.cuda.max_memory_allocated`)
+# ---------------------------------------------------------------------------
+_peak_allocated: dict = {}
+
+
+def _device_obj(device=None):
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    return device
+
+
+def memory_stats(device=None):
+    """Raw allocator statistics for a device. On real TPU/GPU backends
+    this is the PJRT allocator report (``bytes_in_use``,
+    ``peak_bytes_in_use``, ``bytes_limit``, ...); where the backend does
+    not report (CPU, tunneled devices), live on-device arrays are summed
+    instead and the dict carries ``{"bytes_in_use": ..., "source":
+    "live_arrays"}``."""
+    d = _device_obj(device)
+    stats = None
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        return dict(stats)
+    in_use = sum(
+        x.nbytes for x in jax.live_arrays()
+        if any(dd == d for dd in x.devices()))
+    return {"bytes_in_use": in_use, "source": "live_arrays"}
+
+
+def memory_allocated(device=None):
+    """Bytes currently allocated on the device (reference
+    `paddle.device.cuda.memory_allocated`)."""
+    n = int(memory_stats(device).get("bytes_in_use", 0))
+    key = str(_device_obj(device))
+    _peak_allocated[key] = max(_peak_allocated.get(key, 0), n)
+    return n
+
+
+def max_memory_allocated(device=None):
+    """Peak allocated bytes: the allocator's own peak when reported,
+    else the running max over this process's ``memory_allocated`` calls."""
+    stats = memory_stats(device)
+    if "peak_bytes_in_use" in stats:
+        return int(stats["peak_bytes_in_use"])
+    key = str(_device_obj(device))
+    current = int(stats.get("bytes_in_use", 0))
+    _peak_allocated[key] = max(_peak_allocated.get(key, 0), current)
+    return _peak_allocated[key]
+
+
+def memory_reserved(device=None):
+    """Bytes reserved by the allocator (``bytes_limit`` when reported —
+    XLA preallocates; else equals allocated)."""
+    stats = memory_stats(device)
+    return int(stats.get("bytes_limit", stats.get("bytes_in_use", 0)))
+
+
+def reset_max_memory_allocated(device=None):
+    _peak_allocated[str(_device_obj(device))] = 0
+
+
+def empty_cache():
+    """Reference `paddle.device.cuda.empty_cache`. XLA's BFC allocator
+    serves frees internally; deleting dangling host references is the
+    only lever, so this triggers a GC pass."""
+    import gc
+    gc.collect()
+
+
+__all__ += ["memory_stats", "memory_allocated", "max_memory_allocated",
+            "memory_reserved", "reset_max_memory_allocated", "empty_cache"]

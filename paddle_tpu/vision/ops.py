@@ -1,0 +1,224 @@
+"""Detection ops (reference: `python/paddle/vision/ops.py` — nms:1867,
+roi_align:1640, roi_pool, box kernels in `phi/kernels/gpu/`).
+
+TPU-native notes: NMS's greedy suppression is an O(N^2) IoU matrix +
+a ``lax.fori_loop`` sweep (static shapes, no data-dependent Python);
+RoI align is vectorized bilinear gather-interpolation over a static
+sampling grid, so XLA fuses it into a few gathers + contractions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import run_op
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_iou"]
+
+
+def _iou_matrix(boxes):
+    """[N, 4] xyxy -> [N, N] IoU."""
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = (x2 - x1) * (y2 - y1)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU between two [N,4]/[M,4] xyxy sets -> [N, M]."""
+    def fn(a, b):
+        x1, y1, x2, y2 = (a[:, i] for i in range(4))
+        u1, v1, u2, v2 = (b[:, i] for i in range(4))
+        area_a = (x2 - x1) * (y2 - y1)
+        area_b = (u2 - u1) * (v2 - v1)
+        ix1 = jnp.maximum(x1[:, None], u1[None, :])
+        iy1 = jnp.maximum(y1[:, None], v1[None, :])
+        ix2 = jnp.minimum(x2[:, None], u2[None, :])
+        iy2 = jnp.minimum(y2[:, None], v2[None, :])
+        inter = jnp.maximum(ix2 - ix1, 0.0) * jnp.maximum(iy2 - iy1, 0.0)
+        union = area_a[:, None] + area_b[None, :] - inter
+        return jnp.where(union > 0, inter / union, 0.0)
+
+    return run_op("box_iou", fn, (boxes1, boxes2), differentiable=False)
+
+
+def _nms_kept_mask(boxes, iou_threshold):
+    """Greedy NMS on boxes already sorted by descending score; returns a
+    bool keep-mask. lax.fori_loop over rows: a row survives iff no
+    earlier surviving row overlaps it beyond the threshold."""
+    iou = _iou_matrix(boxes)
+    n = boxes.shape[0]
+
+    def body(i, keep):
+        # suppressed if any kept j < i has IoU > thr
+        over = (iou[i] > iou_threshold) & keep \
+            & (jnp.arange(n) < i)
+        return keep.at[i].set(~jnp.any(over))
+
+    return jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Reference `vision/ops.py:1867`. Returns indices of kept boxes
+    sorted by descending score (or input order when ``scores`` is None),
+    truncated to ``top_k``."""
+    def fn(boxes, scores, category_idxs):
+        n = boxes.shape[0]
+        order = jnp.arange(n) if scores is None \
+            else jnp.argsort(-scores)
+        sorted_boxes = boxes[order]
+        if category_idxs is None:
+            keep = _nms_kept_mask(sorted_boxes, iou_threshold)
+        else:
+            # batched NMS: offset each category's boxes to disjoint
+            # regions so cross-category IoU is 0 (standard trick — one
+            # kernel instead of a per-category loop)
+            cats = category_idxs[order].astype(sorted_boxes.dtype)
+            span = jnp.max(sorted_boxes) - jnp.min(sorted_boxes) + 1.0
+            shifted = sorted_boxes + (cats * span)[:, None]
+            keep = _nms_kept_mask(shifted, iou_threshold)
+        kept = order[jnp.where(keep, size=n, fill_value=-1)[0]]
+        kept = kept[jnp.where(kept >= 0, size=n, fill_value=-1)[0]]
+        count = int(jnp.sum(keep))
+        return kept[:count] if top_k is None \
+            else kept[:min(top_k, count)]
+
+    # host-side sizes: NMS output is inherently data-dependent, so this
+    # op runs eagerly (like the reference's CPU/GPU kernel returning a
+    # dynamic-size tensor)
+    return run_op("nms", fn, (boxes, scores, category_idxs),
+                  differentiable=False)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Reference `vision/ops.py:1640` (Mask R-CNN RoI Align). x [N,C,H,W];
+    boxes [R, 4] xyxy in input-image coordinates; boxes_num [N] ints
+    summing to R. Output [R, C, ph, pw]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def fn(x, boxes, boxes_num):
+        n, c, h, w = x.shape
+        r = boxes.shape[0]
+        # map each roi to its batch image
+        img_idx = jnp.repeat(jnp.arange(n), boxes_num, axis=0,
+                             total_repeat_length=r)
+        off = 0.5 if aligned else 0.0
+        bx = boxes * spatial_scale
+        x1, y1, x2, y2 = (bx[:, i] for i in range(4))
+        x1, y1 = x1 - off, y1 - off
+        x2, y2 = x2 - off, y2 - off
+        roi_w = x2 - x1
+        roi_h = y2 - y1
+        if not aligned:
+            roi_w = jnp.maximum(roi_w, 1.0)
+            roi_h = jnp.maximum(roi_h, 1.0)
+        bin_w = roi_w / pw
+        bin_h = roi_h / ph
+        s = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [R, ph, s] y coords and [R, pw, s] x coords
+        sy = (jnp.arange(ph)[None, :, None]
+              + (jnp.arange(s)[None, None, :] + 0.5) / s)
+        sx = (jnp.arange(pw)[None, :, None]
+              + (jnp.arange(s)[None, None, :] + 0.5) / s)
+        ys = y1[:, None, None] + sy * bin_h[:, None, None]   # [R, ph, s]
+        xs = x1[:, None, None] + sx * bin_w[:, None, None]   # [R, pw, s]
+
+        def bilinear(img, yy, xx):
+            """img [C, H, W]; yy [ph*s], xx [pw*s] -> [C, ph*s, pw*s]."""
+            y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+            y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+            wy1 = jnp.clip(yy - y0, 0.0, 1.0)
+            wx1 = jnp.clip(xx - x0, 0.0, 1.0)
+            wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+            # zero contribution for samples outside the feature map
+            valid_y = ((yy >= -1) & (yy <= h)).astype(img.dtype)
+            valid_x = ((xx >= -1) & (xx <= w)).astype(img.dtype)
+            g = lambda yi, xi: img[:, yi][:, :, xi]      # [C, len(y), len(x)]
+            out = (g(y0i, x0i) * (wy0 * valid_y)[None, :, None]
+                   * (wx0 * valid_x)[None, None, :]
+                   + g(y0i, x1i) * (wy0 * valid_y)[None, :, None]
+                   * (wx1 * valid_x)[None, None, :]
+                   + g(y1i, x0i) * (wy1 * valid_y)[None, :, None]
+                   * (wx0 * valid_x)[None, None, :]
+                   + g(y1i, x1i) * (wy1 * valid_y)[None, :, None]
+                   * (wx1 * valid_x)[None, None, :])
+            return out
+
+        def per_roi(ri):
+            img = x[img_idx[ri]]                        # [C, H, W]
+            yy = ys[ri].reshape(-1)                     # [ph*s]
+            xx = xs[ri].reshape(-1)                     # [pw*s]
+            vals = bilinear(img, yy, xx)                # [C, ph*s, pw*s]
+            vals = vals.reshape(c, ph, s, pw, s)
+            return jnp.mean(vals, axis=(2, 4))          # [C, ph, pw]
+
+        return jax.vmap(per_roi)(jnp.arange(r))
+
+    return run_op("roi_align", fn, (x, boxes, boxes_num))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Reference `vision/ops.py` roi_pool (max pooling per bin, Fast
+    R-CNN). Same layout as :func:`roi_align`."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def fn(x, boxes, boxes_num):
+        n, c, h, w = x.shape
+        r = boxes.shape[0]
+        img_idx = jnp.repeat(jnp.arange(n), boxes_num, axis=0,
+                             total_repeat_length=r)
+        bx = jnp.round(boxes * spatial_scale)
+        x1 = bx[:, 0].astype(jnp.int32)
+        y1 = bx[:, 1].astype(jnp.int32)
+        x2 = jnp.maximum(bx[:, 2].astype(jnp.int32), x1 + 1)
+        y2 = jnp.maximum(bx[:, 3].astype(jnp.int32), y1 + 1)
+
+        ww = jnp.arange(w)
+        hh = jnp.arange(h)
+
+        def per_roi(ri):
+            img = x[img_idx[ri]]
+            # bin edges (float) over the roi
+            ys = y1[ri] + (y2[ri] - y1[ri]) * jnp.arange(ph + 1) / ph
+            xs = x1[ri] + (x2[ri] - x1[ri]) * jnp.arange(pw + 1) / pw
+
+            def pool_bin(by, bx_):
+                y_lo = jnp.floor(ys[by]).astype(jnp.int32)
+                y_hi = jnp.ceil(ys[by + 1]).astype(jnp.int32)
+                x_lo = jnp.floor(xs[bx_]).astype(jnp.int32)
+                x_hi = jnp.ceil(xs[bx_ + 1]).astype(jnp.int32)
+                m = ((hh >= y_lo) & (hh < jnp.maximum(y_hi, y_lo + 1)))[
+                    :, None] & \
+                    ((ww >= x_lo) & (ww < jnp.maximum(x_hi, x_lo + 1)))[
+                    None, :]
+                m = m & (hh[:, None] < h) & (ww[None, :] < w)
+                return jnp.max(
+                    jnp.where(m[None], img, -jnp.inf), axis=(1, 2))
+
+            grid = jax.vmap(lambda by: jax.vmap(
+                lambda bx_: pool_bin(by, bx_))(jnp.arange(pw)))(
+                jnp.arange(ph))                          # [ph, pw, C]
+            return jnp.transpose(grid, (2, 0, 1))
+
+        return jax.vmap(per_roi)(jnp.arange(r))
+
+    return run_op("roi_pool", fn, (x, boxes, boxes_num))

@@ -363,6 +363,37 @@ class TestShardOptimizer:
         opt = dist.shard_optimizer(opt)
         assert opt._add_accumulator is wrapped  # no double-wrap
 
+    def test_shard_fn_overrides_accumulator_placement(self):
+        """The shard_fn hook (reference api.py:1120 ShardingStage* use it
+        to place optimizer state) must receive every accumulator and its
+        returned replacement must be the one the update consumes."""
+        m = mesh2x4()
+        lin = paddle.nn.Linear(16, 32)
+        lin.weight = dist.shard_tensor(lin.weight, m, [Replicate(), Shard(1)])
+        seen = []
+
+        def shard_fn(name, param, acc):
+            seen.append((name, tuple(param.shape)))
+            if name == "moment1" and tuple(acc.shape) == (16, 32):
+                # override: replicate moment1 instead of inheriting Shard(1)
+                return dist.shard_tensor(acc, m, [Replicate(), Replicate()])
+            return None  # keep default for everything else
+
+        opt = paddle.optimizer.Adam(parameters=lin.parameters())
+        opt = dist.shard_optimizer(opt, shard_fn)
+        x = paddle.ones([4, 16])
+        lin(x).sum().backward()
+        opt.step()
+        assert ("moment1", (16, 32)) in seen
+        mom1 = opt._get_accumulator("moment1", lin.weight)
+        mom2 = opt._get_accumulator("moment2", lin.weight)
+        assert mom1._placements == [Replicate(), Replicate()]
+        assert mom2._data.sharding.is_equivalent_to(
+            lin.weight._data.sharding, 2)
+        # training still works: a second step consumes the replaced state
+        lin(x).sum().backward()
+        opt.step()
+
 
 class TestEnv:
     def test_single_process_defaults(self):

@@ -1,0 +1,100 @@
+"""paddle.signal tests: STFT/ISTFT roundtrip (the reference's own test
+oracle, `test/legacy_test/test_signal.py`, checks against librosa; here
+numpy's FFT is the oracle) plus frame/overlap_add inverse-pair checks."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import signal
+
+
+def _x(b=2, n=1000, seed=0):
+    return np.random.RandomState(seed).randn(b, n).astype(np.float32)
+
+
+class TestStft:
+    def test_single_frame_equals_numpy_rfft(self):
+        x = _x(1, 256)[0]
+        got = signal.stft(paddle.to_tensor(x), 256, 256,
+                          center=False).numpy()
+        ref = np.fft.rfft(x)
+        assert got.shape == (129, 1)
+        np.testing.assert_allclose(got[:, 0], ref, rtol=1e-4, atol=1e-3)
+
+    def test_matches_manual_framing(self):
+        x = _x(1, 512)[0]
+        win = np.hanning(128).astype(np.float32)
+        got = signal.stft(paddle.to_tensor(x), 128, 64,
+                          window=paddle.to_tensor(win),
+                          center=False).numpy()
+        num = 1 + (512 - 128) // 64
+        assert got.shape == (65, num)
+        for t in range(num):
+            ref = np.fft.rfft(x[t * 64:t * 64 + 128] * win)
+            np.testing.assert_allclose(got[:, t], ref, rtol=1e-4,
+                                       atol=1e-3)
+
+    def test_batched_and_normalized(self):
+        x = _x(3, 600)
+        a = signal.stft(paddle.to_tensor(x), 128, 32).numpy()
+        b = signal.stft(paddle.to_tensor(x), 128, 32,
+                        normalized=True).numpy()
+        np.testing.assert_allclose(a / np.sqrt(128), b, rtol=1e-5,
+                                   atol=1e-5)
+        assert a.shape[0] == 3
+
+    def test_twosided(self):
+        x = _x(1, 256)
+        got = signal.stft(paddle.to_tensor(x), 64, 32,
+                          onesided=False).numpy()
+        assert got.shape[1] == 64
+
+
+class TestIstft:
+    def test_roundtrip_hann(self):
+        x = _x()
+        win = paddle.to_tensor(np.hanning(200).astype(np.float32))
+        spec = signal.stft(paddle.to_tensor(x), 256, 64, 200, win)
+        rec = signal.istft(spec, 256, 64, 200, win, length=1000).numpy()
+        np.testing.assert_allclose(rec, x, rtol=1e-4, atol=1e-4)
+
+    def test_roundtrip_default_window(self):
+        x = _x(1, 800)
+        spec = signal.stft(paddle.to_tensor(x), 128, 32)
+        rec = signal.istft(spec, 128, 32, length=800).numpy()
+        np.testing.assert_allclose(rec, x, rtol=1e-4, atol=1e-4)
+
+    def test_roundtrip_normalized(self):
+        x = _x(1, 512)
+        spec = signal.stft(paddle.to_tensor(x), 128, 32, normalized=True)
+        rec = signal.istft(spec, 128, 32, normalized=True,
+                           length=512).numpy()
+        np.testing.assert_allclose(rec, x, rtol=1e-4, atol=1e-4)
+
+
+class TestFrameOverlapAdd:
+    def test_frame_shapes_and_content(self):
+        x = _x(2, 300)
+        f = signal.frame(paddle.to_tensor(x), 64, 32).numpy()
+        num = 1 + (300 - 64) // 32
+        assert f.shape == (2, 64, num)
+        np.testing.assert_array_equal(f[:, :, 0], x[:, :64])
+        np.testing.assert_array_equal(f[:, :, 1], x[:, 32:96])
+
+    def test_overlap_add_doubles_interior(self):
+        x = _x(2, 1000)
+        f = signal.frame(paddle.to_tensor(x), 64, 32)
+        oa = signal.overlap_add(f, 32).numpy()
+        n = oa.shape[-1]            # (num-1)*hop + frame
+        assert n == ((1000 - 64) // 32) * 32 + 64
+        # interior samples are covered by exactly two frames
+        np.testing.assert_allclose(oa[:, 64:n - 64],
+                                   2 * x[:, 64:n - 64], atol=1e-5)
+
+    def test_gradient_through_stft(self):
+        x = paddle.to_tensor(_x(1, 256), stop_gradient=False)
+        spec = signal.stft(x, 64, 32)
+        mag = (spec.abs() ** 2).sum()
+        mag.backward()
+        assert x.grad is not None
+        assert float(np.abs(x.grad.numpy()).sum()) > 0

@@ -1,0 +1,157 @@
+"""vision.ops tests: NMS / RoI Align / RoI Pool vs independent numpy
+references (the reference's own op tests compare against numpy oracles,
+`test/legacy_test/test_nms_op.py` style)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def _np_iou(a, b):
+    ix1 = max(a[0], b[0])
+    iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2])
+    iy2 = min(a[3], b[3])
+    inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) \
+        - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def _np_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        rest = [j for j in order[1:] if _np_iou(boxes[i], boxes[j]) <= thr]
+        order = np.asarray(rest, dtype=order.dtype)
+    return np.asarray(keep)
+
+
+def _np_roi_align(x, boxes, img_idx, out, scale, ratio, aligned):
+    n, c, h, w = x.shape
+    ph = pw = out
+    res = np.zeros((len(boxes), c, ph, pw), np.float64)
+
+    def bilin(img, y, xq):
+        if y < -1.0 or y > h or xq < -1.0 or xq > w:
+            return np.zeros(c)
+        y = min(max(y, 0), h - 1)
+        xq = min(max(xq, 0), w - 1)
+        y0, x0 = int(np.floor(y)), int(np.floor(xq))
+        y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+        ly, lx = y - y0, xq - x0
+        return (img[:, y0, x0] * (1 - ly) * (1 - lx)
+                + img[:, y0, x1] * (1 - ly) * lx
+                + img[:, y1, x0] * ly * (1 - lx)
+                + img[:, y1, x1] * ly * lx)
+
+    off = 0.5 if aligned else 0.0
+    for r, box in enumerate(boxes):
+        img = x[img_idx[r]]
+        x1, y1, x2, y2 = box * scale
+        x1, y1, x2, y2 = x1 - off, y1 - off, x2 - off, y2 - off
+        rw, rh = x2 - x1, y2 - y1
+        if not aligned:
+            rw, rh = max(rw, 1.0), max(rh, 1.0)
+        bw, bh = rw / pw, rh / ph
+        s = ratio if ratio > 0 else 2
+        for py in range(ph):
+            for px in range(pw):
+                acc = np.zeros(c)
+                for iy in range(s):
+                    for ix in range(s):
+                        yy = y1 + (py + (iy + 0.5) / s) * bh
+                        xx = x1 + (px + (ix + 0.5) / s) * bw
+                        acc += bilin(img, yy, xx)
+                res[r, :, py, px] = acc / (s * s)
+    return res
+
+
+class TestNMS:
+    def test_matches_numpy_greedy(self):
+        rng = np.random.RandomState(0)
+        b = rng.rand(60, 2) * 20
+        wh = rng.rand(60, 2) * 15 + 1
+        boxes = np.concatenate([b, b + wh], axis=1).astype(np.float32)
+        scores = rng.rand(60).astype(np.float32)
+        got = ops.nms(paddle.to_tensor(boxes), 0.5,
+                      paddle.to_tensor(scores)).numpy()
+        want = _np_nms(boxes, scores, 0.5)
+        np.testing.assert_array_equal(got, want)
+
+    def test_without_scores_keeps_input_order(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                         np.float32)
+        got = ops.nms(paddle.to_tensor(boxes), 0.3).numpy()
+        np.testing.assert_array_equal(got, [0, 2])
+
+    def test_top_k(self):
+        rng = np.random.RandomState(1)
+        b = rng.rand(30, 2) * 50
+        boxes = np.concatenate([b, b + 5], axis=1).astype(np.float32)
+        scores = rng.rand(30).astype(np.float32)
+        full = ops.nms(paddle.to_tensor(boxes), 0.5,
+                       paddle.to_tensor(scores)).numpy()
+        top = ops.nms(paddle.to_tensor(boxes), 0.5,
+                      paddle.to_tensor(scores), top_k=3).numpy()
+        np.testing.assert_array_equal(top, full[:3])
+
+    def test_batched_categories_never_suppress_across(self):
+        # identical boxes in different categories must all survive
+        boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1], np.int64)
+        got = ops.nms(paddle.to_tensor(boxes), 0.3,
+                      paddle.to_tensor(scores),
+                      category_idxs=paddle.to_tensor(cats),
+                      categories=[0, 1]).numpy()
+        assert sorted(got.tolist()) == [0, 1]
+
+
+class TestRoiAlign:
+    @pytest.mark.parametrize("aligned", [True, False])
+    def test_matches_numpy_reference(self, aligned):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 16, 16).astype(np.float32)
+        boxes = np.array([[1, 1, 9, 9], [2, 3, 14, 12], [0, 0, 15, 15]],
+                         np.float32)
+        bn = np.array([2, 1], np.int64)
+        got = ops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                            paddle.to_tensor(bn), 7, spatial_scale=0.5,
+                            sampling_ratio=2, aligned=aligned).numpy()
+        want = _np_roi_align(x, boxes, [0, 0, 1], 7, 0.5, 2, aligned)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_gradient_flows_to_features(self):
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(1, 2, 8, 8).astype(np.float32),
+                             stop_gradient=False)
+        boxes = paddle.to_tensor(
+            np.array([[1, 1, 6, 6]], np.float32))
+        bn = paddle.to_tensor(np.array([1], np.int64))
+        out = ops.roi_align(x, boxes, bn, 4)
+        out.sum().backward()
+        assert x.grad is not None
+        assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+    def test_roi_pool_max_semantics(self):
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[0, 0, 2, 2] = 5.0
+        x[0, 0, 6, 6] = 7.0
+        boxes = np.array([[0, 0, 8, 8]], np.float32)
+        bn = np.array([1], np.int64)
+        out = ops.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                           paddle.to_tensor(bn), 2).numpy()
+        assert out[0, 0, 0, 0] == 5.0   # top-left quadrant max
+        assert out[0, 0, 1, 1] == 7.0   # bottom-right quadrant max
+
+    def test_box_iou(self):
+        a = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+        b = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15],
+                                       [20, 20, 30, 30]], np.float32))
+        iou = ops.box_iou(a, b).numpy()
+        np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], rtol=1e-5)
