@@ -114,6 +114,68 @@ class TestPipelineSpmd:
         np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
                                    rtol=1e-5, atol=1e-6)
 
+    def test_interleaved_matches_sequential(self):
+        """VPP (reference PipelineParallelWithInterleave,
+        `pipeline_parallel.py:987`): V chunks per device, wraparound
+        ring — output must equal the plain sequential composition."""
+        mesh = pp_mesh(4)
+        rng = np.random.RandomState(0)
+        L, D = 16, 16
+        ws = jnp.asarray(rng.randn(L, D, D) * 0.3, jnp.float32)
+        x = jnp.asarray(rng.randn(8, D), jnp.float32)
+
+        def stage(params, h):
+            def layer(h, w):
+                return jnp.tanh(h @ w), None
+            out, _ = jax.lax.scan(layer, h, params["w"])
+            return out
+
+        h = x
+        for i in range(L):
+            h = jnp.tanh(h @ ws[i])
+        for v, m in [(2, 4), (4, 4), (4, 8)]:
+            y = pipeline_spmd(stage, {"w": ws}, x, mesh=mesh, axis="pp",
+                              num_microbatches=m, num_virtual_stages=v)
+            np.testing.assert_allclose(np.asarray(y), np.asarray(h),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_interleaved_gradients_match(self):
+        mesh = pp_mesh(4)
+        rng = np.random.RandomState(1)
+        L, D = 8, 8
+        ws = jnp.asarray(rng.randn(L, D, D) * 0.3, jnp.float32)
+        x = jnp.asarray(rng.randn(4, D), jnp.float32)
+
+        def stage(params, h):
+            def layer(h, w):
+                return jnp.tanh(h @ w), None
+            out, _ = jax.lax.scan(layer, h, params["w"])
+            return out
+
+        def loss_pipe(ws):
+            y = pipeline_spmd(stage, {"w": ws}, x, mesh=mesh, axis="pp",
+                              num_microbatches=4, num_virtual_stages=2)
+            return jnp.sum(y ** 2)
+
+        def loss_seq(ws):
+            h = x
+            for i in range(L):
+                h = jnp.tanh(h @ ws[i])
+            return jnp.sum(h ** 2)
+
+        gp = jax.grad(loss_pipe)(ws)
+        gs = jax.grad(loss_seq)(ws)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_interleaved_requires_group_divisibility(self):
+        mesh = pp_mesh(4)
+        ws = jnp.zeros((8, 4, 4), jnp.float32)
+        with pytest.raises(ValueError, match="divisible by stages"):
+            pipeline_spmd(lambda p, h: h, {"w": ws}, jnp.zeros((6, 4)),
+                          mesh=mesh, axis="pp", num_microbatches=6,
+                          num_virtual_stages=2)
+
     def test_batch_not_divisible_raises(self):
         mesh = pp_mesh(2)
         params = {"w": jnp.zeros((2, 4, 4))}
