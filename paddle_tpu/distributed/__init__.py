@@ -39,6 +39,7 @@ from .watchdog import StepWatchdog, ElasticManager, FileStore  # noqa: F401
 from .pipeline import pipeline_spmd  # noqa: F401
 from . import collective  # noqa: F401
 from ..native import TCPStore  # noqa: F401  (C++ rendezvous store)
+from . import ps  # noqa: F401  (sparse parameter-server seam)
 
 __all__ = [
     "TCPStore",

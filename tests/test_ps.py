@@ -1,0 +1,127 @@
+"""Parameter-server seam tests (reference: `fluid/distributed/ps/` sparse
+tables + `ps/the_one_ps.py`): lazy rows, server-side updates, concurrent
+workers over the native TCPStore transport, cross-process pull/push."""
+
+import multiprocessing
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.distributed.ps import PSClient, PSServer, SparseTable
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native library unavailable: {native.build.load_error()}")
+
+
+class TestSparseTable:
+    def test_lazy_rows_deterministic(self):
+        t = SparseTable(dim=8, seed=3)
+        a = t.pull([5, 9, 5])
+        assert a.shape == (3, 8)
+        np.testing.assert_array_equal(a[0], a[2])  # same row
+        assert t.num_rows() == 2
+        b = SparseTable(dim=8, seed=3).pull([5, 9, 5])
+        np.testing.assert_array_equal(a, b)  # seeded init
+
+    def test_sgd_push(self):
+        t = SparseTable(dim=4, optimizer="sgd", lr=0.5)
+        before = t.pull([1])[0].copy()
+        g = np.full((1, 4), 2.0, np.float32)
+        t.push([1], g)
+        np.testing.assert_allclose(t.pull([1])[0], before - 1.0)
+
+    def test_duplicate_ids_accumulate(self):
+        t = SparseTable(dim=2, optimizer="sgd", lr=1.0)
+        before = t.pull([7])[0].copy()
+        t.push([7, 7], np.ones((2, 2), np.float32))
+        # one update with the SUMMED gradient, not two sequential ones
+        np.testing.assert_allclose(t.pull([7])[0], before - 2.0)
+
+    def test_adagrad(self):
+        t = SparseTable(dim=2, optimizer="adagrad", lr=1.0)
+        before = t.pull([0])[0].copy()
+        g = np.asarray([[3.0, 4.0]], np.float32)
+        t.push([0], g)
+        want = before - g[0] / (np.abs(g[0]) + 1e-10)
+        np.testing.assert_allclose(t.pull([0])[0], want, rtol=1e-5)
+
+
+class TestPSOverStore:
+    @pytest.fixture
+    def server(self):
+        s = PSServer({"emb": SparseTable(dim=8, seed=1, lr=0.1)})
+        yield s
+        s.stop()
+
+    def test_pull_push_roundtrip(self, server):
+        c = PSClient(port=server.port)
+        rows = c.pull("emb", [3, 1, 4])
+        assert rows.shape == (3, 8)
+        c.push("emb", [3], np.ones((1, 8), np.float32))
+        after = c.pull("emb", [3])
+        np.testing.assert_allclose(after[0], rows[0] - 0.1, rtol=1e-5)
+        assert c.num_rows("emb") == 3
+        c.close()
+
+    def test_unknown_table_reports_error(self, server):
+        c = PSClient(port=server.port)
+        with pytest.raises(RuntimeError, match="PS server error"):
+            c.pull("nope", [1])
+        # the dispatcher survives the error
+        assert c.pull("emb", [0]).shape == (1, 8)
+        c.close()
+
+    def test_concurrent_workers_interleave(self, server):
+        n_workers, n_ops = 4, 10
+        errs = []
+
+        def worker(wid):
+            try:
+                c = PSClient(port=server.port)
+                for i in range(n_ops):
+                    rid = wid * 100 + i
+                    c.pull("emb", [rid])
+                    c.push("emb", [rid],
+                           np.ones((1, 8), np.float32))
+                c.close()
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(w,))
+              for w in range(n_workers)]
+        [t.start() for t in ts]
+        [t.join(60) for t in ts]
+        assert not errs
+        c = PSClient(port=server.port)
+        assert c.num_rows("emb") == n_workers * n_ops
+        c.close()
+
+    def test_cross_process_worker(self, server):
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_ps_worker_body, args=(server.port, q))
+        p.start()
+        result = q.get(timeout=60)
+        p.join(10)
+        assert result == "ok"
+        c = PSClient(port=server.port)
+        row = c.pull("emb", [777])
+        # the other process pushed a unit gradient: row moved by -lr
+        assert abs(float(row.sum())) >= 0  # row exists server-side
+        assert c.num_rows("emb") >= 1
+        c.close()
+
+
+def _ps_worker_body(port, q):
+    from paddle_tpu.distributed.ps import PSClient
+    import numpy as np
+    c = PSClient(port=port, timeout=30)
+    before = c.pull("emb", [777])
+    c.push("emb", [777], np.ones((1, 8), np.float32))
+    after = c.pull("emb", [777])
+    ok = np.allclose(after, before - 0.1, rtol=1e-5)
+    q.put("ok" if ok else f"mismatch {before} {after}")
+    c.close()
