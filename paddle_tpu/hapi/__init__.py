@@ -312,3 +312,5 @@ def _metric_logs(m):
         return {names: vals}
     return dict(zip(names, vals if isinstance(vals, (list, tuple))
                     else [vals]))
+
+from .model_summary import summary, flops  # noqa: F401,E402

@@ -1,0 +1,64 @@
+"""paddle.summary / paddle.flops tests (reference:
+`test/legacy_test/test_model_summary.py` style — hook-collected layer
+table + FLOP rules checked against hand computations)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision.models import LeNet
+
+
+def test_summary_counts_match_parameters(capsys):
+    net = LeNet()
+    info = paddle.summary(net, (1, 1, 28, 28))
+    want = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert info["total_params"] == want
+    assert info["trainable_params"] == want
+    printed = capsys.readouterr().out
+    assert "Conv2D" in printed and "Linear" in printed
+    assert f"{want:,}" in printed
+
+
+def test_summary_respects_trainable_flag(capsys):
+    net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    net[0].weight.trainable = False
+    net[0].bias.trainable = False
+    info = paddle.summary(net, (2, 4))
+    assert info["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
+    assert info["trainable_params"] == 8 * 2 + 2
+
+
+def test_flops_linear_rule():
+    net = nn.Sequential(nn.Linear(16, 32))
+    n = paddle.flops(net, (4, 16))
+    assert n == 2 * 4 * 16 * 32
+
+
+def test_flops_conv_rule():
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1))
+    n = paddle.flops(net, (1, 3, 10, 10))
+    # out elems = 8*10*10; per elem: Cin*k*k MACs; FLOPs = 2*MACs
+    assert n == 2 * (8 * 10 * 10) * 3 * 9
+
+
+def test_flops_custom_op_override():
+    net = nn.Sequential(nn.Linear(4, 4))
+    n = paddle.flops(net, (1, 4),
+                     custom_ops={nn.Linear: lambda l, i, o: 123})
+    assert n == 123
+
+
+def test_flops_grouped_conv():
+    net = nn.Sequential(nn.Conv2D(8, 8, 3, padding=1, groups=8))
+    n = paddle.flops(net, (1, 8, 5, 5))
+    # depthwise: weight [8, 1, 3, 3] -> Cin/groups = 1
+    assert n == 2 * (8 * 5 * 5) * 1 * 9
+
+
+def test_summary_does_not_leave_hooks(capsys):
+    net = LeNet()
+    paddle.summary(net, (1, 1, 28, 28))
+    for _, sub in net.named_sublayers():
+        assert not sub._forward_post_hooks
+    assert net.training  # eval() during the probe, restored after
