@@ -1,0 +1,92 @@
+"""paddle.utils.cpp_extension tests (reference `test/cpp_extension/`):
+build a host C++ op with g++, bind via ctypes, numpy_op wrapper, cache
+behavior, and failure reporting."""
+
+import ctypes
+import shutil
+
+import numpy as np
+import pytest
+
+from paddle_tpu.utils import cpp_extension, try_import
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no g++ in PATH")
+
+GOOD_SRC = """
+#include <cstdint>
+#include <cmath>
+extern "C" void relu(const float* in, int64_t n, float* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = in[i] > 0 ? in[i] : 0.0f;
+}
+extern "C" double scale_sum(const double* in, int64_t n) {
+  double s = 0; for (int64_t i = 0; i < n; ++i) s += in[i];
+  return 2.0 * s;
+}
+"""
+
+
+@pytest.fixture
+def src(tmp_path):
+    f = tmp_path / "ops.cc"
+    f.write_text(GOOD_SRC)
+    return f
+
+
+def test_load_and_call(src, tmp_path):
+    ext = cpp_extension.load("t1", [src], build_directory=str(tmp_path))
+    arr = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+    f = ext.declare("scale_sum", ctypes.c_double, [arr, ctypes.c_int64])
+    x = np.arange(5, dtype=np.float64)
+    assert f(x, 5) == 2 * x.sum()
+
+
+def test_numpy_op_wrapper(src, tmp_path):
+    ext = cpp_extension.load("t2", [src], build_directory=str(tmp_path))
+    relu = cpp_extension.numpy_op(ext, "relu")
+    x = np.array([[-1.0, 2.0], [3.0, -4.0]], np.float32)
+    np.testing.assert_array_equal(relu(x), np.maximum(x, 0))
+
+
+def test_build_is_cached(src, tmp_path):
+    cpp_extension.load("t3", [src], build_directory=str(tmp_path))
+    sos = list(tmp_path.glob("t3_*.so"))
+    assert len(sos) == 1
+    mtime = sos[0].stat().st_mtime_ns
+    cpp_extension.load("t3", [src], build_directory=str(tmp_path))
+    assert sos[0].stat().st_mtime_ns == mtime  # not rebuilt
+
+
+def test_source_change_rebuilds(src, tmp_path):
+    cpp_extension.load("t4", [src], build_directory=str(tmp_path))
+    src.write_text(GOOD_SRC + "\n// changed\n")
+    cpp_extension.load("t4", [src], build_directory=str(tmp_path))
+    assert len(list(tmp_path.glob("t4_*.so"))) == 2  # new content hash
+
+
+def test_compile_error_reported(tmp_path):
+    bad = tmp_path / "bad.cc"
+    bad.write_text("this is not C++")
+    with pytest.raises(RuntimeError, match="failed to build"):
+        cpp_extension.load("t5", [bad], build_directory=str(tmp_path))
+
+
+def test_setup_parity(src, tmp_path):
+    exts = cpp_extension.setup(
+        name="pkg",
+        ext_modules=[cpp_extension.CppExtension(
+            [src], build_directory=str(tmp_path))])
+    assert len(exts) == 1
+    relu = cpp_extension.numpy_op(exts[0], "relu")
+    assert relu(np.array([-5.0], np.float32))[0] == 0
+
+
+def test_cuda_extension_raises():
+    with pytest.raises(NotImplementedError, match="Pallas"):
+        cpp_extension.CUDAExtension([])
+
+
+def test_try_import():
+    assert try_import("math") is not None
+    with pytest.raises(ImportError):
+        try_import("definitely_not_a_module")
