@@ -7,5 +7,6 @@ Reference surface: `python/paddle/incubate/` (fused functional ops in
 from . import nn  # noqa: F401
 from . import moe  # noqa: F401
 from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
 
 __all__ = ["nn", "moe"]

@@ -70,22 +70,49 @@ class _PrefetchIter:
             except queue.Empty:
                 return
             try:
-                batch = self._loader._fetch(idxs)
-                self._data_queue.put((i, batch, None))
+                item = (i, self._loader._fetch(idxs), None)
             except Exception as e:  # propagate to consumer
-                self._data_queue.put((i, None, e))
+                item = (i, None, e)
+            # bounded put must stay interruptible: a worker stuck in a
+            # blocking put outlives an abandoned iterator and crashes
+            # interpreter teardown (runtime destructors vs live threads)
+            while not self._stop.is_set():
+                try:
+                    self._data_queue.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def close(self):
+        """Stop workers; safe to call repeatedly (StopIteration, __del__,
+        and abandoned partially-consumed iterators all land here)."""
+        self._stop.set()
+        while True:  # unblock any worker parked on a full queue
+            try:
+                self._data_queue.get_nowait()
+            except queue.Empty:
+                break
+        for w in self._workers:
+            if w.is_alive():
+                w.join(timeout=1.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __iter__(self):
         return self
 
     def __next__(self):
         if self._next >= self._n_batches:
-            self._stop.set()
+            self.close()
             raise StopIteration
         while self._next not in self._results:
             i, batch, err = self._data_queue.get()
             if err is not None:
-                self._stop.set()
+                self.close()
                 raise err
             self._results[i] = batch
         out = self._results.pop(self._next)

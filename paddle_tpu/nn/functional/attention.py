@@ -67,6 +67,24 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if use_pallas and dropout_p == 0.0:
         from ...ops import flash_attention as fa
         if fa.supported(query, key, value, attn_mask, is_causal):
+            from ...incubate import autotune
+            if autotune.get_config()["kernel"]["enable"]:
+                # measure-once-then-cache (the reference's exhaustive
+                # kernel search, phi/kernels/autotune) per shape+causal
+                qd = getattr(query, "_data", query)
+                kd = getattr(key, "_data", key)
+                shape_key = ("sdpa", tuple(qd.shape), tuple(kd.shape),
+                             str(qd.dtype), bool(is_causal))
+                _, best = autotune.kernel_choice(shape_key, {
+                    "pallas": lambda q, k, v: fa.flash_attention(
+                        q, k, v, causal=is_causal),
+                    "xla": lambda q, k, v: run_op(
+                        "scaled_dot_product_attention",
+                        lambda q_, k_, v_: _naive_attention(
+                            q_, k_, v_, None, 0.0, is_causal, None),
+                        (q, k, v)),
+                }, (query, key, value))
+                return best(query, key, value)
             return fa.flash_attention(query, key, value, attn_mask=attn_mask,
                                       causal=is_causal)
     rng_key = frandom.next_key() if (dropout_p > 0.0 and training) else None

@@ -1,0 +1,119 @@
+"""incubate.autotune tests (reference `incubate/autotune.py:set_config`
+over `phi/kernels/autotune/` measure-once-then-cache semantics) plus the
+abandoned-DataLoader lifecycle regression."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autotune
+from paddle_tpu.io import DataLoader, TensorDataset
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    # domains off + cache empty before and after every test
+    for v in autotune._config.values():
+        v["enable"] = False
+    autotune._kernel_cache.clear()
+    yield
+    for v in autotune._config.values():
+        v["enable"] = False
+    autotune._kernel_cache.clear()
+
+
+class TestConfig:
+    def test_none_enables_all(self):
+        autotune.set_config(None)
+        cfg = autotune.get_config()
+        assert all(v["enable"] for v in cfg.values())
+
+    def test_dict_partial_update(self):
+        autotune.set_config({"kernel": {"enable": True,
+                                        "tuning_range": [2, 5]}})
+        cfg = autotune.get_config()
+        assert cfg["kernel"]["enable"]
+        assert cfg["kernel"]["tuning_range"] == [2, 5]
+        assert not cfg["layout"]["enable"]
+
+    def test_json_file(self, tmp_path):
+        f = tmp_path / "tune.json"
+        f.write_text('{"dataloader": {"enable": true}}')
+        autotune.set_config(str(f))
+        assert autotune.get_config()["dataloader"]["enable"]
+
+    def test_unknown_domain_rejected(self):
+        with pytest.raises(ValueError, match="unknown autotune domain"):
+            autotune.set_config({"gemm": {"enable": True}})
+
+
+class TestKernelChoice:
+    def test_caches_decision(self):
+        import jax.numpy as jnp
+        autotune.set_config({"kernel": {"enable": True}})
+        calls = []
+
+        def mk(tag):
+            def fn(x):
+                calls.append(tag)
+                return x
+            return fn
+
+        args = (jnp.ones(8),)
+        name1, _ = autotune.kernel_choice(
+            "k", {"a": mk("a"), "b": mk("b")}, args)
+        before = len(calls)
+        name2, fn = autotune.kernel_choice(
+            "k", {"a": mk("a"), "b": mk("b")}, args)
+        assert name1 == name2
+        assert len(calls) == before  # no re-timing
+        fn(*args)
+
+    def test_disabled_raises(self):
+        with pytest.raises(RuntimeError, match="disabled"):
+            autotune.kernel_choice("k", {}, ())
+
+    def test_attention_dispatch_stays_correct(self):
+        from paddle_tpu.nn.functional.attention import _naive_attention
+        import paddle_tpu.nn.functional as F
+
+        autotune.set_config({"kernel": {"enable": True}})
+        paddle.set_flags({"use_pallas_kernels": True})
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(1, 128, 4, 32).astype("float32"))
+        k = paddle.to_tensor(rng.randn(1, 128, 2, 32).astype("float32"))
+        v = paddle.to_tensor(rng.randn(1, 128, 2, 32).astype("float32"))
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        ref = _naive_attention(q._data, k._data, v._data, None, 0.0, True,
+                               None)
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        assert any(key[0] == "sdpa" for key in autotune._kernel_cache)
+
+
+class TestDataloaderTuning:
+    def _ds(self):
+        return TensorDataset([paddle.to_tensor(
+            np.arange(400).reshape(100, 4).astype("float32"))])
+
+    def test_tune_num_workers(self):
+        autotune.set_config({"dataloader": {"enable": True}})
+        best = autotune.tune_num_workers(self._ds(), batch_size=4,
+                                         candidates=(0, 2),
+                                         probe_batches=4)
+        assert best in (0, 2)
+
+    def test_disabled_raises(self):
+        with pytest.raises(RuntimeError, match="disabled"):
+            autotune.tune_num_workers(self._ds(), 4)
+
+    def test_abandoned_iterator_shuts_down_cleanly(self):
+        """Regression: a partially-consumed worker DataLoader must stop
+        its threads when dropped (previously they stayed parked on the
+        bounded queue and crashed interpreter teardown)."""
+        loader = DataLoader(self._ds(), batch_size=2, num_workers=2)
+        it = iter(loader)
+        next(it)
+        inner = it
+        inner.close()
+        assert all(not w.is_alive() for w in inner._workers)
