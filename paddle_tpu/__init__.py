@@ -72,6 +72,8 @@ from . import audio  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
+from . import version  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .hapi.model_summary import summary, flops  # noqa: F401,E402

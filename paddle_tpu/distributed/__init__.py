@@ -40,6 +40,7 @@ from .pipeline import pipeline_spmd  # noqa: F401
 from . import collective  # noqa: F401
 from ..native import TCPStore  # noqa: F401  (C++ rendezvous store)
 from . import ps  # noqa: F401  (sparse parameter-server seam)
+from . import rpc  # noqa: F401  (control-plane RPC over TCPStore)
 
 __all__ = [
     "TCPStore",

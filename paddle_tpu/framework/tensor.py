@@ -188,9 +188,17 @@ def run_op(name, fn, args, kwargs=None, differentiable=True):
     return result
 
 
+# observers called as observer(op_name, raw_output) after each op —
+# the instrumentation seam the reference codegens into eager ops
+# (consumed by paddle_tpu.amp.debugging operator-stats collection)
+op_observers = []
+
+
 def _wrap_outputs(name, out, stop_gradient):
     if flags.flag("check_nan_inf"):
         _check_nan_inf(name, out if isinstance(out, (tuple, list)) else [out])
+    for obs in op_observers:
+        obs(name, out)
     if isinstance(out, (tuple, list)):
         return tuple(
             Tensor(o, stop_gradient=stop_gradient or not jnp.issubdtype(o.dtype, jnp.inexact))

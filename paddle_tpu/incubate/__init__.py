@@ -8,5 +8,6 @@ from . import nn  # noqa: F401
 from . import moe  # noqa: F401
 from . import asp  # noqa: F401
 from . import autotune  # noqa: F401
+from . import autograd  # noqa: F401
 
 __all__ = ["nn", "moe"]

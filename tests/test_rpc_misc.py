@@ -1,0 +1,171 @@
+"""Tests for distributed.rpc (cross-process over TCPStore),
+paddle.version, paddle.onnx gating, incubate.autograd, and
+amp.debugging (reference: `distributed/rpc/rpc.py`,
+`incubate/autograd/functional.py`, `amp/debugging.py`)."""
+
+import multiprocessing
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import native
+from paddle_tpu.incubate import autograd as iag
+
+
+# ---------------------------------------------------------------------------
+# rpc
+# ---------------------------------------------------------------------------
+def _double(x):
+    return x * 2
+
+
+def _boom():
+    raise ValueError("intentional")
+
+
+def _rpc_worker(rank, world, port, result_q):
+    from paddle_tpu.distributed import rpc
+
+    # the endpoint is predetermined, as in a real launch (PADDLE_MASTER)
+    rpc.init_rpc(f"worker{rank}", rank=rank, world_size=world,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        peer = f"worker{(rank + 1) % world}"
+        out = rpc.rpc_sync(peer, _double, args=(rank + 10,))
+        assert out == 2 * (rank + 10), out
+        fut = rpc.rpc_async(peer, _double, args=(5,))
+        assert fut.wait(30) == 10
+        if rank == 0:
+            try:
+                rpc.rpc_sync("worker1", _boom)
+                result_q.put((rank, "no-exception"))
+                return
+            except ValueError as e:
+                assert "intentional" in str(e)
+        infos = rpc.get_all_worker_infos()
+        assert [w.name for w in infos] == [f"worker{r}"
+                                           for r in range(world)]
+        result_q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover
+        result_q.put((rank, repr(e)))
+    finally:
+        rpc.shutdown()
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native store")
+def test_rpc_cross_process():
+    import socket
+
+    with socket.socket() as s:  # reserve a free port for the master
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    ctx = multiprocessing.get_context("spawn")
+    result_q = ctx.Queue()
+    world = 2
+    ps = [ctx.Process(target=_rpc_worker, args=(r, world, port, result_q))
+          for r in range(world)]
+    [p.start() for p in ps]
+    results = dict(result_q.get(timeout=120) for _ in range(world))
+    [p.join(15) for p in ps]
+    assert results == {0: "ok", 1: "ok"}, results
+
+
+# ---------------------------------------------------------------------------
+# version / onnx
+# ---------------------------------------------------------------------------
+def test_version(capsys):
+    assert paddle.version.full_version == paddle.__version__
+    paddle.version.show()
+    out = capsys.readouterr().out
+    assert "full_version" in out and "tpu: True" in out
+
+
+def test_onnx_gate():
+    with pytest.raises(ImportError, match="paddle2onnx"):
+        paddle.onnx.export(None, "model")
+
+
+# ---------------------------------------------------------------------------
+# incubate.autograd
+# ---------------------------------------------------------------------------
+class TestFunctionalAutograd:
+    def test_jvp(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        v = paddle.to_tensor(np.array([1.0, 0.0, 1.0], np.float32))
+        out, tang = iag.jvp(lambda x: x ** 2, [x], [v])
+        np.testing.assert_allclose(out.numpy(), [1, 4, 9])
+        np.testing.assert_allclose(tang.numpy(), [2, 0, 6])
+
+    def test_vjp(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out, grad = iag.vjp(lambda x: (x ** 3).sum(), [x])
+        np.testing.assert_allclose(grad.numpy(), [3, 12])
+
+    def test_jacobian(self):
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+        J = iag.Jacobian(lambda x: x ** 2, x)
+        np.testing.assert_allclose(np.asarray(J[:]._data),
+                                   np.diag([4.0, 6.0]), atol=1e-6)
+        assert J.shape == [2, 2]
+
+    def test_hessian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        H = iag.Hessian(lambda x: (x ** 4).sum(), x)
+        np.testing.assert_allclose(np.asarray(H[:]._data),
+                                   np.diag([12.0, 48.0]), rtol=1e-5)
+
+    def test_prim_flags(self):
+        iag.enable_prim()
+        assert iag.prim_enabled()
+        iag.disable_prim()
+        assert iag.prim_enabled()  # always-on by construction
+
+
+# ---------------------------------------------------------------------------
+# amp.debugging
+# ---------------------------------------------------------------------------
+class TestAmpDebugging:
+    def test_operator_stats(self, capsys):
+        from paddle_tpu.amp import debugging as dbg
+
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        with dbg.collect_operator_stats():
+            _ = x * x + x.astype("bfloat16").astype("float32")
+        out = capsys.readouterr().out
+        assert "multiply" in out and "op list" in out
+
+    def test_observer_removed_after_context(self):
+        from paddle_tpu.amp import debugging as dbg
+        from paddle_tpu.framework import tensor as tmod
+
+        with dbg.collect_operator_stats():
+            pass
+        assert dbg._observer not in tmod.op_observers
+
+    def test_check_numerics(self, capsys):
+        from paddle_tpu.amp import debugging as dbg
+
+        t = paddle.to_tensor(np.array([np.nan, np.inf, 1.0], np.float32))
+        nan, inf = dbg.check_numerics(t, "opx", "varx")
+        assert (nan, inf) == (1, 1)
+        assert "opx" in capsys.readouterr().out
+        assert dbg.check_numerics(
+            paddle.to_tensor(np.ones(3, np.float32))) == (0, 0)
+
+    def test_tensor_checker_toggle(self):
+        from paddle_tpu.amp import debugging as dbg
+
+        dbg.enable_tensor_checker()
+        x = paddle.to_tensor(np.array([-1.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            paddle.log(x)
+        dbg.disable_tensor_checker()
+        paddle.log(x)  # no raise
+
+    def test_compare_accuracy(self):
+        from paddle_tpu.amp import debugging as dbg
+
+        x = paddle.to_tensor(np.linspace(0, 1, 8).astype(np.float32))
+        rep = dbg.compare_accuracy(lambda a: a * 1.5, [x])
+        assert rep["bfloat16"][0]["max_abs_err"] < 0.05
