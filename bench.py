@@ -249,6 +249,33 @@ def bench_paged(batch=8, heads=16, kv_heads=8, dim=128, page=64,
     }
 
 
+def bench_serving(model, n_requests=8, new_tokens=32, max_batch=4):
+    """Continuous-batching engine throughput: ragged prompts admitted on
+    the fly over the Pallas paged-attention decode program."""
+    from paddle_tpu.inference.serving import LlamaServingEngine
+
+    model.eval()
+    engine = LlamaServingEngine(model, max_batch=max_batch, page_size=64,
+                                num_pages=max_batch * 24 + 8)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, model.config.vocab_size,
+                           (int(rng.randint(16, 128)),)).tolist()
+               for _ in range(n_requests)]
+    # warm: compiles prefill shapes + the decode program
+    engine.generate(prompts[:2], max_new_tokens=4)
+    t0 = time.perf_counter()
+    outs = engine.generate(prompts, max_new_tokens=new_tokens)
+    dt = time.perf_counter() - t0
+    model.train()
+    total = sum(len(o) for o in outs)
+    return {
+        "serving_requests": n_requests,
+        "serving_tokens": total,
+        "serving_tokens_per_sec": round(total / dt, 1),
+        "serving_max_batch": max_batch,
+    }
+
+
 # (config kwargs, batch, seq) from largest to smallest; the first that
 # completes on this chip wins (HBM-driven fallback)
 CANDIDATES = [
@@ -314,6 +341,14 @@ def main():
     except Exception as e:
         log(f"decode bench failed: {e!r:.300}")
         result["decode_error"] = repr(e)[:200]
+
+    try:
+        model = bench_train_step.last_model
+        result.update(bench_serving(model, n_requests=8 if on_tpu else 2,
+                                    new_tokens=32 if on_tpu else 4))
+    except Exception as e:
+        log(f"serving bench failed: {e!r:.300}")
+        result["serving_error"] = repr(e)[:200]
 
     mfu = result["mfu"]
     line = {"metric": "llama_train_mfu", "value": mfu,

@@ -95,9 +95,11 @@ class LlamaServingEngine:
     # ------------------------------------------------------------------
     # prefill
     # ------------------------------------------------------------------
-    def _prefill_forward(self, ids):
-        """Dense forward of one prompt [1, S]; returns (last-token id,
-        per-layer post-rope (k, v) [S, Hk, D])."""
+    def _prefill_forward(self, ids, real_len):
+        """Dense forward of one prompt [1, Sb] (bucket-padded; causal
+        attention keeps the padded tail from touching the real prefix);
+        returns (token id after position real_len-1, per-layer post-rope
+        (k, v) [Sb, Hk, D] — caller slices to real_len)."""
         from ..tensor import creation, search
 
         m = self.model.model
@@ -119,14 +121,23 @@ class LlamaServingEngine:
             x = x + att.o_proj(out.reshape([b, s, -1]))
             x = x + layer.mlp(layer.post_attention_layernorm(x))
         x = m.norm(x)
-        logits = self.model._logits(x[:, -1:])
+        logits = self.model._logits(x[:, real_len - 1:real_len])
         nxt = search.argmax(logits, axis=-1).astype("int64")
         return nxt, kvs
 
+    PREFILL_BUCKET = 32
+
     def _prefill(self, req):
-        ids = Tensor(jnp.asarray(req.prompt_ids[None, :]))
+        n = len(req.prompt_ids)
+        # bucket the padded length so ragged prompts share compiled
+        # prefill programs (one per bucket, not one per length)
+        bucket = -(-n // self.PREFILL_BUCKET) * self.PREFILL_BUCKET
+        padded = np.zeros((1, bucket), np.int64)
+        padded[0, :n] = req.prompt_ids
+        ids = Tensor(jnp.asarray(padded))
         with no_grad():
-            nxt, kvs = self._prefill_forward(ids)
+            nxt, kvs = self._prefill_forward(ids, n)
+        kvs = [(k[:n], v[:n]) for k, v in kvs]
         seq_id = req.seq_id
         page_ids, offs = self.alloc.page_positions(
             seq_id, 0, len(req.prompt_ids))
