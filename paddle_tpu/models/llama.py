@@ -23,6 +23,8 @@ from __future__ import annotations
 import dataclasses
 import math
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .. import nn
@@ -318,41 +320,86 @@ class LlamaForCausalLM(nn.Layer):
         attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
         return 6 * n + attn
 
-    def _decode_step(self, tokens, cache_len, caches):
-        """One generation step: (next_token, new_cache_len, new_caches).
-        Pure in (tokens, cache_len, caches) so ``to_static`` compiles it
-        ONCE per shape — the static KV buffers keep every decode step the
-        same program, and with input donation XLA updates them in place."""
+    @staticmethod
+    def _pick_token(logits, rng_key, sampler):
+        """next-token rule on [B, 1, V] logits. ``sampler`` is a static
+        (do_sample, top_k, top_p, temperature) tuple — each distinct
+        config compiles its own decode program."""
+        from ..framework.tensor import run_op
         from ..tensor import search
+
+        do_sample, top_k, top_p, temperature = sampler
+        if not do_sample:
+            return search.argmax(logits, axis=-1).astype("int64")
+
+        def fn(logits, key):
+            lg = logits[:, 0, :].astype(jnp.float32)
+            lg = lg / max(float(temperature), 1e-6)
+            if top_k is not None:
+                kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
+                lg = jnp.where(lg >= kth, lg, -1e30)
+            if top_p is not None:
+                # nucleus over the (possibly top-k-restricted) softmax
+                probs = jax.nn.softmax(lg, axis=-1)
+                order = jnp.argsort(-probs, axis=-1)
+                sp = jnp.take_along_axis(probs, order, axis=-1)
+                cum_before = jnp.cumsum(sp, axis=-1) - sp
+                keep_sorted = cum_before < float(top_p)
+                keep = jnp.zeros_like(keep_sorted).at[
+                    jnp.arange(lg.shape[0])[:, None], order].set(
+                    keep_sorted)
+                lg = jnp.where(keep, lg, -1e30)
+            return jax.random.categorical(key, lg, axis=-1)[:, None]
+
+        return run_op("sample_next_token", fn, (logits, rng_key),
+                      differentiable=False).astype("int64")
+
+    def _decode_step(self, tokens, cache_len, caches, rng_key=None,
+                     sampler=(False, None, None, 1.0)):
+        """One generation step: (next_token, new_cache_len, new_caches).
+        Pure in (tokens, cache_len, caches, rng_key) so ``to_static``
+        compiles it ONCE per shape — the static KV buffers keep every
+        decode step the same program, and with input donation XLA updates
+        them in place."""
         hidden, caches = self.model(tokens, None, caches, cache_len)
         logits = self._logits(hidden[:, -1:])
-        nxt = search.argmax(logits, axis=-1).astype("int64")
+        nxt = self._pick_token(logits, rng_key, sampler)
         new_len = cache_len + tokens.shape[1]
         return nxt, new_len, caches
 
-    def generate(self, input_ids, max_new_tokens=16, max_length=None):
-        """Greedy decode over a static KV cache: one compile for the
-        prefill shape + one for the single-token decode shape, reused for
-        every subsequent step and every same-shape call. Inputs of the
-        compiled step are donated (the caches alias in place on device), so
-        nothing passed to one step is touched after it. The buffer length
-        is bucketed (multiple of 64) so prompts of different lengths share
-        the same decode executable."""
+    def generate(self, input_ids, max_new_tokens=16, max_length=None,
+                 do_sample=False, top_k=None, top_p=None, temperature=1.0,
+                 seed=None):
+        """Decode over a static KV cache: one compile for the prefill
+        shape + one for the single-token decode shape, reused for every
+        subsequent step and every same-shape call. Greedy by default;
+        ``do_sample=True`` samples inside the compiled step (temperature
+        -> top-k -> top-p nucleus -> categorical), deterministic under
+        ``seed``. Inputs of the compiled step are donated (the caches
+        alias in place on device), so nothing passed to one step is
+        touched after it. The buffer length is bucketed (multiple of 64)
+        so prompts of different lengths share the same decode executable."""
         from ..framework.tensor import Tensor, no_grad
+        from ..framework import random as frandom
         from ..tensor import manipulation as M
         from .. import jit
         import jax.numpy as jnp
 
-        # the compiled step pins parameter objects; rebuild if any were
-        # replaced since (e.g. shard_llama swapped in dist Parameters)
-        param_key = tuple(id(p) for p in self.parameters())
+        sampler = (bool(do_sample), top_k, top_p, float(temperature))
+        # the compiled step pins parameter objects + the sampler config;
+        # rebuild if either changed (e.g. shard_llama swapped Parameters)
+        param_key = (tuple(id(p) for p in self.parameters()), sampler)
         if getattr(self, "_decode_static", None) is None \
                 or self._decode_param_key != param_key:
+            def step_fn(tokens, cache_len, caches, rng_key):
+                return self._decode_step(tokens, cache_len, caches,
+                                         rng_key, sampler)
             self._decode_static = jit.StaticFunction(
-                self._decode_step, state=[self], warmup="once",
-                donate_inputs=True)
+                step_fn, state=[self], warmup="once", donate_inputs=True)
             self._decode_param_key = param_key
         step = self._decode_static
+        base_key = jax.random.key(seed) if seed is not None \
+            else frandom.next_key()
         with no_grad():
             b, s = input_ids.shape[0], input_ids.shape[1]
             need = s + max_new_tokens
@@ -369,7 +416,9 @@ class LlamaForCausalLM(nn.Layer):
             tokens = Tensor(jnp.array(input_ids._data))
             new_tokens = []
             for i in range(max_new_tokens):
-                nxt, cache_len, caches = step(tokens, cache_len, caches)
+                key = Tensor(jax.random.fold_in(base_key, i))
+                nxt, cache_len, caches = step(tokens, cache_len, caches,
+                                              key)
                 tokens = nxt.reshape([b, 1])
                 # copy: `tokens` itself is donated into the next step, but
                 # the appended value must survive until the final concat

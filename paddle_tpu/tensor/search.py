@@ -19,7 +19,7 @@ from . import manipulation
 __all__ = [
     "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
     "where_index", "nonzero", "index_sample", "searchsorted", "bucketize",
-    "masked_select_idx",
+    "masked_select_idx", "top_p_sampling",
 ]
 
 
@@ -149,3 +149,38 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False):
 
 def masked_select_idx(x, mask):
     return manipulation.masked_select(x, mask)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Sample one id per row from the top-p nucleus (reference
+    `python/paddle/tensor/search.py:1261`, CUDA kernel
+    `phi/kernels/gpu/top_p_sampling_kernel.cu`). ``x`` [B, V] holds
+    probabilities, ``ps`` [B] the cumulative threshold, ``threshold`` an
+    optional absolute probability floor. Returns (values [B, 1],
+    ids [B, 1] int64).
+
+    TPU-native: sort + masked Gumbel-argmax — static shapes, no
+    rejection loop.
+    """
+    import jax
+
+    from ..framework import random as frandom
+    from ..framework.tensor import run_op
+
+    key = jax.random.key(seed) if seed is not None else frandom.next_key()
+
+    def fn(x, ps, thr, key):
+        sx_idx = jnp.argsort(-x, axis=-1)
+        sx = jnp.take_along_axis(x, sx_idx, axis=-1)
+        cum_before = jnp.cumsum(sx, axis=-1) - sx
+        keep = cum_before < ps[:, None]          # always keeps the top-1
+        if thr is not None:
+            keep &= (sx >= thr[:, None]) | (cum_before <= 0)
+        logits = jnp.where(keep, jnp.log(jnp.maximum(sx, 1e-38)), -1e30)
+        j = jax.random.categorical(key, logits, axis=-1)      # [B]
+        val = jnp.take_along_axis(sx, j[:, None], axis=-1)
+        ids = jnp.take_along_axis(sx_idx, j[:, None], axis=-1)
+        return val, ids.astype(_i64())
+
+    return run_op("top_p_sampling", fn, (x, ps, threshold, key),
+                  differentiable=False)

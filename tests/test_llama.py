@@ -258,3 +258,49 @@ class TestKVCacheGuards:
         out2 = m.generate(ids, max_new_tokens=3)
         assert m._decode_static is not sf1  # rebuilt, not stale
         np.testing.assert_array_equal(out1.numpy(), out2.numpy())
+
+
+class TestSamplingGenerate:
+    """Sampling decode (reference capability: top_p_sampling CUDA kernel
+    `phi/kernels/gpu/top_p_sampling_kernel.cu` + generation loops)."""
+
+    def _model(self):
+        paddle.seed(0)
+        m = LlamaForCausalLM(tiny_llama_config())
+        m.eval()
+        return m
+
+    def _ids(self, b=2, s=6):
+        return paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 128, (b, s)).astype(np.int64))
+
+    def test_seeded_sampling_deterministic(self):
+        m, ids = self._model(), self._ids()
+        a = m.generate(ids, max_new_tokens=5, do_sample=True, top_p=0.9,
+                       temperature=0.8, seed=7).numpy()
+        b = m.generate(ids, max_new_tokens=5, do_sample=True, top_p=0.9,
+                       temperature=0.8, seed=7).numpy()
+        np.testing.assert_array_equal(a, b)
+        c = m.generate(ids, max_new_tokens=5, do_sample=True, top_p=0.9,
+                       temperature=0.8, seed=8).numpy()
+        assert not np.array_equal(a, c)
+
+    def test_top_k_one_equals_greedy(self):
+        m, ids = self._model(), self._ids()
+        greedy = m.generate(ids, max_new_tokens=5).numpy()
+        k1 = m.generate(ids, max_new_tokens=5, do_sample=True, top_k=1,
+                        seed=3).numpy()
+        np.testing.assert_array_equal(greedy, k1)
+
+    def test_top_p_sampling_op_nucleus(self):
+        probs = paddle.to_tensor(np.array(
+            [[0.5, 0.3, 0.15, 0.05], [0.9, 0.05, 0.03, 0.02]], np.float32))
+        ps = paddle.to_tensor(np.array([0.7, 0.5], np.float32))
+        seen0 = set()
+        for s in range(40):
+            _, ids = paddle.tensor.top_p_sampling(probs, ps, seed=s)
+            ids = ids.numpy()
+            assert ids[1, 0] == 0          # nucleus of row 1 is {0}
+            assert ids[0, 0] in (0, 1)     # nucleus of row 0 is {0, 1}
+            seen0.add(int(ids[0, 0]))
+        assert seen0 == {0, 1}             # actually samples, not argmax
