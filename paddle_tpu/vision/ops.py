@@ -15,7 +15,8 @@ import numpy as np
 
 from ..framework.tensor import run_op
 
-__all__ = ["nms", "roi_align", "roi_pool", "box_iou"]
+__all__ = ["nms", "roi_align", "roi_pool", "box_iou", "deform_conv2d",
+           "DeformConv2D"]
 
 
 def _iou_matrix(boxes):
@@ -222,3 +223,117 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         return jax.vmap(per_roi)(jnp.arange(r))
 
     return run_op("roi_pool", fn, (x, boxes, boxes_num))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference `vision/ops.py:753`,
+    CUDA kernel `phi/kernels/gpu/deformable_conv_kernel.cu`).
+
+    x [N, Cin, H, W]; offset [N, 2*dg*kh*kw, Ho, Wo] ordered (y, x) per
+    tap; optional mask [N, dg*kh*kw, Ho, Wo] (v2 modulation); weight
+    [Cout, Cin/groups, kh, kw]. TPU-native: every kernel tap becomes one
+    batched bilinear gather over its offset field, accumulated into an
+    im2col-like tensor that contracts with the weights on the MXU — no
+    per-position scalar loops.
+    """
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def fn(x, offset, weight, bias, mask):
+        n, cin, h, w = x.shape
+        cout, cin_g, kh, kw = weight.shape
+        ho = (h + 2 * padding[0] - dilation[0] * (kh - 1) - 1) \
+            // stride[0] + 1
+        wo = (w + 2 * padding[1] - dilation[1] * (kw - 1) - 1) \
+            // stride[1] + 1
+        dg = deformable_groups
+        off = offset.reshape(n, dg, kh * kw, 2, ho, wo)
+        if mask is not None:
+            mk = mask.reshape(n, dg, kh * kw, ho, wo)
+        # base sampling grid per tap: [kh*kw, Ho, Wo]
+        base_y = (jnp.arange(ho) * stride[0] - padding[0])[None, :, None] \
+            + (jnp.arange(kh) * dilation[0])[:, None, None].repeat(
+                kw, axis=0).reshape(kh * kw, 1, 1)
+        base_x = (jnp.arange(wo) * stride[1] - padding[1])[None, None, :] \
+            + jnp.tile(jnp.arange(kw) * dilation[1], kh)[:, None, None]
+        ys = base_y[None, None] + off[:, :, :, 0]       # [N, dg, K, Ho, Wo]
+        xs = base_x[None, None] + off[:, :, :, 1]
+
+        # bilinear sample x at (ys, xs) for each deformable group's
+        # channel slice: returns [N, dg, C/dg, K, Ho, Wo]
+        cg = cin // dg
+        xg = x.reshape(n, dg, cg, h, w)
+
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy1 = (ys - y0)[:, :, None]                     # [N, dg, 1, K, ...]
+        wx1 = (xs - x0)[:, :, None]
+        wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+        valid = ((ys > -1) & (ys < h) & (xs > -1) & (xs < w))[:, :, None]
+
+        def gather(yi, xi):
+            yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            flat = yi * w + xi                          # [N, dg, K, Ho, Wo]
+            xf = xg.reshape(n, dg, cg, h * w)
+            # take_along_axis over the flattened spatial dim
+            idx = flat.reshape(n, dg, 1, -1)
+            out = jnp.take_along_axis(
+                xf, jnp.broadcast_to(idx, (n, dg, cg, idx.shape[-1])),
+                axis=-1)
+            return out.reshape(n, dg, cg, kh * kw, ho, wo)
+
+        sampled = (gather(y0, x0) * wy0 * wx0
+                   + gather(y0, x0 + 1) * wy0 * wx1
+                   + gather(y0 + 1, x0) * wy1 * wx0
+                   + gather(y0 + 1, x0 + 1) * wy1 * wx1)
+        sampled = jnp.where(valid, sampled, 0.0)
+        if mask is not None:
+            sampled = sampled * mk[:, :, None]
+        # [N, Cin, K, Ho, Wo] -> grouped contraction with the weights
+        col = sampled.reshape(n, cin, kh * kw, ho, wo)
+        colg = col.reshape(n, groups, cin // groups, kh * kw, ho, wo)
+        wg = weight.reshape(groups, cout // groups, cin_g, kh * kw)
+        out = jnp.einsum("ngckhw,gock->ngohw", colg, wg,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(n, cout, ho, wo).astype(x.dtype)
+        if bias is not None:
+            out = out + bias.reshape(1, cout, 1, 1)
+        return out
+
+    return run_op("deform_conv2d", fn, (x, offset, weight, bias, mask))
+
+
+class DeformConv2D:
+    """Layer wrapper over :func:`deform_conv2d` (reference
+    `vision/ops.py:DeformConv2D`). Holds weight/bias; offset (and v2
+    mask) are runtime inputs, as in the reference."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from .. import nn
+
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+        # reuse Conv2D's parameter creation (fan-in init, attrs)
+        self._conv = nn.Conv2D(in_channels, out_channels, ks, stride=stride,
+                               padding=padding, dilation=dilation,
+                               groups=groups, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+        self.weight = self._conv.weight
+        self.bias = self._conv.bias
+
+    def parameters(self):
+        return self._conv.parameters()
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._cfg)

@@ -155,3 +155,74 @@ class TestRoiAlign:
                                        [20, 20, 30, 30]], np.float32))
         iou = ops.box_iou(a, b).numpy()
         np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], rtol=1e-5)
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv2d(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 4, 9, 9).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(6, 4, 3, 3).astype(np.float32))
+        b = paddle.to_tensor(rng.randn(6).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((2, 18, 7, 7), np.float32))
+        out = ops.deform_conv2d(x, off, w, b)
+        ref = F.conv2d(x, w, b)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_integer_offset_equals_shifted_conv(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(1, 3, 9, 9).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(5, 3, 3, 3).astype(np.float32))
+        off = np.zeros((1, 18, 7, 7), np.float32)
+        off[:, 0::2] = 1.0  # +1 on every tap's y offset
+        out = ops.deform_conv2d(x, paddle.to_tensor(off), w, None).numpy()
+        ref = F.conv2d(x[:, :, 1:, :], w, None).numpy()
+        # rows whose shifted taps stay in bounds
+        np.testing.assert_allclose(out[:, :, :6, :], ref, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_mask_modulation(self):
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(3, 2, 3, 3).astype(np.float32))
+        b = paddle.to_tensor(rng.randn(3).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((1, 18, 4, 4), np.float32))
+        ones = paddle.to_tensor(np.ones((1, 9, 4, 4), np.float32))
+        zeros = paddle.to_tensor(np.zeros((1, 9, 4, 4), np.float32))
+        v1 = ops.deform_conv2d(x, off, w, b)
+        v2 = ops.deform_conv2d(x, off, w, b, mask=ones)
+        np.testing.assert_allclose(v1.numpy(), v2.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+        v0 = ops.deform_conv2d(x, off, w, b, mask=zeros)
+        np.testing.assert_allclose(
+            v0.numpy(), np.broadcast_to(b.numpy().reshape(1, 3, 1, 1),
+                                        v0.shape), rtol=1e-5, atol=1e-5)
+
+    def test_grouped_strided_with_gradients(self):
+        rng = np.random.RandomState(3)
+        x = paddle.to_tensor(rng.randn(1, 4, 8, 8).astype(np.float32),
+                             stop_gradient=False)
+        w = paddle.to_tensor(rng.randn(8, 2, 3, 3).astype(np.float32),
+                             stop_gradient=False)
+        off = paddle.to_tensor(
+            0.5 * rng.randn(1, 36, 4, 4).astype(np.float32),
+            stop_gradient=False)
+        out = ops.deform_conv2d(x, off, w, None, stride=2, padding=1,
+                                groups=2, deformable_groups=2)
+        assert tuple(out.shape) == (1, 8, 4, 4)
+        out.sum().backward()
+        for t in (x, w, off):
+            assert t.grad is not None
+        assert float(np.abs(off.grad.numpy()).sum()) > 0
+
+    def test_layer_wrapper(self):
+        paddle.seed(0)
+        layer = ops.DeformConv2D(3, 6, 3, padding=1)
+        x = paddle.to_tensor(np.random.RandomState(4)
+                             .randn(2, 3, 8, 8).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((2, 18, 8, 8), np.float32))
+        out = layer(x, off)
+        assert tuple(out.shape) == (2, 6, 8, 8)
+        assert len(layer.parameters()) >= 1
