@@ -77,6 +77,7 @@ from . import onnx  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .hapi.model_summary import summary, flops  # noqa: F401,E402
+from .hapi import hub  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
 from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402

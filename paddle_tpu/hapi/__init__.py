@@ -314,3 +314,4 @@ def _metric_logs(m):
                     else [vals]))
 
 from .model_summary import summary, flops  # noqa: F401,E402
+from . import hub  # noqa: F401,E402

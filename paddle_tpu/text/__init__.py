@@ -2,7 +2,7 @@
 Viterbi decoding + classic NLP datasets."""
 
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
-from .datasets import UCIHousing, Imdb, Imikolov  # noqa: F401
+from .datasets import UCIHousing, Imdb, Imikolov, Movielens  # noqa: F401
 
 __all__ = ["ViterbiDecoder", "viterbi_decode",
-           "UCIHousing", "Imdb", "Imikolov"]
+           "UCIHousing", "Imdb", "Imikolov", "Movielens"]

@@ -19,7 +19,7 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["UCIHousing", "Imdb", "Imikolov"]
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens"]
 
 
 class UCIHousing(Dataset):
@@ -194,6 +194,108 @@ class Imikolov(Dataset):
                     if 0 < self.window_size < len(src):
                         continue
                     self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+_AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """Movie metadata row (reference `text/datasets/movielens.py`)."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+
+class UserInfo:
+    """User metadata row (reference `text/datasets/movielens.py`)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = _AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings from the ml-1m.zip archive (reference
+    `text/datasets/movielens.py`): '::'-separated users/movies/ratings
+    tables, ratings rescaled to [-5, 5] via r*2-5, random train/test
+    split by ``test_ratio`` under ``rand_seed``. Each example is
+    (uid, gender, age_bucket, job, movie_id, category_ids, title_ids,
+    rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        import re
+        import zipfile
+
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        if data_file is None:
+            raise ValueError(
+                "data_file is required (no network in this build): pass "
+                "the ml-1m.zip archive the reference downloads")
+        self.data_file = data_file
+
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info = {}
+        self.user_info = {}
+        title_words, category_set = set(), set()
+        with zipfile.ZipFile(data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode("latin").strip() \
+                        .split("::")
+                    cats = cats.split("|")
+                    category_set.update(cats)
+                    m = pattern.match(title)
+                    title = m.group(1) if m else title
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+                    title_words.update(w.lower() for w in title.split())
+            self.movie_title_dict = {w: i for i, w
+                                     in enumerate(sorted(title_words))}
+            self.categories_dict = {c: i for i, c
+                                    in enumerate(sorted(category_set))}
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = line.decode("latin") \
+                        .strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age,
+                                                        job)
+            rng = np.random.RandomState(rand_seed)
+            is_test = self.mode == "test"
+            self.data = []
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (rng.random_sample() < test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = line.decode("latin").strip() \
+                        .split("::")
+                    usr = self.user_info[int(uid)]
+                    mov = self.movie_info[int(mid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating) * 2 - 5.0]])
 
     def __getitem__(self, idx):
         return tuple(np.array(d) for d in self.data[idx])

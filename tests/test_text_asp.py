@@ -207,3 +207,76 @@ class TestASP:
         assert dens == {}
         asp.reset_excluded_layers()
         assert len(asp.prune_model(model)) == 1
+
+
+def _make_ml1m_zip(path):
+    import zipfile
+    movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+              "2::Heat (1995)::Action|Crime\n").encode("latin")
+    users = ("1::M::25::12::55117\n2::F::18::3::55105\n").encode("latin")
+    ratings = ("1::1::5::978300760\n1::2::3::978302109\n"
+               "2::1::4::978301968\n2::2::1::978300275\n").encode("latin")
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/ratings.dat", ratings)
+
+
+class TestMovielens:
+    def test_parsing_and_split(self, tmp_path):
+        f = tmp_path / "ml-1m.zip"
+        _make_ml1m_zip(f)
+        train = text.Movielens(data_file=str(f), mode="train",
+                               test_ratio=0.0)
+        assert len(train) == 4
+        uid, gender, age, job, mid, cats, title, rating = train[0]
+        assert uid[0] == 1 and gender[0] == 0       # male -> 0
+        assert age[0] == 2                           # bucket index of 25
+        assert job[0] == 12
+        assert mid[0] == 1 and len(cats) == 2 and len(title) == 2
+        assert rating[0] == 5.0 * 2 - 5.0
+        # train + test partition the ratings
+        tr = text.Movielens(data_file=str(f), mode="train",
+                            test_ratio=0.5, rand_seed=1)
+        te = text.Movielens(data_file=str(f), mode="test",
+                            test_ratio=0.5, rand_seed=1)
+        assert len(tr) + len(te) == 4
+
+    def test_title_year_stripped(self, tmp_path):
+        f = tmp_path / "ml-1m.zip"
+        _make_ml1m_zip(f)
+        ds = text.Movielens(data_file=str(f), mode="train", test_ratio=0.0)
+        assert "(1995)" not in ds.movie_info[1].title
+
+
+class TestHub:
+    def _repo(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            'dependencies = ["numpy"]\n'
+            "def small_model(width=4):\n"
+            '    """Builds the small model."""\n'
+            "    import paddle_tpu as paddle\n"
+            "    return paddle.nn.Linear(width, 2)\n"
+            "def _private():\n"
+            "    pass\n")
+        return str(tmp_path)
+
+    def test_list_help_load(self, tmp_path):
+        repo = self._repo(tmp_path)
+        assert paddle.hub.list(repo, source="local") == ["small_model"]
+        assert "small model" in paddle.hub.help(repo, "small_model",
+                                                source="local")
+        layer = paddle.hub.load(repo, "small_model", source="local",
+                                width=8)
+        assert layer.weight.shape == [8, 2]
+
+    def test_network_sources_rejected(self, tmp_path):
+        with pytest.raises(RuntimeError, match="network"):
+            paddle.hub.list("user/repo", source="github")
+
+    def test_missing_dependency_reported(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            'dependencies = ["not_a_real_pkg"]\n'
+            "def m():\n    return 1\n")
+        with pytest.raises(RuntimeError, match="not_a_real_pkg"):
+            paddle.hub.load(str(tmp_path), "m", source="local")
