@@ -1,0 +1,113 @@
+"""incubate.nn fused layer classes (reference
+`incubate/nn/layer/fused_transformer.py`): API-parity wrappers over the
+fused functionals; behavior checked against the equivalent unfused
+composition."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate.nn import (
+    FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedFeedForward,
+    FusedLinear, FusedMultiHeadAttention, FusedTransformerEncoderLayer)
+
+
+def _x(b=2, s=6, d=16, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(b, s, d).astype("float32"))
+
+
+class TestFusedLinear:
+    def test_matches_matmul(self):
+        paddle.seed(0)
+        fl = FusedLinear(16, 8)
+        x = _x()
+        want = x.matmul(fl.weight) + fl.bias
+        np.testing.assert_allclose(fl(x).numpy(), want.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_transpose_weight(self):
+        paddle.seed(0)
+        fl = FusedLinear(16, 8, transpose_weight=True)
+        assert fl.weight.shape == [8, 16]
+        assert tuple(fl(_x()).shape) == (2, 6, 8)
+
+
+class TestFusedAttention:
+    def test_forward_backward(self):
+        paddle.seed(0)
+        attn = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0)
+        attn.eval()
+        out = attn(_x())
+        assert tuple(out.shape) == (2, 6, 16)
+        (out ** 2).mean().backward()
+        used = [attn.qkv_weight, attn.linear_weight, attn.ln_scale]
+        assert all(p.grad is not None for p in used)
+
+    def test_matches_unfused_composition(self):
+        """post-LN, zero dropout: fused block == layer_norm(residual +
+        linear(attention(qkv(x))))."""
+        paddle.seed(3)
+        d, h = 16, 4
+        attn = FusedMultiHeadAttention(d, h, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0)
+        attn.eval()
+        x = _x(seed=5)
+        qkv = (x.matmul(attn.qkv_weight) + attn.qkv_bias) \
+            .reshape([2, 6, 3, h, d // h])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = F.scaled_dot_product_attention(q, k, v).reshape([2, 6, d])
+        o = o.matmul(attn.linear_weight) + attn.linear_bias
+        want = F.layer_norm(x + o, [d], weight=attn.ln_scale,
+                            bias=attn.ln_bias)
+        np.testing.assert_allclose(attn(x).numpy(), want.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_need_weights_rejected(self):
+        with pytest.raises(NotImplementedError):
+            FusedMultiHeadAttention(16, 4, need_weights=True)
+
+
+class TestFusedFeedForward:
+    @pytest.mark.parametrize("pre_ln", [False, True])
+    def test_forward_shape_and_grads(self, pre_ln):
+        paddle.seed(0)
+        ffn = FusedFeedForward(16, 32, dropout_rate=0.0,
+                               normalize_before=pre_ln)
+        ffn.eval()
+        out = ffn(_x())
+        assert tuple(out.shape) == (2, 6, 16)
+        (out ** 2).mean().backward()
+        assert ffn.linear1_weight.grad is not None
+        assert ffn.linear2_weight.grad is not None
+
+
+class TestEncoderAndBlocks:
+    def test_encoder_layer_trains(self):
+        paddle.seed(0)
+        enc = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+        enc.eval()
+        y = enc(_x())
+        assert tuple(y.shape) == (2, 6, 16)
+        (y ** 2).mean().backward()
+        assert enc.ffn.linear1_weight.grad is not None
+        assert enc.fused_attn.qkv_weight.grad is not None
+
+    def test_bias_dropout_residual_ln(self):
+        paddle.seed(0)
+        blk = FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+        blk.eval()
+        x = _x()
+        want = F.layer_norm(x + blk.linear_bias + x, [16],
+                            weight=blk.ln_scale, bias=blk.ln_bias)
+        np.testing.assert_allclose(blk(x, x).numpy(), want.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_fused_dropout_add_eval_is_plain_add(self):
+        da = FusedDropoutAdd(p=0.7)
+        da.eval()
+        x = _x()
+        np.testing.assert_allclose(da(x, x).numpy(), 2 * x.numpy(),
+                                   rtol=1e-6)
