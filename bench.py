@@ -261,8 +261,8 @@ def bench_serving(model, n_requests=8, new_tokens=32, max_batch=4):
     prompts = [rng.randint(0, model.config.vocab_size,
                            (int(rng.randint(16, 128)),)).tolist()
                for _ in range(n_requests)]
-    # warm: compiles prefill shapes + the decode program
-    engine.generate(prompts[:2], max_new_tokens=4)
+    # warm: compiles every prefill bucket + the decode program
+    engine.generate(prompts, max_new_tokens=2)
     t0 = time.perf_counter()
     outs = engine.generate(prompts, max_new_tokens=new_tokens)
     dt = time.perf_counter() - t0

@@ -78,6 +78,8 @@ from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .hapi.model_summary import summary, flops  # noqa: F401,E402
 from .hapi import hub  # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402  (paddle.callbacks)
+from . import sysconfig  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
 from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402

@@ -11,3 +11,6 @@ from . import autotune  # noqa: F401
 from . import autograd  # noqa: F401
 
 __all__ = ["nn", "moe"]
+
+from ..geometric import (  # noqa: F401  (reference incubate.segment_*)
+    segment_sum, segment_mean, segment_max, segment_min)

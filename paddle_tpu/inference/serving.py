@@ -41,6 +41,16 @@ from .paged_cache import PageAllocator
 __all__ = ["LlamaServingEngine", "Request"]
 
 
+def _dynamic_take(x, pos):
+    """x[:, pos:pos+1, :] with a traced scalar ``pos``."""
+    import jax
+
+    def fn(x, pos):
+        return jax.lax.dynamic_slice_in_dim(x, pos, 1, axis=1)
+
+    return run_op("dynamic_take", fn, (x, pos), differentiable=False)
+
+
 def _page_write(pages, new, page_ids, offs):
     """Functional scatter of ``new [B, Hk, D]`` into head-major ``pages
     [P, Hk, page, D]`` at (page_ids[b], h, offs[b]) — one token per live
@@ -91,15 +101,18 @@ class LlamaServingEngine:
         self._live: dict[int, Request] = {}
         self._next_id = 0
         self._decode_static = None
+        self._prefill_static = None
 
     # ------------------------------------------------------------------
     # prefill
     # ------------------------------------------------------------------
-    def _prefill_forward(self, ids, real_len):
+    def _prefill_forward(self, ids, last_pos):
         """Dense forward of one prompt [1, Sb] (bucket-padded; causal
         attention keeps the padded tail from touching the real prefix);
-        returns (token id after position real_len-1, per-layer post-rope
-        (k, v) [Sb, Hk, D] — caller slices to real_len)."""
+        ``last_pos`` is a traced scalar so every prompt length in the
+        bucket shares ONE compiled program. Returns (token id after
+        ``last_pos``, per-layer post-rope (k, v) [Sb, Hk, D] — caller
+        slices to the real length)."""
         from ..tensor import creation, search
 
         m = self.model.model
@@ -121,7 +134,8 @@ class LlamaServingEngine:
             x = x + att.o_proj(out.reshape([b, s, -1]))
             x = x + layer.mlp(layer.post_attention_layernorm(x))
         x = m.norm(x)
-        logits = self.model._logits(x[:, real_len - 1:real_len])
+        h_last = _dynamic_take(x, last_pos)          # [1, 1, H]
+        logits = self.model._logits(h_last)
         nxt = search.argmax(logits, axis=-1).astype("int64")
         return nxt, kvs
 
@@ -135,8 +149,15 @@ class LlamaServingEngine:
         padded = np.zeros((1, bucket), np.int64)
         padded[0, :n] = req.prompt_ids
         ids = Tensor(jnp.asarray(padded))
+        if self._prefill_static is None:
+            from .. import jit
+            # eager prefill pays per-op dispatch for every layer on every
+            # request; compiled, each bucket is one XLA call
+            self._prefill_static = jit.to_static(
+                self._prefill_forward, state=[self.model])
         with no_grad():
-            nxt, kvs = self._prefill_forward(ids, n)
+            nxt, kvs = self._prefill_static(
+                ids, Tensor(jnp.asarray(n - 1, jnp.int32)))
         kvs = [(k[:n], v[:n]) for k, v in kvs]
         seq_id = req.seq_id
         page_ids, offs = self.alloc.page_positions(

@@ -17,6 +17,7 @@ __all__ = [
     "embedding", "normalize", "cosine_similarity", "bilinear",
     "label_smooth", "interpolate", "upsample", "pixel_shuffle",
     "pixel_unshuffle", "channel_shuffle", "unfold", "fold", "one_hot",
+    "grid_sample",
 ]
 
 
@@ -257,3 +258,59 @@ def upsample(x, size=None, scale_factor=None, mode="nearest",
              align_corners=False, data_format="NCHW", name=None):
     return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
                        align_corners=align_corners, data_format=data_format)
+
+
+@defop(differentiable=True)
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Sample ``x [N, C, H, W]`` at normalized ``grid [N, Ho, Wo, 2]``
+    coordinates in [-1, 1] (reference `nn/functional/vision.py:grid_sample`,
+    CUDA kernel `phi/kernels/gpu/grid_sample_kernel.cu`). TPU-native:
+    the bilinear taps are four gathers + a weighted sum XLA fuses."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode must be bilinear/nearest, got {mode!r}")
+    if padding_mode not in ("zeros", "border"):
+        raise ValueError(
+            f"padding_mode must be zeros/border, got {padding_mode!r}")
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+
+    def gather(yi, xi):
+        yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        flat = (yi_c * w + xi_c).reshape(n, 1, -1)       # [N, 1, Ho*Wo]
+        xf = x.reshape(n, c, h * w)
+        out = jnp.take_along_axis(
+            xf, jnp.broadcast_to(flat, (n, c, flat.shape[-1])), axis=-1)
+        return out.reshape(n, c, *gx.shape[1:])
+
+    def in_bounds(yi, xi):
+        if padding_mode == "border":
+            return jnp.ones_like(yi, dtype=x.dtype)
+        return ((yi >= 0) & (yi <= h - 1) & (xi >= 0)
+                & (xi <= w - 1)).astype(x.dtype)
+
+    if mode == "nearest":
+        yi = jnp.round(fy)
+        xi = jnp.round(fx)
+        return gather(yi, xi) * in_bounds(yi, xi)[:, None]
+
+    y0 = jnp.floor(fy)
+    x0 = jnp.floor(fx)
+    wy1 = fy - y0
+    wx1 = fx - x0
+    out = 0.0
+    for (yy, xx, wgt) in [
+            (y0, x0, (1 - wy1) * (1 - wx1)),
+            (y0, x0 + 1, (1 - wy1) * wx1),
+            (y0 + 1, x0, wy1 * (1 - wx1)),
+            (y0 + 1, x0 + 1, wy1 * wx1)]:
+        out = out + gather(yy, xx) * (wgt * in_bounds(yy, xx))[:, None]
+    return out

@@ -16,6 +16,7 @@ from ..framework.tensor import Tensor
 from .registry import defop
 
 __all__ = [
+    "lu_unpack",
     "matmul", "mm", "bmm", "dot", "mv", "t", "norm", "dist", "cross",
     "cholesky", "cholesky_solve", "qr", "svd", "pca_lowrank", "eig", "eigh",
     "eigvals", "eigvalsh", "det", "slogdet", "inv", "pinv", "solve",
@@ -290,3 +291,35 @@ def householder_product(x, tau):
 @defop()
 def matrix_exp(x):
     return jax.scipy.linalg.expm(x)
+
+
+@defop()
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
+    """Unpack jax lu_factor output into (P, L, U) (reference
+    `tensor/linalg.py:lu_unpack`; ``y`` is the 1-based pivot vector that
+    :func:`lu` returns)."""
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    if unpack_ludata:
+        tri_l = jnp.tril(x[..., :, :k], k=-1)
+        eye = jnp.eye(m, k, dtype=x.dtype)
+        l_mat = tri_l + eye
+        u_mat = jnp.triu(x[..., :k, :])
+    else:
+        l_mat = u_mat = jnp.zeros((0,), x.dtype)
+    if unpack_pivots:
+        piv = jnp.asarray(y, jnp.int32) - 1           # back to 0-based
+        perm = jnp.arange(m, dtype=jnp.int32)
+
+        def swap(i, p):
+            j = piv[..., i]
+            pi, pj = p[..., i], p[j]
+            p = p.at[..., i].set(pj)
+            return p.at[j].set(pi)
+
+        for i in range(piv.shape[-1]):   # pivot count is static
+            perm = swap(i, perm)
+        p_mat = jnp.eye(m, dtype=x.dtype)[perm].T
+    else:
+        p_mat = jnp.zeros((0,), x.dtype)
+    return p_mat, l_mat, u_mat

@@ -18,6 +18,7 @@ __all__ = [
     "cast", "slice", "strided_slice", "gather", "gather_nd", "scatter",
     "scatter_nd", "scatter_nd_add", "index_select", "index_add", "index_put",
     "masked_select", "masked_fill", "masked_scatter", "where", "take_along_axis",
+    "index_fill",
     "put_along_axis", "flip", "rot90", "roll", "unique", "unique_consecutive",
     "unbind", "unstack", "repeat_interleave", "as_strided", "view", "view_as",
     "tensordot", "crop", "pad", "shard_index", "tolist", "as_complex",
@@ -607,3 +608,14 @@ def _setitem(x, idx, value):
     x._data, x._node, x._out_index = out._data, out._node, out._out_index
     if not out.stop_gradient:
         x.stop_gradient = False
+
+
+@defop(method=True, inplace_method="index_fill_")
+def index_fill(x, index, axis, value):
+    """Fill rows of ``axis`` selected by ``index`` with ``value``
+    (reference `tensor/manipulation.py:index_fill`)."""
+    idx = jnp.asarray(index).reshape(-1)
+    v = jnp.asarray(value, dtype=x.dtype)
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[idx].set(v)
+    return jnp.moveaxis(moved, 0, axis)

@@ -16,6 +16,7 @@ from ..framework.tensor import Tensor
 from .registry import defop
 
 __all__ = [
+    "trapezoid", "cumulative_trapezoid",
     "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
     "pow", "float_power", "maximum", "minimum", "fmax", "fmin",
     "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
@@ -438,3 +439,33 @@ def multiply_(x, y):
     out = multiply(x, y)
     x._data, x._node, x._out_index = out._data, out._node, out._out_index
     return x
+
+
+@defop(method=True)
+def trapezoid(y, x=None, dx=None, axis=-1):
+    """Trapezoidal rule integral (reference `tensor/math.py:trapezoid`)."""
+    if x is not None and dx is not None:
+        raise ValueError("pass either x or dx, not both")
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+@defop(method=True)
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    """Cumulative trapezoid (reference `tensor/math.py`): running sum of
+    the per-segment trapezoid areas along ``axis``."""
+    if x is not None and dx is not None:
+        raise ValueError("pass either x or dx, not both")
+    y0 = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+    if x is not None:
+        x = jnp.asarray(x)
+        if x.ndim == 1:
+            shape = [1] * y.ndim
+            shape[axis] = x.shape[0]
+            x = x.reshape(shape)
+        d = jnp.diff(x, axis=axis)
+    else:
+        d = 1.0 if dx is None else dx
+    return jnp.cumsum((y0 + y1) * 0.5 * d, axis=axis)

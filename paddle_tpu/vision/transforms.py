@@ -10,7 +10,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
-           "RandomCrop", "RandomHorizontalFlip", "Transpose"]
+           "RandomCrop", "RandomHorizontalFlip", "Transpose",
+           "RandomResizedCrop", "RandomVerticalFlip", "ColorJitter"]
 
 
 class Compose:
@@ -120,3 +121,73 @@ class Transpose:
 
     def __call__(self, img):
         return np.transpose(np.asarray(img), self.order)
+
+
+class RandomResizedCrop:
+    """Random area+aspect crop then resize (reference
+    `vision/transforms/transforms.py:RandomResizedCrop`). HWC arrays,
+    like the other transforms here."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round((target * ar) ** 0.5))
+            ch = int(round((target / ar) ** 0.5))
+            if 0 < cw <= w and 0 < ch <= h:
+                y = np.random.randint(0, h - ch + 1)
+                x = np.random.randint(0, w - cw + 1)
+                return _resize_nn(arr[y:y + ch, x:x + cw], self.size)
+        # fallback: center crop of the smaller side
+        s = min(h, w)
+        y, x = (h - s) // 2, (w - s) // 2
+        return _resize_nn(arr[y:y + s, x:x + s], self.size)
+
+
+class RandomVerticalFlip:
+    """Reference RandomVerticalFlip (HWC)."""
+
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if np.random.random() < self.prob:
+            return arr[::-1].copy()
+        return arr
+
+
+class ColorJitter:
+    """Brightness/contrast jitter on HWC float arrays (reference
+    ColorJitter; hue/saturation need HSV — brightness/contrast cover the
+    common training recipes)."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0):
+        if saturation or hue:
+            raise NotImplementedError(
+                "saturation/hue jitter not supported (needs HSV space); "
+                "use brightness/contrast")
+        self.brightness = brightness
+        self.contrast = contrast
+
+    def __call__(self, img):
+        out = np.asarray(img)
+        if self.brightness:
+            f = np.random.uniform(max(0, 1 - self.brightness),
+                                  1 + self.brightness)
+            out = out * f
+        if self.contrast:
+            f = np.random.uniform(max(0, 1 - self.contrast),
+                                  1 + self.contrast)
+            out = (out - out.mean()) * f + out.mean()
+        return out

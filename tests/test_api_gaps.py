@@ -1,0 +1,137 @@
+"""Tests for the long-tail API additions: grid_sample, index_fill,
+trapezoid/cumulative_trapezoid, lu_unpack, new transforms, and the
+namespace aliases (callbacks/sysconfig/get_worker_info/segment aliases)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestGridSample:
+    def test_identity_grid(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(1, 2, 5, 7).astype("float32"),
+                             stop_gradient=False)
+        ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 7),
+                             indexing="ij")
+        grid = paddle.to_tensor(
+            np.stack([xs, ys], -1)[None].astype("float32"))
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+        out.sum().backward()
+        assert x.grad is not None
+
+    def test_zeros_vs_border_padding(self):
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+        far = paddle.to_tensor(np.full((1, 1, 1, 2), 5.0, np.float32))
+        assert float(F.grid_sample(x, far, padding_mode="zeros")
+                     .abs().sum()) == 0.0
+        assert abs(float(F.grid_sample(x, far, padding_mode="border")
+                         .sum()) - 1.0) < 1e-6
+
+    def test_nearest_mode(self):
+        x = paddle.to_tensor(
+            np.arange(16).reshape(1, 1, 4, 4).astype("float32"))
+        # grid point at exactly pixel (1, 2): x=-1+2*2/3 ... use align
+        # corners mapping: gx = 2*j/(W-1)-1
+        gx, gy = 2 * 2 / 3 - 1, 2 * 1 / 3 - 1
+        g = paddle.to_tensor(np.array([[[[gx, gy]]]], np.float32))
+        out = F.grid_sample(x, g, mode="nearest")
+        assert float(out[0, 0, 0, 0]) == 6.0  # row 1, col 2
+
+    def test_rejects_bad_modes(self):
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+        g = paddle.to_tensor(np.zeros((1, 1, 1, 2), np.float32))
+        with pytest.raises(ValueError):
+            F.grid_sample(x, g, mode="bicubic")
+        with pytest.raises(ValueError):
+            F.grid_sample(x, g, padding_mode="reflection")
+
+
+class TestSmallTensorOps:
+    def test_index_fill_and_inplace(self):
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype("float32"))
+        out = paddle.index_fill(x, paddle.to_tensor(np.array([0, 2])), 0,
+                                -1.0)
+        assert (out.numpy()[[0, 2]] == -1).all()
+        assert (out.numpy()[1] == x.numpy()[1]).all()
+        x.index_fill_(paddle.to_tensor(np.array([1])), 0, 9.0)
+        assert (x.numpy()[1] == 9).all()
+
+    def test_index_fill_axis1(self):
+        x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        out = paddle.index_fill(x, paddle.to_tensor(np.array([2])), 1, 7.0)
+        np.testing.assert_array_equal(out.numpy()[:, 2], [7, 7])
+        assert (out.numpy()[:, :2] == 0).all()
+
+    def test_trapezoid(self):
+        y = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        assert abs(float(paddle.trapezoid(y)) - 4.0) < 1e-6
+        xs = paddle.to_tensor(np.array([0.0, 2.0, 3.0], np.float32))
+        assert abs(float(paddle.trapezoid(y, x=xs)) - 5.5) < 1e-6
+        assert abs(float(paddle.trapezoid(y, dx=2.0)) - 8.0) < 1e-6
+
+    def test_cumulative_trapezoid(self):
+        y = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        np.testing.assert_allclose(
+            paddle.tensor.math.cumulative_trapezoid(y).numpy(),
+            [1.5, 4.0], rtol=1e-6)
+
+    def test_lu_unpack_reconstructs(self):
+        a = paddle.to_tensor(
+            np.random.RandomState(0).randn(5, 5).astype("float32"))
+        lu_mat, piv = paddle.linalg.lu(a)
+        P, L, U = paddle.linalg.lu_unpack(lu_mat, piv)
+        np.testing.assert_allclose(
+            P.numpy() @ L.numpy() @ U.numpy(), a.numpy(), rtol=1e-4,
+            atol=1e-5)
+        # L unit-lower-triangular, U upper-triangular
+        assert np.allclose(np.diag(L.numpy()), 1.0)
+        assert np.allclose(np.tril(U.numpy(), -1), 0.0)
+
+
+class TestTransforms:
+    def test_random_resized_crop_shape(self):
+        from paddle_tpu.vision.transforms import RandomResizedCrop
+        np.random.seed(0)
+        img = np.random.rand(32, 48, 3).astype("float32")
+        out = RandomResizedCrop(16)(img)
+        assert out.shape == (16, 16, 3)
+
+    def test_vertical_flip(self):
+        from paddle_tpu.vision.transforms import RandomVerticalFlip
+        img = np.random.rand(8, 8, 3).astype("float32")
+        np.testing.assert_array_equal(RandomVerticalFlip(1.0)(img),
+                                      img[::-1])
+        np.testing.assert_array_equal(RandomVerticalFlip(0.0)(img), img)
+
+    def test_color_jitter(self):
+        from paddle_tpu.vision.transforms import ColorJitter
+        img = np.random.rand(8, 8, 3).astype("float32")
+        assert ColorJitter(brightness=0.5)(img).shape == img.shape
+        with pytest.raises(NotImplementedError):
+            ColorJitter(hue=0.1)
+
+
+class TestAliases:
+    def test_callbacks_and_sysconfig(self):
+        import os
+        assert hasattr(paddle.callbacks, "Callback") \
+            or hasattr(paddle.callbacks, "EarlyStopping") \
+            or len(dir(paddle.callbacks)) > 3
+        assert os.path.isdir(paddle.sysconfig.get_include())
+
+    def test_worker_info(self):
+        assert paddle.io.get_worker_info() is None
+        w = paddle.io.WorkerInfo(id=1, num_workers=4)
+        assert w.id == 1 and w.num_workers == 4
+
+    def test_incubate_segment_aliases(self):
+        from paddle_tpu.incubate import segment_sum
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1]))
+        out = segment_sum(x, ids)
+        np.testing.assert_allclose(out.numpy(), [[3.0], [3.0]])
