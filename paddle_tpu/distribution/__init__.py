@@ -16,6 +16,7 @@ from __future__ import annotations
 import math
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax.scipy import special as jsp
 
@@ -881,3 +882,257 @@ def _kl_dirichlet(p, q):
                                        - jsp.digamma(sp)[..., None]), -1))
 
     return run_op("kl_dirichlet", fn, (p.concentration, q.concentration))
+
+
+class Binomial(Distribution):
+    """Reference `distribution/binomial.py`."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(jnp.broadcast_shapes(
+            self.total_count._data.shape, self.probs._data.shape))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(n, p):
+            return jax.random.binomial(key, n, p, shape=out_shape) \
+                .astype(jnp.float32)
+
+        return run_op("binomial_sample", fn, (self.total_count, self.probs),
+                      differentiable=False)
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, n, p):
+            logc = (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(n - v + 1))
+            return logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+
+        return run_op("binomial_log_prob", fn,
+                      (value, self.total_count, self.probs))
+
+    def entropy(self):
+        # half the support often suffices; exact via summation over k
+        def fn(n, p):
+            nmax = int(np.max(np.asarray(n)))
+            k = jnp.arange(nmax + 1, dtype=jnp.float32)
+            logc = (jsp.gammaln(n[..., None] + 1) - jsp.gammaln(k + 1)
+                    - jsp.gammaln(n[..., None] - k + 1))
+            logp = logc + k * jnp.log(p[..., None]) \
+                + (n[..., None] - k) * jnp.log1p(-p[..., None])
+            mask = k <= n[..., None]
+            pk = jnp.where(mask, jnp.exp(logp), 0.0)
+            return -jnp.sum(pk * jnp.where(mask, logp, 0.0), axis=-1)
+
+        return run_op("binomial_entropy", fn,
+                      (self.total_count, self.probs))
+
+
+class ContinuousBernoulli(Distribution):
+    """Reference `distribution/continuous_bernoulli.py`: the [0, 1]
+    continuous relaxation with normalizer C(p)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(self.probs._data.shape)
+
+    def _log_norm(self, p):
+        # C(p) = 2*atanh(1-2p) / (1-2p) for p != 0.5, else 2
+        safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+        near_half = jnp.abs(safe - 0.5) < (self._lims[1] - 0.5)
+        x = jnp.where(near_half, 0.4, safe)  # safe value for the formula
+        c = 2 * jnp.arctanh(1 - 2 * x) / (1 - 2 * x)
+        # 2nd-order Taylor around 0.5: C = 2 + (4/3)*(p-1/2)^2
+        taylor = 2.0 + (4.0 / 3.0) * (safe - 0.5) ** 2
+        return jnp.log(jnp.where(near_half, taylor, c))
+
+    @property
+    def mean(self):
+        def fn(p):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            near_half = jnp.abs(safe - 0.5) < (self._lims[1] - 0.5)
+            x = jnp.where(near_half, 0.4, safe)
+            m = x / (2 * x - 1) + 1 / (2 * jnp.arctanh(1 - 2 * x))
+            return jnp.where(near_half, 0.5, m)
+
+        return run_op("cb_mean", fn, (self.probs,))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(p):
+            u = jax.random.uniform(key, out_shape)
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            near_half = jnp.abs(safe - 0.5) < (self._lims[1] - 0.5)
+            x = jnp.where(near_half, 0.4, safe)
+            # inverse CDF for p != 0.5
+            icdf = (jnp.log1p(u * (2 * x - 1) / (1 - x))
+                    / (jnp.log(x) - jnp.log1p(-x)))
+            return jnp.where(near_half, u, icdf)
+
+        return run_op("cb_sample", fn, (self.probs,),
+                      differentiable=False)
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, p):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            return (v * jnp.log(safe) + (1 - v) * jnp.log1p(-safe)
+                    + self._log_norm(safe))
+
+        return run_op("cb_log_prob", fn, (value, self.probs))
+
+
+class Independent(Distribution):
+    """Reference `distribution/independent.py`: reinterpret the last
+    ``reinterpreted_batch_rank`` batch dims as event dims (log_prob
+    sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        if self.rank > len(base.batch_shape):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self.rank} exceeds base batch "
+                f"rank {len(base.batch_shape)}")
+        super().__init__(tuple(base.batch_shape)[:len(base.batch_shape)
+                                                 - self.rank])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        for _ in range(self.rank):
+            lp = lp.sum(-1)
+        return lp
+
+    def entropy(self):
+        e = self.base.entropy()
+        for _ in range(self.rank):
+            e = e.sum(-1)
+        return e
+
+
+class MultivariateNormal(Distribution):
+    """Reference `distribution/multivariate_normal.py` (loc +
+    covariance_matrix parameterization)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _t(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError(
+                "pass exactly one of covariance_matrix/scale_tril")
+        if covariance_matrix is not None:
+            self.covariance_matrix = _t(covariance_matrix)
+            self._tril = run_op(
+                "mvn_chol", lambda c: jnp.linalg.cholesky(c),
+                (self.covariance_matrix,))
+        else:
+            self._tril = _t(scale_tril)
+            self.covariance_matrix = run_op(
+                "mvn_cov", lambda L: L @ jnp.swapaxes(L, -1, -2),
+                (self._tril,))
+        super().__init__(self.loc._data.shape[:-1])
+        self._d = self.loc._data.shape[-1]
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return run_op(
+            "mvn_var", lambda c: jnp.diagonal(c, axis1=-2, axis2=-1),
+            (self.covariance_matrix,))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape) + (self._d,)
+
+        def fn(mu, L):
+            eps = jax.random.normal(key, out_shape)
+            return mu + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return run_op("mvn_sample", fn, (self.loc, self._tril),
+                      differentiable=False)
+
+    def rsample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape) + (self._d,)
+
+        def fn(mu, L):
+            eps = jax.random.normal(key, out_shape)
+            return mu + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return run_op("mvn_rsample", fn, (self.loc, self._tril))
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, mu, L):
+            diff = v - mu
+            sol = jax.scipy.linalg.solve_triangular(
+                L, diff[..., None], lower=True)[..., 0]
+            maha = jnp.sum(sol ** 2, axis=-1)
+            logdet = 2 * jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+            return -0.5 * (self._d * jnp.log(2 * jnp.pi) + logdet + maha)
+
+        return run_op("mvn_log_prob", fn, (value, self.loc, self._tril))
+
+    def entropy(self):
+        def fn(L):
+            logdet = 2 * jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+            return 0.5 * self._d * (1 + jnp.log(2 * jnp.pi)) + 0.5 * logdet
+
+        return run_op("mvn_entropy", fn, (self._tril,))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    def fn(mu_p, Lp, mu_q, Lq):
+        d = mu_p.shape[-1]
+        diff = mu_q - mu_p
+        sol_mean = jax.scipy.linalg.solve_triangular(
+            Lq, diff[..., None], lower=True)[..., 0]
+        m = jax.scipy.linalg.solve_triangular(
+            Lq, Lp, lower=True)
+        tr = jnp.sum(m ** 2, axis=(-2, -1))
+        logdet_p = 2 * jnp.sum(
+            jnp.log(jnp.diagonal(Lp, axis1=-2, axis2=-1)), axis=-1)
+        logdet_q = 2 * jnp.sum(
+            jnp.log(jnp.diagonal(Lq, axis1=-2, axis2=-1)), axis=-1)
+        return 0.5 * (tr + jnp.sum(sol_mean ** 2, axis=-1) - d
+                      + logdet_q - logdet_p)
+
+    return run_op("kl_mvn", fn, (p.loc, p._tril, q.loc, q._tril))
+
+
+__all__ += ["Binomial", "ContinuousBernoulli", "Independent",
+            "MultivariateNormal"]

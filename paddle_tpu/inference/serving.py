@@ -246,6 +246,25 @@ class LlamaServingEngine:
             self.alloc.release(req.seq_id)
             del self._live[req.seq_id]
 
+    def _views_np(self, live):
+        """Padded (tokens?, tables, lens) numpy views for the full
+        [max_batch] slot layout — pure host work, ONE H2D per array."""
+        b = self.max_batch
+        tables = np.full((b, self.width), self.trash_page, np.int32)
+        lens = np.ones((b,), np.int32)
+        for i, r in enumerate(live):
+            t = self.alloc._tables[r.seq_id]
+            tables[i, :len(t)] = t
+            lens[i] = self.alloc._lens[r.seq_id]
+        return tables, lens
+
+    def _ensure_decode_compiled(self):
+        if self._decode_static is None:
+            from .. import jit
+            self._decode_static = jit.to_static(
+                self._decode_step, state=[self.model])
+        return self._decode_static
+
     def step(self):
         """Decode one token for every live request. Returns the number of
         live requests served."""
@@ -256,33 +275,56 @@ class LlamaServingEngine:
         # and the kernel's context length both include it
         for r in live:
             self.alloc.extend(r.seq_id, 1)
-        b = self.max_batch
-        tokens = np.zeros((b, 1), np.int64)
+        tokens = np.zeros((self.max_batch, 1), np.int64)
         for i, r in enumerate(live):
             tokens[i, 0] = r.output_ids[-1] if r.output_ids \
                 else r.prompt_ids[-1]
-        tables, lens = self.alloc.batch_views(
-            [r.seq_id for r in live], width=self.width,
-            fill_page=self.trash_page)
-        pad = b - len(live)
-        if pad:
-            tables = jnp.concatenate(
-                [tables, jnp.full((pad, self.width), self.trash_page,
-                                  jnp.int32)])
-            lens = jnp.concatenate([lens, jnp.ones((pad,), jnp.int32)])
-
-        if self._decode_static is None:
-            from .. import jit
-            self._decode_static = jit.to_static(
-                self._decode_step, state=[self.model])
-        nxt, new_k, new_v = self._decode_static(
-            Tensor(jnp.asarray(tokens)), Tensor(tables), Tensor(lens),
-            self.k_pools, self.v_pools)
+        tables, lens = self._views_np(live)
+        step = self._ensure_decode_compiled()
+        nxt, new_k, new_v = step(
+            Tensor(jnp.asarray(tokens)), Tensor(jnp.asarray(tables)),
+            Tensor(jnp.asarray(lens)), self.k_pools, self.v_pools)
         self.k_pools, self.v_pools = list(new_k), list(new_v)
         out = np.asarray(nxt._data).reshape(-1)
         for i, r in enumerate(live):
             self._emit(r, int(out[i]))
         return len(live)
+
+    def decode_many(self, n):
+        """Fast path: ``n`` chained decode steps for the current live set
+        with NO host sync inside the loop — next tokens feed the next
+        step as device arrays, page views are precomputed on the host,
+        and the emitted tokens are fetched once at the end. Valid when no
+        request can retire mid-run (no EOS; none reaches max_new_tokens
+        before the n-th step)."""
+        live = [r for r in self._live.values() if not r.done]
+        if not live:
+            return 0
+        assert all(r.eos_token_id is None
+                   and len(r.output_ids) + n <= r.max_new_tokens
+                   for r in live), "decode_many needs retire-free steps"
+        step = self._ensure_decode_compiled()
+        tokens = np.zeros((self.max_batch, 1), np.int64)
+        for i, r in enumerate(live):
+            tokens[i, 0] = r.output_ids[-1] if r.output_ids \
+                else r.prompt_ids[-1]
+        tok_t = Tensor(jnp.asarray(tokens))
+        outs = []
+        for _ in range(n):
+            for r in live:
+                self.alloc.extend(r.seq_id, 1)
+            tables, lens = self._views_np(live)
+            nxt, new_k, new_v = step(
+                tok_t, Tensor(jnp.asarray(tables)),
+                Tensor(jnp.asarray(lens)), self.k_pools, self.v_pools)
+            self.k_pools, self.v_pools = list(new_k), list(new_v)
+            outs.append(nxt._data)
+            tok_t = nxt.reshape([self.max_batch, 1])
+        all_tokens = np.asarray(jnp.concatenate(outs, axis=1))  # one D2H
+        for i, r in enumerate(live):
+            for t in range(n):
+                self._emit(r, int(all_tokens[i, t]))
+        return len(live) * n
 
     def generate(self, prompts, max_new_tokens=16, eos_token_id=None):
         """Convenience batch API: admit all prompts (continuous batching
@@ -293,6 +335,15 @@ class LlamaServingEngine:
         while pending or any(not r.done for r in reqs):
             while pending and len(self._live) < self.max_batch:
                 self.add_request(pending.pop(0))
+            live = [r for r in self._live.values() if not r.done]
+            # sync-free fast path while no request can retire and the
+            # batch is as full as it can get
+            if live and not pending:
+                burst = min(r.max_new_tokens - len(r.output_ids)
+                            for r in live)
+                if eos_token_id is None and burst > 1:
+                    self.decode_many(burst)
+                    continue
             if not self.step() and pending:
                 continue
             if not pending and all(r.done for r in reqs):

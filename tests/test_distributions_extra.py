@@ -1,0 +1,125 @@
+"""Binomial / ContinuousBernoulli / Independent / MultivariateNormal
+(reference `python/paddle/distribution/{binomial,continuous_bernoulli,
+independent,multivariate_normal}.py`), validated against scipy."""
+
+import numpy as np
+import pytest
+from scipy import stats
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Binomial, ContinuousBernoulli,
+                                     Independent, MultivariateNormal,
+                                     Normal, kl_divergence)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(7)
+
+
+class TestBinomial:
+    def test_moments_and_log_prob(self):
+        b = Binomial(paddle.to_tensor(10.0), paddle.to_tensor(0.3))
+        assert abs(float(b.mean) - 3.0) < 1e-6
+        assert abs(float(b.variance) - 2.1) < 1e-6
+        lp = float(b.log_prob(paddle.to_tensor(4.0)))
+        assert abs(lp - stats.binom.logpmf(4, 10, 0.3)) < 1e-3
+        assert abs(float(b.entropy())
+                   - stats.binom.entropy(10, 0.3)) < 2e-3
+
+    def test_sample_mean(self):
+        b = Binomial(paddle.to_tensor(10.0), paddle.to_tensor(0.3))
+        s = b.sample([3000])
+        assert abs(float(s.mean()) - 3.0) < 0.2
+
+
+class TestContinuousBernoulli:
+    def test_density_normalizes(self):
+        cb = ContinuousBernoulli(paddle.to_tensor(0.3))
+        xs = np.linspace(1e-4, 1 - 1e-4, 20001).astype(np.float32)
+        dense = np.exp(np.asarray(
+            cb.log_prob(paddle.to_tensor(xs))._data))
+        assert abs(np.trapezoid(dense, xs) - 1.0) < 1e-2
+
+    def test_half_is_uniform(self):
+        cb = ContinuousBernoulli(paddle.to_tensor(0.5))
+        assert abs(float(cb.mean) - 0.5) < 1e-5
+        # density == 1 everywhere for p = 1/2 (Taylor branch)
+        lp = float(cb.log_prob(paddle.to_tensor(0.123)))
+        assert abs(lp) < 5e-2
+
+    def test_samples_in_unit_interval(self):
+        cb = ContinuousBernoulli(paddle.to_tensor(0.8))
+        s = np.asarray(cb.sample([1000])._data)
+        assert (s >= 0).all() and (s <= 1).all()
+        assert s.mean() > 0.55  # skewed toward 1 for p = 0.8
+
+
+class TestIndependent:
+    def test_log_prob_sums_event_dims(self):
+        base = Normal(paddle.to_tensor(np.zeros((3, 4), np.float32)),
+                      paddle.to_tensor(np.ones((3, 4), np.float32)))
+        ind = Independent(base, 1)
+        v = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        lp = ind.log_prob(v)
+        assert list(lp.shape) == [3]
+        np.testing.assert_allclose(lp.numpy(),
+                                   base.log_prob(v).numpy().sum(-1),
+                                   rtol=1e-6)
+
+    def test_rank_validation(self):
+        base = Normal(paddle.to_tensor(np.zeros(3, np.float32)),
+                      paddle.to_tensor(np.ones(3, np.float32)))
+        with pytest.raises(ValueError):
+            Independent(base, 2)
+
+
+class TestMultivariateNormal:
+    COV = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+
+    def _mvn(self):
+        return MultivariateNormal(
+            paddle.to_tensor(np.zeros(2, np.float32)),
+            covariance_matrix=paddle.to_tensor(self.COV))
+
+    def test_log_prob_vs_scipy(self):
+        v = np.array([0.3, -0.2], np.float32)
+        lp = float(self._mvn().log_prob(paddle.to_tensor(v)))
+        ref = stats.multivariate_normal.logpdf(v, np.zeros(2), self.COV)
+        assert abs(lp - ref) < 1e-3
+
+    def test_entropy_vs_scipy(self):
+        want = stats.multivariate_normal(np.zeros(2), self.COV).entropy()
+        assert abs(float(self._mvn().entropy()) - want) < 1e-3
+
+    def test_sample_covariance(self):
+        s = np.asarray(self._mvn().sample([4000])._data)
+        np.testing.assert_allclose(np.cov(s.T), self.COV, atol=0.25)
+
+    def test_scale_tril_parameterization(self):
+        L = np.linalg.cholesky(self.COV).astype(np.float32)
+        mvn = MultivariateNormal(paddle.to_tensor(np.zeros(2, np.float32)),
+                                 scale_tril=paddle.to_tensor(L))
+        np.testing.assert_allclose(mvn.covariance_matrix.numpy(), self.COV,
+                                   rtol=1e-5)
+
+    def test_kl_closed_form(self):
+        import numpy.linalg as la
+        p = self._mvn()
+        q = MultivariateNormal(
+            paddle.to_tensor(np.ones(2, np.float32)),
+            covariance_matrix=paddle.to_tensor(np.eye(2, dtype=np.float32)))
+        kl = float(kl_divergence(p, q))
+        diff = np.ones(2)
+        want = 0.5 * (np.trace(self.COV) + diff @ diff - 2
+                      - np.log(la.det(self.COV)))
+        assert abs(kl - want) < 1e-3
+
+    def test_rsample_differentiable(self):
+        loc = paddle.to_tensor(np.zeros(2, np.float32),
+                               stop_gradient=False)
+        mvn = MultivariateNormal(
+            loc, covariance_matrix=paddle.to_tensor(self.COV))
+        s = mvn.rsample([8])
+        (s ** 2).sum().backward()
+        assert loc.grad is not None
