@@ -336,12 +336,15 @@ class LlamaServingEngine:
             while pending and len(self._live) < self.max_batch:
                 self.add_request(pending.pop(0))
             live = [r for r in self._live.values() if not r.done]
-            # sync-free fast path while no request can retire and the
-            # batch is as full as it can get
-            if live and not pending:
+            # sync-free fast path while no request can retire; with
+            # pending admissions cap the burst so a retirement (and the
+            # admission it enables) is never far away
+            if live and eos_token_id is None:
                 burst = min(r.max_new_tokens - len(r.output_ids)
                             for r in live)
-                if eos_token_id is None and burst > 1:
+                if pending:
+                    burst = min(burst, 8)
+                if burst > 1:
                     self.decode_many(burst)
                     continue
             if not self.step() and pending:

@@ -2,7 +2,8 @@
 Viterbi decoding + classic NLP datasets."""
 
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
-from .datasets import UCIHousing, Imdb, Imikolov, Movielens  # noqa: F401
+from .datasets import (  # noqa: F401
+    UCIHousing, Imdb, Imikolov, Movielens, WMT16)
 
 __all__ = ["ViterbiDecoder", "viterbi_decode",
-           "UCIHousing", "Imdb", "Imikolov", "Movielens"]
+           "UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT16"]

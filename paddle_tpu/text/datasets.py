@@ -19,7 +19,7 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens"]
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT16"]
 
 
 class UCIHousing(Dataset):
@@ -302,3 +302,77 @@ class Movielens(Dataset):
 
     def __len__(self):
         return len(self.data)
+
+
+class WMT16(Dataset):
+    """WMT16 en-de parallel corpus from the reference's tar layout
+    (reference `text/datasets/wmt16.py`): members ``wmt16/{train,val,
+    test}`` hold tab-separated "en\\tde" lines. Per-language vocabularies
+    keep the ``dict_size`` most frequent train-set words behind the
+    <s>/<e>/<unk> markers (built in memory — the reference caches dict
+    files on disk). Examples are (src_ids with <s>...<e>, trg_ids with
+    leading <s>, trg_ids_next with trailing <e>)."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=False):
+        if mode.lower() not in ("train", "val", "test"):
+            raise ValueError(
+                f"mode should be 'train', 'val' or 'test', got {mode}")
+        if lang not in ("en", "de"):
+            raise ValueError(f"lang should be 'en' or 'de', got {lang}")
+        if data_file is None:
+            raise ValueError(
+                "data_file is required (no network in this build): pass "
+                "the wmt16 tar archive the reference downloads")
+        self.mode = mode.lower()
+        self.lang = lang
+        self.data_file = data_file
+        self.src_dict = self._build_dict(lang, src_dict_size)
+        self.trg_dict = self._build_dict("de" if lang == "en" else "en",
+                                         trg_dict_size)
+        self._load_data()
+
+    def _build_dict(self, lang, dict_size):
+        col = 0 if lang == "en" else 1
+        freq = collections.defaultdict(int)
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile("wmt16/train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[col].split():
+                    freq[w] += 1
+        words = [w for w, _ in sorted(freq.items(),
+                                      key=lambda x: (-x[1], x[0]))]
+        if dict_size > 0:
+            words = words[:max(dict_size - 3, 0)]
+        vocab = [self.START, self.END, self.UNK] + words
+        return {w: i for i, w in enumerate(vocab)}
+
+    def _load_data(self):
+        start = self.src_dict[self.START]
+        end = self.src_dict[self.END]
+        unk = self.src_dict[self.UNK]
+        src_col = 0 if self.lang == "en" else 1
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [self.src_dict.get(w, unk)
+                       for w in parts[src_col].split()]
+                trg = [self.trg_dict.get(w, unk)
+                       for w in parts[1 - src_col].split()]
+                self.src_ids.append([start] + src + [end])
+                self.trg_ids.append([start] + trg)
+                self.trg_ids_next.append(trg + [end])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)

@@ -280,3 +280,48 @@ class TestHub:
             "def m():\n    return 1\n")
         with pytest.raises(RuntimeError, match="not_a_real_pkg"):
             paddle.hub.load(str(tmp_path), "m", source="local")
+
+
+def _make_wmt16_tar(path):
+    files = {
+        "wmt16/train": b"the cat\tdie katze\na dog\tein hund\n"
+                       b"the dog\tder hund\nbad line without tab\n",
+        "wmt16/val": b"the cat\tdie katze\n",
+        "wmt16/test": b"a dog\tein hund\n",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+class TestWMT16:
+    def test_vocab_and_example_layout(self, tmp_path):
+        f = tmp_path / "wmt16.tar.gz"
+        _make_wmt16_tar(f)
+        ds = text.WMT16(data_file=str(f), mode="train", lang="en")
+        start = ds.src_dict["<s>"]
+        end = ds.src_dict["<e>"]
+        assert (start, end) == (0, 1)
+        assert len(ds) == 3  # malformed line skipped
+        src, trg, trg_next = ds[0]
+        assert src[0] == start and src[-1] == end
+        assert trg[0] == start and trg_next[-1] == end
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+
+    def test_lang_swap(self, tmp_path):
+        f = tmp_path / "wmt16.tar.gz"
+        _make_wmt16_tar(f)
+        en = text.WMT16(data_file=str(f), mode="val", lang="en")
+        de = text.WMT16(data_file=str(f), mode="val", lang="de")
+        # en source length ("the cat" + markers) vs de ("die katze")
+        assert len(en[0][0]) == 4 and len(de[0][0]) == 4
+        assert en.src_dict.keys() != de.src_dict.keys()
+
+    def test_dict_size_truncation(self, tmp_path):
+        f = tmp_path / "wmt16.tar.gz"
+        _make_wmt16_tar(f)
+        ds = text.WMT16(data_file=str(f), mode="train", lang="en",
+                        src_dict_size=4)
+        assert len(ds.src_dict) == 4  # 3 markers + 1 word
