@@ -64,6 +64,19 @@ def _page_write(pages, new, page_ids, offs):
                   differentiable=False)
 
 
+def _page_write_seq(pages, new, page_ids, offs):
+    """Scatter a whole sequence ``new [S, Hk, D]`` into ``pages`` at
+    (page_ids[s], h, offs[s]) — the prefill write, inside the compiled
+    program (trash-page tail entries absorb the bucket padding)."""
+    def fn(pages, new, page_ids, offs):
+        hidx = jnp.arange(pages.shape[1])[None, :]
+        return pages.at[page_ids[:, None], hidx, offs[:, None]].set(
+            new.astype(pages.dtype))
+
+    return run_op("paged_kv_write_seq", fn, (pages, new, page_ids, offs),
+                  differentiable=False)
+
+
 class Request:
     """One generation request (seq_id is assigned by the engine)."""
 
@@ -106,13 +119,15 @@ class LlamaServingEngine:
     # ------------------------------------------------------------------
     # prefill
     # ------------------------------------------------------------------
-    def _prefill_forward(self, ids, last_pos):
+    def _prefill_forward(self, ids, last_pos, page_ids, offs, k_pools,
+                         v_pools):
         """Dense forward of one prompt [1, Sb] (bucket-padded; causal
-        attention keeps the padded tail from touching the real prefix);
-        ``last_pos`` is a traced scalar so every prompt length in the
-        bucket shares ONE compiled program. Returns (token id after
-        ``last_pos``, per-layer post-rope (k, v) [Sb, Hk, D] — caller
-        slices to the real length)."""
+        attention keeps the padded tail from touching the real prefix)
+        that also scatters the post-rope K/V into the page pools INSIDE
+        the compiled program (one XLA call per request; the bucket
+        padding's scatter targets are the trash page). ``last_pos`` is a
+        traced scalar so every prompt length in the bucket shares one
+        program. Returns (next token id, new k_pools, new v_pools)."""
         from ..tensor import creation, search
 
         m = self.model.model
@@ -120,8 +135,8 @@ class LlamaServingEngine:
         b, s = ids.shape[0], ids.shape[1]
         pos = creation.arange(0, s, dtype="int64").reshape([1, s])
         x = m.embed_tokens(ids)
-        kvs = []
-        for layer in m.layers:
+        new_k, new_v = [], []
+        for li, layer in enumerate(m.layers):
             h = layer.input_layernorm(x)
             att = layer.self_attn
             q = att.q_proj(h).reshape([b, s, att.num_heads, att.head_dim])
@@ -129,7 +144,8 @@ class LlamaServingEngine:
             v = att.v_proj(h).reshape([b, s, att.num_kv_heads, att.head_dim])
             q, k, v = FI.fused_rotary_position_embedding(
                 q, k, v, position_ids=pos, rotary_emb_base=cfg.rope_theta)
-            kvs.append((k[0], v[0]))
+            new_k.append(_page_write_seq(k_pools[li], k[0], page_ids, offs))
+            new_v.append(_page_write_seq(v_pools[li], v[0], page_ids, offs))
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
             x = x + att.o_proj(out.reshape([b, s, -1]))
             x = x + layer.mlp(layer.post_attention_layernorm(x))
@@ -137,7 +153,7 @@ class LlamaServingEngine:
         h_last = _dynamic_take(x, last_pos)          # [1, 1, H]
         logits = self.model._logits(h_last)
         nxt = search.argmax(logits, axis=-1).astype("int64")
-        return nxt, kvs
+        return nxt, new_k, new_v
 
     PREFILL_BUCKET = 32
 
@@ -149,28 +165,26 @@ class LlamaServingEngine:
         padded = np.zeros((1, bucket), np.int64)
         padded[0, :n] = req.prompt_ids
         ids = Tensor(jnp.asarray(padded))
+        real_pages, real_offs = self.alloc.page_positions(req.seq_id, 0, n)
+        page_ids = np.full((bucket,), self.trash_page, np.int32)
+        offs = np.zeros((bucket,), np.int32)
+        page_ids[:n] = real_pages
+        offs[:n] = real_offs
         if self._prefill_static is None:
             from .. import jit
             # eager prefill pays per-op dispatch for every layer on every
             # request; compiled, each bucket is one XLA call
+            # warmup="once": one eager materialization pass total —
+            # later buckets go straight to compile (the eager pass costs
+            # a full per-op-dispatch forward)
             self._prefill_static = jit.to_static(
-                self._prefill_forward, state=[self.model])
+                self._prefill_forward, state=[self.model], warmup="once")
         with no_grad():
-            nxt, kvs = self._prefill_static(
-                ids, Tensor(jnp.asarray(n - 1, jnp.int32)))
-        kvs = [(k[:n], v[:n]) for k, v in kvs]
-        seq_id = req.seq_id
-        page_ids, offs = self.alloc.page_positions(
-            seq_id, 0, len(req.prompt_ids))
-        hidx = np.arange(self.model.config.num_key_value_heads)[None, :]
-        for li, (k, v) in enumerate(kvs):
-            kp, vp = self.k_pools[li]._data, self.v_pools[li]._data
-            self.k_pools[li] = Tensor(kp.at[
-                page_ids[:, None], hidx, offs[:, None]].set(
-                k._data.astype(kp.dtype)))
-            self.v_pools[li] = Tensor(vp.at[
-                page_ids[:, None], hidx, offs[:, None]].set(
-                v._data.astype(vp.dtype)))
+            nxt, new_k, new_v = self._prefill_static(
+                ids, Tensor(jnp.asarray(n - 1, jnp.int32)),
+                Tensor(jnp.asarray(page_ids)), Tensor(jnp.asarray(offs)),
+                self.k_pools, self.v_pools)
+        self.k_pools, self.v_pools = list(new_k), list(new_v)
         first = int(np.asarray(nxt._data).reshape(-1)[0])
         self._emit(req, first)
 
@@ -262,7 +276,7 @@ class LlamaServingEngine:
         if self._decode_static is None:
             from .. import jit
             self._decode_static = jit.to_static(
-                self._decode_step, state=[self.model])
+                self._decode_step, state=[self.model], warmup="once")
         return self._decode_static
 
     def step(self):
