@@ -212,3 +212,59 @@ fleet = Fleet()
 
 def init(role_maker=None, is_collective=True, strategy=None):
     return fleet.init(role_maker, is_collective, strategy)
+
+
+# module-level delegators over the singleton — the reference's usage
+# surface (`fleet.distributed_model(model)` etc., fleet/fleet.py:100)
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group():
+    return fleet.get_hybrid_communicate_group()
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def is_first_worker():
+    return fleet.is_first_worker()
+
+
+def is_worker():
+    """Collective mode has no PS roles: every process is a worker."""
+    return True
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()
+
+
+class PaddleCloudRoleMaker:
+    """Role shim (reference `fleet/base/role_maker.py`): collective mode
+    reads ranks from the env/runtime, so the role maker is an inert
+    marker object accepted by ``fleet.init`` for API parity."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self.is_collective = is_collective
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective)
+
+
+__all__ += ["distributed_model", "distributed_optimizer",
+            "get_hybrid_communicate_group", "worker_index", "worker_num",
+            "is_first_worker", "is_worker", "barrier_worker",
+            "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]

@@ -182,3 +182,22 @@ class TestElastic:
         for i in range(3):
             ElasticManager(store, i, 2).register()
         assert ElasticManager(store, 0, 2).watch_once() == "scale_up"
+
+
+class TestModuleLevelAPI:
+    """Reference usage surface: module-level fleet.* functions
+    (fleet/fleet.py:100) delegating to the singleton."""
+
+    def test_delegators(self):
+        from paddle_tpu.distributed import fleet as flt
+        flt.init(role_maker=flt.PaddleCloudRoleMaker(is_collective=True))
+        assert flt.worker_num() == 1
+        assert flt.worker_index() == 0
+        assert flt.is_first_worker() and flt.is_worker()
+        assert flt.get_hybrid_communicate_group() is not None
+        m = paddle.nn.Linear(4, 2)
+        assert flt.distributed_model(m) is not None
+        opt = flt.distributed_optimizer(
+            paddle.optimizer.SGD(parameters=m.parameters()))
+        assert opt is not None
+        flt.barrier_worker()  # no-op single process, must not raise
