@@ -206,6 +206,14 @@ class Fleet:
         from ..api import shard_optimizer
         return shard_optimizer(optimizer)
 
+    def is_worker(self):
+        """Collective mode has no PS roles: every process is a worker."""
+        return True
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
 
 fleet = Fleet()
 
@@ -241,13 +249,11 @@ def is_first_worker():
 
 
 def is_worker():
-    """Collective mode has no PS roles: every process is a worker."""
-    return True
+    return fleet.is_worker()
 
 
 def barrier_worker():
-    from ..collective import barrier
-    barrier()
+    return fleet.barrier_worker()
 
 
 class PaddleCloudRoleMaker:
