@@ -19,7 +19,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT16"]
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT16",
+           "Conll05st"]
 
 
 class UCIHousing(Dataset):
@@ -376,3 +377,141 @@ class WMT16(Dataset):
 
     def __len__(self):
         return len(self.src_ids)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split (reference
+    `text/datasets/conll05.py`): the tar holds gzipped word and
+    proposition columns; each verb of a sentence yields one example with
+    the bracketed proposition tags converted to B/I/O and a 5-word
+    context window around the predicate. Dict files (word/verb/target)
+    are the reference's plain one-entry-per-line files."""
+
+    UNK_IDX = 0
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=False):
+        import gzip
+
+        for name, f in (("data_file", data_file),
+                        ("word_dict_file", word_dict_file),
+                        ("verb_dict_file", verb_dict_file),
+                        ("target_dict_file", target_dict_file)):
+            if f is None:
+                raise ValueError(
+                    f"{name} is required (no network in this build): pass "
+                    "the conll05st files the reference downloads")
+        self.data_file = data_file
+        self.word_dict = self._load_dict(word_dict_file)
+        self.predicate_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_label_dict(target_dict_file)
+        self.emb_file = emb_file
+
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+                self._parse(words, props)
+
+    @staticmethod
+    def _load_dict(filename):
+        with open(filename) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(filename):
+        tags = set()
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d, idx = {}, 0
+        for tag in tags:
+            d["B-" + tag] = idx
+            d["I-" + tag] = idx + 1
+            idx += 2
+        d["O"] = idx
+        return d
+
+    def _parse(self, words_file, props_file):
+        # lockstep: one word line per prop line; a blank prop line ends
+        # the sentence (the reference's protocol)
+        sentence, columns = [], []
+        for word, prop in zip(words_file, props_file):
+            word = word.strip().decode()
+            prop = prop.strip().decode().split()
+            if not prop:
+                self._finish_sentence(sentence, columns)
+                sentence, columns = [], []
+            else:
+                sentence.append(word)
+                columns.append(prop)
+        if sentence:
+            self._finish_sentence(sentence, columns)
+
+    def _finish_sentence(self, sentence, columns):
+        if not columns:
+            return
+        # transpose the per-token rows into per-column tag sequences
+        per_col = [[row[i] for row in columns]
+                   for i in range(len(columns[0]))]
+        verbs = [v for v in per_col[0] if v != "-"]
+        for i, col in enumerate(per_col[1:]):
+            seq, cur, inside = [], "O", False
+            for tag in col:
+                if tag == "*":
+                    seq.append("I-" + cur if inside else "O")
+                elif tag == "*)":
+                    seq.append("I-" + cur)
+                    inside = False
+                elif "(" in tag and ")" in tag:
+                    cur = tag[1:tag.find("*")]
+                    seq.append("B-" + cur)
+                    inside = False
+                elif "(" in tag:
+                    cur = tag[1:tag.find("*")]
+                    seq.append("B-" + cur)
+                    inside = True
+                else:
+                    raise ValueError(f"unexpected proposition tag {tag!r}")
+            self.sentences.append(list(sentence))
+            self.predicates.append(verbs[i])
+            self.labels.append(seq)
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        v = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, name, fallback in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                                    (0, "0", None), (1, "p1", "eos"),
+                                    (2, "p2", "eos")):
+            j = v + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = fallback
+        wd = self.word_dict
+        word_idx = [wd.get(w, self.UNK_IDX) for w in sentence]
+        rows = [word_idx]
+        for name in ("n2", "n1", "0", "p1", "p2"):
+            rows.append([wd.get(ctx[name], self.UNK_IDX)] * n)
+        rows.append([self.predicate_dict.get(self.predicates[idx])] * n)
+        rows.append(mark)
+        rows.append([self.label_dict.get(t) for t in labels])
+        return tuple(np.array(r) for r in rows)
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict

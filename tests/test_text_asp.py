@@ -325,3 +325,76 @@ class TestWMT16:
         ds = text.WMT16(data_file=str(f), mode="train", lang="en",
                         src_dict_size=4)
         assert len(ds.src_dict) == 4  # 3 markers + 1 word
+
+
+def _make_conll_files(tmp_path):
+    import gzip
+    # two sentences; first has 2 verbs (columns: verb, args1, args2),
+    # second has 1 verb
+    words = b"The\ncat\nsat\nquickly\n\nDogs\nbark\n\n"
+    props = (b"-\t(A0*\t(A1*\n"
+             b"-\t*)\t*\n"
+             b"sit\t(V*)\t*\n"
+             b"hurry\t*\t(V*)\n"
+             b"\n"
+             b"-\t(A0*)\n"
+             b"bark\t(V*)\n"
+             b"\n").replace(b"\t", b" ")
+    wbuf, pbuf = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=wbuf, mode="w") as g:
+        g.write(words)
+    with gzip.GzipFile(fileobj=pbuf, mode="w") as g:
+        g.write(props)
+    tar_path = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name, buf in [
+                ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 wbuf),
+                ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 pbuf)]:
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    wd = tmp_path / "wordDict.txt"
+    wd.write_text("\n".join(["<unk>", "The", "cat", "sat", "quickly",
+                             "Dogs", "bark", "bos", "eos"]) + "\n")
+    vd = tmp_path / "verbDict.txt"
+    vd.write_text("sit\nhurry\nbark\n")
+    td = tmp_path / "targetDict.txt"
+    td.write_text("B-A0\nI-A0\nB-A1\nI-A1\nB-V\nI-V\nO\n")
+    return tar_path, wd, vd, td
+
+
+class TestConll05st:
+    def test_parses_verbs_and_bio(self, tmp_path):
+        tar, wd, vd, td = _make_conll_files(tmp_path)
+        ds = text.Conll05st(data_file=str(tar), word_dict_file=str(wd),
+                            verb_dict_file=str(vd),
+                            target_dict_file=str(td))
+        # sentence 1 contributes 2 examples (two verbs), sentence 2 one
+        assert len(ds) == 3
+        assert ds.predicates == ["sit", "hurry", "bark"]
+        # first example: labels B-A0 I-A0 B-V O
+        inv = {v: k for k, v in ds.label_dict.items()}
+        ex = ds[0]
+        assert len(ex) == 9
+        tags = [inv[i] for i in ex[8].tolist()]
+        assert tags == ["B-A0", "I-A0", "B-V", "O"]
+        # mark covers the predicate window
+        np.testing.assert_array_equal(ex[7], [1, 1, 1, 1])
+        # predicate id constant across the sentence
+        assert set(ex[6].tolist()) == {ds.predicate_dict["sit"]}
+
+    def test_context_window_at_boundary(self, tmp_path):
+        tar, wd, vd, td = _make_conll_files(tmp_path)
+        ds = text.Conll05st(data_file=str(tar), word_dict_file=str(wd),
+                            verb_dict_file=str(vd),
+                            target_dict_file=str(td))
+        # third example: "Dogs bark", verb at index 1 -> ctx_p1/p2 = eos
+        ex = ds[2]
+        eos = ds.word_dict["eos"]
+        assert set(ex[4].tolist()) == {eos}  # ctx_p1
+        assert set(ex[5].tolist()) == {eos}  # ctx_p2
+        w, pd, ld = ds.get_dict()
+        assert "B-V" in ld and "O" in ld
