@@ -3,8 +3,6 @@ paddle.version, paddle.onnx gating, incubate.autograd, and
 amp.debugging (reference: `distributed/rpc/rpc.py`,
 `incubate/autograd/functional.py`, `amp/debugging.py`)."""
 
-import multiprocessing
-
 import numpy as np
 import pytest
 
@@ -16,74 +14,70 @@ from paddle_tpu.incubate import autograd as iag
 # ---------------------------------------------------------------------------
 # rpc
 # ---------------------------------------------------------------------------
-def _double(x):
+_RPC_WORKER_SRC = """
+import sys
+sys.path.insert(0, %(repo)r)
+rank = int(sys.argv[1]); world = int(sys.argv[2]); port = int(sys.argv[3])
+out_path = sys.argv[4]
+
+def double(x):
     return x * 2
 
-
-def _boom():
+def boom():
     raise ValueError("intentional")
 
-
-def _rpc_worker(rank, world, port, result_q):
-    from paddle_tpu.distributed import rpc
-
-    # the endpoint is predetermined, as in a real launch (PADDLE_MASTER)
-    rpc.init_rpc(f"worker{rank}", rank=rank, world_size=world,
-                 master_endpoint=f"127.0.0.1:{port}")
-    try:
-        peer = f"worker{(rank + 1) % world}"
-        out = rpc.rpc_sync(peer, _double, args=(rank + 10,))
-        assert out == 2 * (rank + 10), out
-        fut = rpc.rpc_async(peer, _double, args=(5,))
-        assert fut.wait(30) == 10
-        if rank == 0:
-            try:
-                rpc.rpc_sync("worker1", _boom)
-                result_q.put((rank, "no-exception"))
-                return
-            except ValueError as e:
-                assert "intentional" in str(e)
-        infos = rpc.get_all_worker_infos()
-        assert [w.name for w in infos] == [f"worker{r}"
-                                           for r in range(world)]
-        result_q.put((rank, "ok"))
-    except Exception as e:  # pragma: no cover
-        result_q.put((rank, repr(e)))
-    finally:
-        rpc.shutdown()
+from paddle_tpu.distributed import rpc
+rpc.init_rpc(f"worker{rank}", rank=rank, world_size=world,
+             master_endpoint=f"127.0.0.1:{port}")
+try:
+    peer = f"worker{(rank + 1) %% world}"
+    assert rpc.rpc_sync(peer, double, args=(rank + 10,)) == 2 * (rank + 10)
+    fut = rpc.rpc_async(peer, double, args=(5,))
+    assert fut.wait(60) == 10
+    if rank == 0:
+        try:
+            rpc.rpc_sync("worker1", boom)
+            raise SystemExit("no-exception")
+        except ValueError as e:
+            assert "intentional" in str(e)
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == [f"worker{r}" for r in range(world)]
+    open(out_path, "w").write("ok")
+finally:
+    rpc.shutdown()
+"""
 
 
 @pytest.mark.skipif(not native.available(), reason="needs native store")
-def test_rpc_cross_process():
+def test_rpc_cross_process(tmp_path):
+    """Fresh-subprocess workers with a scrubbed env (the test_launch
+    pattern): rpc mesh bootstrap, sync + async calls, remote exception
+    propagation, worker info listing."""
+    import os
     import socket
+    import subprocess
+    import sys
 
-    # two attempts: the reserved-port trick has a small reuse race, and
-    # worker startup (jax init) can exceed the queue timeout on a loaded
-    # machine — a fresh port + retry absorbs both
-    last = None
-    for _ in range(2):
-        with socket.socket() as s:  # reserve a free port for the master
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        ctx = multiprocessing.get_context("spawn")
-        result_q = ctx.Queue()
-        world = 2
-        ps = [ctx.Process(target=_rpc_worker,
-                          args=(r, world, port, result_q))
-              for r in range(world)]
-        [p.start() for p in ps]
-        try:
-            results = dict(result_q.get(timeout=300)
-                           for _ in range(world))
-        except Exception as e:
-            last = e
-            [p.terminate() for p in ps]
-            [p.join(10) for p in ps]
-            continue
-        [p.join(15) for p in ps]
-        assert results == {0: "ok", 1: "ok"}, results
-        return
-    raise AssertionError(f"rpc cross-process failed twice: {last!r}")
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    script = tmp_path / "rpc_worker.py"
+    script.write_text(_RPC_WORKER_SRC % {"repo": repo})
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    with socket.socket() as s:  # reserve a free port for the master
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    world = 2
+    outs = [tmp_path / f"out{r}" for r in range(world)]
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(world), str(port),
+         str(outs[r])], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for r in range(world)]
+    for r, p in enumerate(procs):
+        _, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"rank {r} failed:\n{err[-2000:]}"
+    for o in outs:
+        assert o.read_text() == "ok"
 
 
 # ---------------------------------------------------------------------------
