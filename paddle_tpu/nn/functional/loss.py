@@ -16,7 +16,8 @@ __all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
            "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
            "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
            "square_error_cost", "log_loss", "sigmoid_focal_loss",
-           "triplet_margin_loss", "poisson_nll_loss"]
+           "triplet_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
+           "multi_label_soft_margin_loss", "margin_cross_entropy"]
 
 
 def _reduce(x, reduction):
@@ -258,3 +259,69 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return run_op("ctc_loss", fn,
                   (log_probs, labels, input_lengths, label_lengths))
+
+
+@defop()
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    """Gaussian negative log likelihood (reference
+    `nn/functional/loss.py:gaussian_nll_loss`): 0.5*(log(var) +
+    (input-label)^2/var), variance clamped at ``epsilon``; ``full`` adds
+    the 0.5*log(2*pi) constant."""
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * jnp.pi, loss.dtype))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    """Multi-label one-vs-all soft margin (reference
+    `nn/functional/loss.py:multi_label_soft_margin_loss`): per-class
+    sigmoid BCE averaged over classes."""
+    logsig = jax.nn.log_sigmoid
+    per_class = -(label * logsig(input) + (1 - label) * logsig(-input))
+    if weight is not None:
+        per_class = per_class * weight
+    loss = jnp.mean(per_class, axis=-1)
+    return _reduce(loss, reduction)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family combined margin softmax (reference
+    `nn/functional/loss.py:margin_cross_entropy`, CUDA kernel
+    `phi/kernels/gpu/margin_cross_entropy_kernel.cu`): the target
+    class's logit cos(theta) becomes cos(margin1*theta + margin2) -
+    margin3 before scaled softmax CE. The reference's model-parallel
+    ``group`` is GSPMD's job here — shard the class dim of ``logits``
+    and the same code compiles to the sharded softmax."""
+    from ...framework.tensor import run_op
+
+    m1, m2, m3, s = (float(margin1), float(margin2), float(margin3),
+                     float(scale))
+
+    def fn(logits, label):
+        n, c = logits.shape
+        cos = jnp.clip(logits.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target_cos = jnp.cos(m1 * theta + m2) - m3
+        onehot = jax.nn.one_hot(label.reshape(-1), c, dtype=jnp.float32)
+        adjusted = jnp.where(onehot > 0, target_cos, cos) * s
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        if reduction == "mean":
+            loss_out = jnp.mean(loss)
+        elif reduction == "sum":
+            loss_out = jnp.sum(loss)
+        else:
+            loss_out = loss
+        return loss_out, jnp.exp(logp)
+
+    loss, softmax = run_op("margin_cross_entropy", fn, (logits, label))
+    if return_softmax:
+        return loss, softmax
+    return loss

@@ -237,3 +237,62 @@ def test_vgg_and_mobilenet_forward_backward():
         loss.backward()
         grads = [p.grad for p in checked.parameters() if p.trainable]
         assert grads and all(g is not None for g in grads)
+
+
+class TestExtraLosses:
+    """gaussian_nll / multi_label_soft_margin / margin_cross_entropy
+    (reference `nn/functional/loss.py`; ArcFace margin kernel
+    `phi/kernels/gpu/margin_cross_entropy_kernel.cu`) vs numpy oracles."""
+
+    def setup_method(self, _):
+        self.rng = np.random.RandomState(0)
+
+    def test_gaussian_nll(self):
+        x = t(self.rng.randn(4, 3))
+        y = t(self.rng.randn(4, 3))
+        var = t(np.abs(self.rng.randn(4, 3)) + 0.1)
+        got = float(F.gaussian_nll_loss(x, y, var))
+        v = np.maximum(var.numpy(), 1e-6)
+        want = (0.5 * (np.log(v)
+                       + (x.numpy() - y.numpy()) ** 2 / v)).mean()
+        assert abs(got - want) < 1e-4
+        full = float(F.gaussian_nll_loss(x, y, var, full=True))
+        assert abs(full - (want + 0.5 * np.log(2 * np.pi))) < 1e-4
+
+    def test_multi_label_soft_margin(self):
+        x = t(self.rng.randn(4, 3))
+        lbl = t((self.rng.rand(4, 3) > 0.5).astype("float32"))
+        got = float(F.multi_label_soft_margin_loss(x, lbl))
+
+        def sig(z):
+            return 1 / (1 + np.exp(-z))
+
+        pc = -(lbl.numpy() * np.log(sig(x.numpy()))
+               + (1 - lbl.numpy()) * np.log(sig(-x.numpy())))
+        assert abs(got - pc.mean(-1).mean()) < 1e-5
+
+    def test_margin_ce_zero_margin_is_scaled_softmax(self):
+        cos = t(self.rng.rand(4, 10) * 2 - 1)
+        lab = paddle.to_tensor(self.rng.randint(0, 10, (4,)))
+        loss, sm = F.margin_cross_entropy(
+            cos, lab, margin1=1.0, margin2=0.0, margin3=0.0, scale=4.0,
+            return_softmax=True)
+        logits = np.clip(cos.numpy(), -1, 1) * 4.0
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(4), lab.numpy()]).mean()
+        assert abs(float(loss) - want) < 1e-5
+        np.testing.assert_allclose(sm.numpy(), p, rtol=1e-5, atol=1e-6)
+
+    def test_margin_makes_target_harder(self):
+        cos = t(self.rng.rand(4, 10) * 2 - 1)
+        lab = paddle.to_tensor(self.rng.randint(0, 10, (4,)))
+        assert float(F.margin_cross_entropy(cos, lab, margin2=0.5)) \
+            > float(F.margin_cross_entropy(cos, lab, margin2=0.0))
+
+    def test_margin_ce_gradient(self):
+        cos = t(self.rng.rand(2, 5) * 2 - 1, rg=True)
+        F.margin_cross_entropy(
+            cos, paddle.to_tensor(np.array([1, 3]))).backward()
+        assert cos.grad is not None
+        assert float(np.abs(cos.grad.numpy()).sum()) > 0
