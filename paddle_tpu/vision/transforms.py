@@ -11,7 +11,8 @@ import numpy as np
 
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomCrop", "RandomHorizontalFlip", "Transpose",
-           "RandomResizedCrop", "RandomVerticalFlip", "ColorJitter"]
+           "RandomResizedCrop", "RandomVerticalFlip", "ColorJitter",
+           "Pad", "Grayscale", "RandomRotation", "RandomErasing"]
 
 
 class Compose:
@@ -191,3 +192,106 @@ class ColorJitter:
                                   1 + self.contrast)
             out = (out - out.mean()) * f + out.mean()
         return out
+
+
+class Pad:
+    """Constant-pad H and W of an HWC array (reference transforms.Pad)."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding, padding, padding, padding)  # l, t, r, b
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        pad = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+        if self.padding_mode == "constant":
+            return np.pad(arr, pad, constant_values=self.fill)
+        return np.pad(arr, pad, mode=self.padding_mode)
+
+
+class Grayscale:
+    """ITU-R 601-2 luma transform on HWC RGB (reference
+    transforms.Grayscale); num_output_channels 1 or 3."""
+
+    def __init__(self, num_output_channels=1):
+        if num_output_channels not in (1, 3):
+            raise ValueError("num_output_channels must be 1 or 3")
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                + 0.114 * arr[..., 2])[..., None]
+        if self.num_output_channels == 3:
+            gray = np.repeat(gray, 3, axis=-1)
+        return gray.astype(arr.dtype)
+
+
+class RandomRotation:
+    """Rotate by a uniform random angle (reference
+    transforms.RandomRotation); nearest-neighbor resample around the
+    image center, out-of-frame pixels filled with ``fill``."""
+
+    def __init__(self, degrees, fill=0):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        angle = np.random.uniform(*self.degrees) * np.pi / 180.0
+        h, w = arr.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float64)
+        c, s = np.cos(angle), np.sin(angle)
+        # inverse map: output pixel pulls from rotated source coordinate
+        sy = cy + (yy - cy) * c - (xx - cx) * s
+        sx = cx + (yy - cy) * s + (xx - cx) * c
+        syi = np.round(sy).astype(np.int64)
+        sxi = np.round(sx).astype(np.int64)
+        valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+        out = np.full_like(arr, self.fill)
+        out[valid] = arr[syi[valid], sxi[valid]]
+        return out
+
+
+class RandomErasing:
+    """Erase a random rectangle (reference transforms.RandomErasing):
+    area in ``scale`` x image, aspect in ``ratio``; value 0 or 'random'."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img).copy()
+        if np.random.random() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round((target / ar) ** 0.5))
+            ew = int(round((target * ar) ** 0.5))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                y = np.random.randint(0, h - eh + 1)
+                x = np.random.randint(0, w - ew + 1)
+                if self.value == "random":
+                    arr[y:y + eh, x:x + ew] = np.random.rand(
+                        eh, ew, *arr.shape[2:]).astype(arr.dtype)
+                else:
+                    arr[y:y + eh, x:x + ew] = self.value
+                return arr
+        return arr

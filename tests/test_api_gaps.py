@@ -135,3 +135,47 @@ class TestAliases:
         ids = paddle.to_tensor(np.array([0, 0, 1]))
         out = segment_sum(x, ids)
         np.testing.assert_allclose(out.numpy(), [[3.0], [3.0]])
+
+
+class TestMoreTransforms:
+    def test_pad(self):
+        from paddle_tpu.vision.transforms import Pad
+        img = np.ones((4, 6, 3), np.float32)
+        out = Pad(2, fill=7)(img)
+        assert out.shape == (8, 10, 3)
+        assert out[0, 0, 0] == 7 and out[4, 4, 0] == 1
+        out2 = Pad((1, 2))(img)  # (l/r=1, t/b=2)
+        assert out2.shape == (8, 8, 3)
+
+    def test_grayscale(self):
+        from paddle_tpu.vision.transforms import Grayscale
+        img = np.zeros((2, 2, 3), np.float32)
+        img[..., 1] = 1.0  # pure green
+        out = Grayscale()(img)
+        assert out.shape == (2, 2, 1)
+        np.testing.assert_allclose(out, 0.587, rtol=1e-6)
+        assert Grayscale(3)(img).shape == (2, 2, 3)
+
+    def test_random_rotation_identity_at_zero(self):
+        from paddle_tpu.vision.transforms import RandomRotation
+        img = np.random.RandomState(0).rand(8, 8, 3).astype(np.float32)
+        out = RandomRotation((0, 0))(img)
+        np.testing.assert_allclose(out, img)
+
+    def test_random_rotation_90(self):
+        from paddle_tpu.vision.transforms import RandomRotation
+        img = np.zeros((5, 5, 1), np.float32)
+        img[0, 2] = 1.0  # top-center
+        out = RandomRotation((90, 90))(img)
+        # 90-degree rotation moves top-center to a side-center
+        assert out.sum() == 1.0
+        assert out[2, 0] == 1.0 or out[2, 4] == 1.0
+
+    def test_random_erasing(self):
+        from paddle_tpu.vision.transforms import RandomErasing
+        np.random.seed(0)
+        img = np.ones((16, 16, 3), np.float32)
+        out = RandomErasing(prob=1.0, value=0)(img)
+        assert (out == 0).any() and (out == 1).any()
+        same = RandomErasing(prob=0.0)(img)
+        np.testing.assert_array_equal(same, img)
