@@ -57,6 +57,12 @@ class _RpcAgent:
         self._stop = threading.Event()
         self._req_seq = 0
         store.set(f"rpc/worker/{rank}", name.encode())
+        # DEDICATED connection for the dispatcher: a TCPStore client
+        # serializes requests on its single socket, so a blocking
+        # reply-wait elsewhere must never share the dispatcher's
+        # connection — two agents each starving their own dispatcher
+        # while waiting on the other is a distributed deadlock
+        self._dispatch_store = self._connect()
         self._dispatcher = threading.Thread(target=self._serve, daemon=True)
         self._dispatcher.start()
         # barrier: everyone registered before calls start flying
@@ -66,22 +72,29 @@ class _RpcAgent:
             wname = store.get(f"rpc/worker/{r}", timeout=30).decode()
             self.workers[wname] = WorkerInfo(wname, r)
 
+    def _connect(self):
+        from ..native import TCPStore
+
+        return TCPStore(host=self.store.host, port=self.store.port,
+                        timeout=self.store.timeout)
+
     def _serve(self):
         seq = 0
+        st = self._dispatch_store
         while not self._stop.is_set():
             key = f"rpc/to/{self.name}/{seq}"
             try:
-                payload = self.store.get(key, timeout=0.25)
+                payload = st.get(key, timeout=0.25)
             except TimeoutError:
                 continue
-            self.store.delete_key(key)
+            st.delete_key(key)
             reply_key = f"rpc/reply/{self.name}/{seq}"
             try:
                 fn, args, kwargs = pickle.loads(payload)
-                self.store.set(reply_key, b"ok:" + pickle.dumps(
+                st.set(reply_key, b"ok:" + pickle.dumps(
                     fn(*args, **kwargs)))
             except Exception as e:
-                self.store.set(reply_key, b"er:" + pickle.dumps(e))
+                st.set(reply_key, b"er:" + pickle.dumps(e))
             seq += 1
 
     def call(self, to, fn, args, kwargs, timeout):
@@ -91,16 +104,22 @@ class _RpcAgent:
         fut = _FutureReply()
 
         def waiter():
+            # per-call connection: the blocking reply-get must not pin
+            # the shared client (see _dispatch_store note)
+            conn = None
             try:
-                rsp = self.store.get(f"rpc/reply/{to}/{seq}",
-                                     timeout=timeout)
-                self.store.delete_key(f"rpc/reply/{to}/{seq}")
+                conn = self._connect()
+                rsp = conn.get(f"rpc/reply/{to}/{seq}", timeout=timeout)
+                conn.delete_key(f"rpc/reply/{to}/{seq}")
                 if rsp[:3] == b"er:":
                     fut._set(None, pickle.loads(rsp[3:]))
                 else:
                     fut._set(pickle.loads(rsp[3:]), None)
             except Exception as e:
                 fut._set(None, e)
+            finally:
+                if conn is not None:
+                    conn.close()
 
         threading.Thread(target=waiter, daemon=True).start()
         return fut
@@ -108,6 +127,7 @@ class _RpcAgent:
     def stop(self):
         self._stop.set()
         self._dispatcher.join(timeout=5)
+        self._dispatch_store.close()
 
 
 _agent: _RpcAgent | None = None

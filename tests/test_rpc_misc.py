@@ -20,8 +20,14 @@ sys.path.insert(0, %(repo)r)
 rank = int(sys.argv[1]); world = int(sys.argv[2]); port = int(sys.argv[3])
 out_path = sys.argv[4]
 
+import time
+
 def double(x):
     return x * 2
+
+def slow_inc(x):
+    time.sleep(2.0)
+    return x + 1
 
 def boom():
     raise ValueError("intentional")
@@ -32,6 +38,10 @@ rpc.init_rpc(f"worker{rank}", rank=rank, world_size=world,
 try:
     peer = f"worker{(rank + 1) %% world}"
     assert rpc.rpc_sync(peer, double, args=(rank + 10,)) == 2 * (rank + 10)
+    # simultaneous bidirectional BLOCKING calls: regression for the
+    # shared-connection deadlock (a waiter pinning the client starved
+    # the dispatcher on both sides at once)
+    assert rpc.rpc_sync(peer, slow_inc, args=(rank,), timeout=60) == rank + 1
     fut = rpc.rpc_async(peer, double, args=(5,))
     assert fut.wait(60) == 10
     if rank == 0:
