@@ -91,10 +91,20 @@ class _RpcAgent:
             reply_key = f"rpc/reply/{self.name}/{seq}"
             try:
                 fn, args, kwargs = pickle.loads(payload)
-                st.set(reply_key, b"ok:" + pickle.dumps(
-                    fn(*args, **kwargs)))
+                reply = b"ok:" + pickle.dumps(fn(*args, **kwargs))
             except Exception as e:
-                st.set(reply_key, b"er:" + pickle.dumps(e))
+                reply = b"er:" + pickle.dumps(e)
+            # Tombstone protocol: a timed-out caller plants
+            # rpc/dead/{name}/{seq}; consuming it means "don't publish,
+            # nobody is waiting" — otherwise a late reply would leak in
+            # the master store forever. Re-check after publishing to
+            # close the set-between-check-and-publish race (the waiter
+            # symmetrically deletes the reply if it was already out).
+            tomb_key = f"rpc/dead/{self.name}/{seq}"
+            if not st.delete_key(tomb_key):
+                st.set(reply_key, reply)
+                if st.delete_key(tomb_key):
+                    st.delete_key(reply_key)
             seq += 1
 
     def call(self, to, fn, args, kwargs, timeout):
@@ -117,6 +127,16 @@ class _RpcAgent:
                     fut._set(pickle.loads(rsp[3:]), None)
             except Exception as e:
                 fut._set(None, e)
+                # Plant a tombstone so the (probably still running)
+                # handler skips publishing its reply; if the reply beat
+                # the tombstone, reap both keys ourselves.
+                if conn is not None:
+                    try:
+                        conn.set(f"rpc/dead/{to}/{seq}", b"1")
+                        if conn.delete_key(f"rpc/reply/{to}/{seq}"):
+                            conn.delete_key(f"rpc/dead/{to}/{seq}")
+                    except Exception:
+                        pass
             finally:
                 if conn is not None:
                     conn.close()

@@ -335,8 +335,9 @@ class LlamaForCausalLM(nn.Layer):
         def fn(logits, key):
             lg = logits[:, 0, :].astype(jnp.float32)
             lg = lg / max(float(temperature), 1e-6)
-            if top_k is not None:
-                kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
+            if top_k:  # None or 0 disables the filter (HF/paddle convention)
+                k = min(int(top_k), lg.shape[-1])
+                kth = jnp.sort(lg, axis=-1)[:, -k][:, None]
                 lg = jnp.where(lg >= kth, lg, -1e30)
             if top_p is not None:
                 # nucleus over the (possibly top-k-restricted) softmax

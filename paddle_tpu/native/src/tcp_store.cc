@@ -87,7 +87,13 @@ struct Server {
       ::shutdown(listen_fd, SHUT_RDWR);
       ::close(listen_fd);
     }
-    cv.notify_all();  // release handlers parked in blocking GET/WAIT
+    {
+      // Hold mu so the stopping publish is ordered against handlers'
+      // predicate checks: notify without it can slip between a waiter
+      // evaluating the predicate and parking, losing the wakeup.
+      std::lock_guard<std::mutex> lk(mu);
+      cv.notify_all();  // release handlers parked in blocking GET/WAIT
+    }
     {
       std::lock_guard<std::mutex> lk(conn_mu);
       for (int fd : open_fds) ::shutdown(fd, SHUT_RDWR);

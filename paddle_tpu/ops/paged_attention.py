@@ -18,7 +18,9 @@ Shapes:
   v_pages       [P, Hk, page_size, D]
   block_tables  [B, max_pages] int32    page ids per sequence (row-major
                                         position order; unused tail
-                                        entries may hold anything)
+                                        entries may hold anything — they
+                                        are clamped into [0, P) before
+                                        reaching the index map)
   context_lens  [B] int32              valid tokens per sequence,
                                         *including* the current one
                                         (its K/V must already be written)
@@ -166,8 +168,13 @@ def _paged_impl(q, k_pages, v_pages, block_tables, context_lens, scale):
     page_size = k_pages.shape[2]
     q4 = q.reshape(b, hk, group, d)
     call = _make_paged(scale, page_size, group, _interpret())
-    out = call(q4, k_pages, v_pages,
-               block_tables.astype(jnp.int32),
+    # Tail entries past a sequence's last page are never *read* for the
+    # output, but they still feed the Pallas index map — clamp so an
+    # arbitrary tail value can't index the page pool out of bounds
+    # (unspecified behavior in Mosaic).
+    tables = jnp.clip(block_tables.astype(jnp.int32), 0,
+                      k_pages.shape[0] - 1)
+    out = call(q4, k_pages, v_pages, tables,
                context_lens.astype(jnp.int32))
     return out.reshape(b, h, d)
 

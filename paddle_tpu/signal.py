@@ -19,8 +19,8 @@ __all__ = ["stft", "istft", "frame", "overlap_add"]
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
     """Slice ``x`` into overlapping frames along ``axis`` (reference
-    `signal.py:frame`). Output appends a frame axis: for axis=-1,
-    [..., N] -> [..., frame_length, num_frames]."""
+    `signal.py:frame`). For axis=-1, [..., N] -> [..., frame_length,
+    num_frames]; for axis=0, [N, ...] -> [num_frames, frame_length, ...]."""
     if axis not in (-1, 0):
         raise ValueError("frame: axis must be 0 or -1")
 
@@ -32,19 +32,25 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
         idx = starts[:, None] + jnp.arange(frame_length)[None, :]
         out = xx[..., idx]                       # [..., num, frame_length]
         out = jnp.swapaxes(out, -1, -2)          # [..., frame_length, num]
-        return jnp.moveaxis(out, -1, 0) if axis == 0 else out
+        if axis == 0:
+            # [..., frame_length, num] -> [num, frame_length, ...]
+            out = jnp.moveaxis(out, (-1, -2), (0, 1))
+        return out
 
     return run_op("frame", fn, (x,))
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
     """Inverse of :func:`frame` (reference `signal.py:overlap_add`):
-    [..., frame_length, num_frames] -> [..., N]."""
+    axis=-1 takes [..., frame_length, num_frames] -> [..., N]; axis=0
+    takes [num_frames, frame_length, ...] -> [N, ...]."""
     if axis not in (-1, 0):
         raise ValueError("overlap_add: axis must be 0 or -1")
 
     def fn(x):
-        xx = jnp.moveaxis(x, 0, -1) if axis == 0 else x
+        # axis=0 input layout is [num, frame_length, ...]; bring it to the
+        # canonical [..., frame_length, num] before the scatter-add.
+        xx = jnp.moveaxis(x, (0, 1), (-1, -2)) if axis == 0 else x
         fl, num = xx.shape[-2], xx.shape[-1]
         n = (num - 1) * hop_length + fl
         starts = jnp.arange(num) * hop_length

@@ -91,6 +91,21 @@ class TestFrameOverlapAdd:
         np.testing.assert_allclose(oa[:, 64:n - 64],
                                    2 * x[:, 64:n - 64], atol=1e-5)
 
+    def test_frame_axis0_batched_layout(self):
+        # reference frame(axis=0): [N, ...] -> [num, frame_length, ...]
+        x = _x(2, 8).T                                   # [8, 2]
+        f = signal.frame(paddle.to_tensor(x), 4, 2, axis=0).numpy()
+        assert f.shape == (3, 4, 2)
+        ref = signal.frame(paddle.to_tensor(x.T), 4, 2, axis=-1).numpy()
+        np.testing.assert_array_equal(f, ref.transpose(2, 1, 0))
+
+    def test_overlap_add_axis0_roundtrip(self):
+        x = _x(2, 8).T                                   # [8, 2]
+        f = signal.frame(paddle.to_tensor(x), 4, 4, axis=0)
+        assert tuple(f.shape) == (2, 4, 2)
+        oa = signal.overlap_add(f, 4, axis=0).numpy()
+        np.testing.assert_allclose(oa, x, atol=1e-6)
+
     def test_gradient_through_stft(self):
         x = paddle.to_tensor(_x(1, 256), stop_gradient=False)
         spec = signal.stft(x, 64, 32)
