@@ -249,14 +249,16 @@ def bench_paged(batch=8, heads=16, kv_heads=8, dim=128, page=64,
     }
 
 
-def bench_serving(model, n_requests=8, new_tokens=32, max_batch=4):
+def bench_serving(model, n_requests=24, new_tokens=48, max_batch=16,
+                  decode_ceiling=None):
     """Continuous-batching engine throughput: ragged prompts admitted on
-    the fly over the Pallas paged-attention decode program."""
+    the fly over the Pallas paged-attention decode program. Steady state
+    runs the scanned burst program (BURST decode steps per dispatch)."""
     from paddle_tpu.inference.serving import LlamaServingEngine
 
     model.eval()
     engine = LlamaServingEngine(model, max_batch=max_batch, page_size=64,
-                                num_pages=max_batch * 24 + 8)
+                                num_pages=max_batch * 6 + 8)
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, model.config.vocab_size,
                            (int(rng.randint(16, 128)),)).tolist()
@@ -264,7 +266,7 @@ def bench_serving(model, n_requests=8, new_tokens=32, max_batch=4):
     # warm TWICE: pass 1 runs the eager warmup + traces, pass 2 lands
     # every prefill bucket and the decode program in the compile cache
     engine.generate(prompts, max_new_tokens=2)
-    engine.generate(prompts, max_new_tokens=2)
+    engine.generate(prompts, max_new_tokens=engine.BURST + 2)
     t0 = time.perf_counter()
     outs = engine.generate(prompts, max_new_tokens=new_tokens)
     dt = time.perf_counter() - t0
@@ -278,8 +280,8 @@ def bench_serving(model, n_requests=8, new_tokens=32, max_batch=4):
     for _ in range(max_batch):
         engine.add_request(Request(
             rng2.randint(0, model.config.vocab_size, (32,)).tolist(),
-            max_new_tokens=new_tokens * 4 + 16))
-    engine.decode_many(8)  # warm the burst path
+            max_new_tokens=new_tokens * 4 + 64))
+    engine.decode_many(engine.BURST)  # warm the burst path
     t0 = time.perf_counter()
     served = engine.decode_many(new_tokens * 2)
     steady = served / (time.perf_counter() - t0)
@@ -287,13 +289,17 @@ def bench_serving(model, n_requests=8, new_tokens=32, max_batch=4):
         engine.alloc.release(r.seq_id)
         engine._live.pop(r.seq_id)
     model.train()
-    return {
+    out = {
         "serving_requests": n_requests,
         "serving_tokens": total,
         "serving_tokens_per_sec": round(total / dt, 1),
         "serving_steady_tokens_per_sec": round(steady, 1),
         "serving_max_batch": max_batch,
+        "serving_burst": LlamaServingEngine.BURST,
     }
+    if decode_ceiling:
+        out["serving_ceiling_frac"] = round(steady / decode_ceiling, 3)
+    return out
 
 
 # (config kwargs, batch, seq) from largest to smallest; the first that
@@ -355,7 +361,7 @@ def main():
     try:
         model = bench_train_step.last_model
         result.update(bench_decode(
-            model, batch=4 if on_tpu else 1,
+            model, batch=16 if on_tpu else 1,
             prompt=128 if on_tpu else 16,
             new_tokens=64 if on_tpu else 4))
     except Exception as e:
@@ -364,8 +370,11 @@ def main():
 
     try:
         model = bench_train_step.last_model
-        result.update(bench_serving(model, n_requests=8 if on_tpu else 2,
-                                    new_tokens=32 if on_tpu else 4))
+        result.update(bench_serving(
+            model, n_requests=24 if on_tpu else 2,
+            new_tokens=48 if on_tpu else 4,
+            max_batch=16 if on_tpu else 2,
+            decode_ceiling=result.get("decode_tokens_per_sec")))
     except Exception as e:
         log(f"serving bench failed: {e!r:.300}")
         result["serving_error"] = repr(e)[:200]

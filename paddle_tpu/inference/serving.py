@@ -23,6 +23,13 @@ Design (TPU-first):
   swiglu MLP) → logits → greedy argmax. Inactive batch slots point at a
   reserved trash page with length 1, so shapes never change and the
   executable is reused for the engine's lifetime.
+- Sustained decode runs as a **burst**: ``lax.scan`` over the same
+  traced decode step, so BURST tokens per sequence cost ONE dispatch,
+  one host→device transfer of (tokens, tables, lens) and one
+  device→host fetch of the emitted block — the per-step host round
+  trip (the dominant cost of dispatch-per-token serving) is amortized
+  away. Pages for the whole burst are reserved up front; sequence
+  lengths advance on device as the scan carry.
 """
 
 from __future__ import annotations
@@ -41,16 +48,6 @@ from .paged_cache import PageAllocator
 __all__ = ["LlamaServingEngine", "Request"]
 
 
-def _dynamic_take(x, pos):
-    """x[:, pos:pos+1, :] with a traced scalar ``pos``."""
-    import jax
-
-    def fn(x, pos):
-        return jax.lax.dynamic_slice_in_dim(x, pos, 1, axis=1)
-
-    return run_op("dynamic_take", fn, (x, pos), differentiable=False)
-
-
 def _page_write(pages, new, page_ids, offs):
     """Functional scatter of ``new [B, Hk, D]`` into head-major ``pages
     [P, Hk, page, D]`` at (page_ids[b], h, offs[b]) — one token per live
@@ -65,12 +62,13 @@ def _page_write(pages, new, page_ids, offs):
 
 
 def _page_write_seq(pages, new, page_ids, offs):
-    """Scatter a whole sequence ``new [S, Hk, D]`` into ``pages`` at
-    (page_ids[s], h, offs[s]) — the prefill write, inside the compiled
-    program (trash-page tail entries absorb the bucket padding)."""
+    """Scatter a wave of sequences ``new [B, S, Hk, D]`` into ``pages``
+    at (page_ids[b, s], h, offs[b, s]) — the prefill write, inside the
+    compiled program (trash-page entries absorb bucket padding and pad
+    rows)."""
     def fn(pages, new, page_ids, offs):
-        hidx = jnp.arange(pages.shape[1])[None, :]
-        return pages.at[page_ids[:, None], hidx, offs[:, None]].set(
+        hidx = jnp.arange(pages.shape[1])[None, None, :]
+        return pages.at[page_ids[:, :, None], hidx, offs[:, :, None]].set(
             new.astype(pages.dtype))
 
     return run_op("paged_kv_write_seq", fn, (pages, new, page_ids, offs),
@@ -92,12 +90,23 @@ class Request:
 
 
 class LlamaServingEngine:
-    def __init__(self, model, max_batch=4, page_size=16, num_pages=128,
-                 max_pages_per_seq=None):
+    #: default compiled burst length — one scanned decode program serves
+    #: this many tokens per sequence per dispatch
+    BURST = 16
+
+    def __init__(self, model, max_batch=16, page_size=16, num_pages=None,
+                 max_pages_per_seq=None, burst=None):
+        if num_pages is None:
+            num_pages = max_batch * 24 + 8
         self.model = model
         cfg = model.config
         self.max_batch = max_batch
         self.page_size = page_size
+        # Keep block tables as narrow as the workload allows: the Pallas
+        # decode grid is (B, Hk, width), so a table sized to the whole
+        # pool pays a grid step (and an HBM->VMEM page fetch) per UNUSED
+        # table slot. max_pages_per_seq is the knob.
+        self.burst = int(burst) if burst else self.BURST
         # page num_pages-1 is the trash page for inactive batch slots
         self.alloc = PageAllocator(num_pages - 1, page_size,
                                    max_pages_per_seq)
@@ -115,25 +124,36 @@ class LlamaServingEngine:
         self._next_id = 0
         self._decode_static = None
         self._prefill_static = None
+        self._burst_static: dict[int, object] = {}  # burst length -> program
+
+    def __state_tensors__(self):
+        """State-discovery override for ``to_static``: the KV pools are
+        explicit inputs/outputs of every compiled program (donated by the
+        burst path) and must NOT also be captured as closure state —
+        that would donate the same buffers twice. Model params enter via
+        ``state=[self.model]``."""
+        return []
 
     # ------------------------------------------------------------------
     # prefill
     # ------------------------------------------------------------------
     def _prefill_forward(self, ids, last_pos, page_ids, offs, k_pools,
                          v_pools):
-        """Dense forward of one prompt [1, Sb] (bucket-padded; causal
-        attention keeps the padded tail from touching the real prefix)
-        that also scatters the post-rope K/V into the page pools INSIDE
-        the compiled program (one XLA call per request; the bucket
-        padding's scatter targets are the trash page). ``last_pos`` is a
-        traced scalar so every prompt length in the bucket shares one
-        program. Returns (next token id, new k_pools, new v_pools)."""
-        from ..tensor import creation, search
+        """Dense forward of a WAVE of prompts [max_batch, Sb]
+        (bucket-padded; causal attention keeps each padded tail from
+        touching the real prefix) that also scatters the post-rope K/V
+        into the page pools INSIDE the compiled program. Pad rows and
+        pad positions scatter to the trash page. One dispatch admits up
+        to max_batch requests — the reference serving stack's batched
+        context step (`block_multi_head_attention`) done the XLA way.
+        Returns (next token id [B, 1], new k_pools, new v_pools)."""
+        from ..tensor import creation, manipulation, search
 
         m = self.model.model
         cfg = self.model.config
         b, s = ids.shape[0], ids.shape[1]
-        pos = creation.arange(0, s, dtype="int64").reshape([1, s])
+        pos = creation.arange(0, s, dtype="int64").reshape([1, s]) \
+            .expand([b, s])
         x = m.embed_tokens(ids)
         new_k, new_v = [], []
         for li, layer in enumerate(m.layers):
@@ -144,49 +164,61 @@ class LlamaServingEngine:
             v = att.v_proj(h).reshape([b, s, att.num_kv_heads, att.head_dim])
             q, k, v = FI.fused_rotary_position_embedding(
                 q, k, v, position_ids=pos, rotary_emb_base=cfg.rope_theta)
-            new_k.append(_page_write_seq(k_pools[li], k[0], page_ids, offs))
-            new_v.append(_page_write_seq(v_pools[li], v[0], page_ids, offs))
+            new_k.append(_page_write_seq(k_pools[li], k, page_ids, offs))
+            new_v.append(_page_write_seq(v_pools[li], v, page_ids, offs))
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
             x = x + att.o_proj(out.reshape([b, s, -1]))
             x = x + layer.mlp(layer.post_attention_layernorm(x))
         x = m.norm(x)
-        h_last = _dynamic_take(x, last_pos)          # [1, 1, H]
+        h_last = manipulation.take_along_axis(
+            x, last_pos.astype("int64").reshape([b, 1, 1])
+            .expand([b, 1, x.shape[-1]]), 1)         # [B, 1, H]
         logits = self.model._logits(h_last)
         nxt = search.argmax(logits, axis=-1).astype("int64")
         return nxt, new_k, new_v
 
     PREFILL_BUCKET = 32
 
-    def _prefill(self, req):
-        n = len(req.prompt_ids)
+    def _prefill_wave(self, reqs):
+        """Prefill 1..max_batch admitted requests in ONE compiled call."""
+        if not reqs:
+            return
+        b = self.max_batch
+        n_max = max(len(r.prompt_ids) for r in reqs)
         # bucket the padded length so ragged prompts share compiled
         # prefill programs (one per bucket, not one per length)
-        bucket = -(-n // self.PREFILL_BUCKET) * self.PREFILL_BUCKET
-        padded = np.zeros((1, bucket), np.int64)
-        padded[0, :n] = req.prompt_ids
-        ids = Tensor(jnp.asarray(padded))
-        real_pages, real_offs = self.alloc.page_positions(req.seq_id, 0, n)
-        page_ids = np.full((bucket,), self.trash_page, np.int32)
-        offs = np.zeros((bucket,), np.int32)
-        page_ids[:n] = real_pages
-        offs[:n] = real_offs
+        bucket = -(-n_max // self.PREFILL_BUCKET) * self.PREFILL_BUCKET
+        padded = np.zeros((b, bucket), np.int64)
+        page_ids = np.full((b, bucket), self.trash_page, np.int32)
+        offs = np.zeros((b, bucket), np.int32)
+        last_pos = np.zeros((b,), np.int32)
+        for i, r in enumerate(reqs):
+            n = len(r.prompt_ids)
+            padded[i, :n] = r.prompt_ids
+            rp, ro = self.alloc.page_positions(r.seq_id, 0, n)
+            page_ids[i, :n] = rp
+            offs[i, :n] = ro
+            last_pos[i] = n - 1
         if self._prefill_static is None:
-            from .. import jit
-            # eager prefill pays per-op dispatch for every layer on every
-            # request; compiled, each bucket is one XLA call
-            # warmup="once": one eager materialization pass total —
-            # later buckets go straight to compile (the eager pass costs
-            # a full per-op-dispatch forward)
-            self._prefill_static = jit.to_static(
-                self._prefill_forward, state=[self.model], warmup="once")
+            from ..jit import StaticFunction
+
+            # no lazy state (params exist, no optimizer): skip the eager
+            # warmup and compile directly; donate pools for in-place
+            # page writes
+            self._prefill_static = StaticFunction(
+                self._prefill_forward, state=[self.model], warmup="once",
+                donate_inputs=True)
+            self._prefill_static._warmed_any = True
         with no_grad():
             nxt, new_k, new_v = self._prefill_static(
-                ids, Tensor(jnp.asarray(n - 1, jnp.int32)),
+                Tensor(jnp.asarray(padded)),
+                Tensor(jnp.asarray(last_pos)),
                 Tensor(jnp.asarray(page_ids)), Tensor(jnp.asarray(offs)),
                 self.k_pools, self.v_pools)
         self.k_pools, self.v_pools = list(new_k), list(new_v)
-        first = int(np.asarray(nxt._data).reshape(-1)[0])
-        self._emit(req, first)
+        first = np.asarray(nxt._data).reshape(-1)
+        for i, r in enumerate(reqs):
+            self._emit(r, int(first[i]))
 
     # ------------------------------------------------------------------
     # decode
@@ -240,8 +272,7 @@ class LlamaServingEngine:
     # ------------------------------------------------------------------
     # scheduling
     # ------------------------------------------------------------------
-    def add_request(self, req):
-        """Admit a request (prefill immediately). Returns its seq_id."""
+    def _admit(self, req):
         if len(self._live) >= self.max_batch:
             raise MemoryError(
                 f"engine full ({self.max_batch} live requests)")
@@ -249,8 +280,13 @@ class LlamaServingEngine:
         self._next_id += 1
         self.alloc.admit(req.seq_id, len(req.prompt_ids))
         self._live[req.seq_id] = req
-        self._prefill(req)
         return req.seq_id
+
+    def add_request(self, req):
+        """Admit a request (prefill immediately). Returns its seq_id."""
+        sid = self._admit(req)
+        self._prefill_wave([req])
+        return sid
 
     def _emit(self, req, token):
         req.output_ids.append(token)
@@ -304,64 +340,153 @@ class LlamaServingEngine:
             self._emit(r, int(out[i]))
         return len(live)
 
-    def decode_many(self, n):
-        """Fast path: ``n`` chained decode steps for the current live set
-        with NO host sync inside the loop — next tokens feed the next
-        step as device arrays, page views are precomputed on the host,
-        and the emitted tokens are fetched once at the end. Valid when no
-        request can retire mid-run (no EOS; none reaches max_new_tokens
-        before the n-th step)."""
+    # ------------------------------------------------------------------
+    # burst decode: n steps = ONE compiled program (lax.scan)
+    # ------------------------------------------------------------------
+    def _decode_burst_fn(self, n):
+        """Build the n-step burst: ``lax.scan`` whose body is the SAME
+        Tensor-level :meth:`_decode_step` (traced, not re-implemented —
+        parity with the per-step program is by construction). The carry
+        is (tokens, lens, pools); tables are scan-invariant because
+        pages for the whole burst are reserved before launch."""
+        import jax
+
+        def fn(tokens, tables, lens, k_pools, v_pools):
+            tab = tables._data
+            kp = [t._data for t in k_pools]
+            vp = [t._data for t in v_pools]
+
+            def body(carry, _):
+                tok, lc, kc, vc = carry
+                nxt, nk, nv = self._decode_step(
+                    Tensor(tok), Tensor(tab), Tensor(lc),
+                    [Tensor(a) for a in kc], [Tensor(a) for a in vc])
+                nxt_arr = nxt._data.reshape(tok.shape).astype(tok.dtype)
+                return ((nxt_arr, lc + 1,
+                         [t._data for t in nk], [t._data for t in nv]),
+                        nxt_arr[:, 0])
+
+            (_, _, kf, vf), toks = jax.lax.scan(
+                body, (tokens._data, lens._data, kp, vp), None, length=n)
+            return (jnp.swapaxes(toks, 0, 1), *kf, *vf)
+
+        return fn
+
+    def _ensure_burst_compiled(self, n):
+        sf = self._burst_static.get(n)
+        if sf is None:
+            from ..jit import StaticFunction
+
+            sf = StaticFunction(self._decode_burst_fn(n),
+                                state=[self.model], warmup="once",
+                                donate_inputs=True)
+            # no lazy state to materialize (params exist; no optimizer):
+            # skip the eager warmup — n scanned steps of per-op dispatch
+            # would cost more than the compile it avoids
+            sf._warmed_any = True
+            self._burst_static[n] = sf
+        return sf
+
+    def _burst(self, n):
+        """Decode ``n`` tokens for every live request in one dispatch.
+        Pages for all n tokens are reserved up front; requests that
+        retire mid-burst (EOS / max_new_tokens) have their tail tokens
+        discarded at emit time — bounded waste, no correctness impact."""
         live = [r for r in self._live.values() if not r.done]
-        if not live:
+        if not live or n <= 0:
             return 0
-        assert all(r.eos_token_id is None
-                   and len(r.output_ids) + n <= r.max_new_tokens
-                   for r in live), "decode_many needs retire-free steps"
-        step = self._ensure_decode_compiled()
-        tokens = np.zeros((self.max_batch, 1), np.int64)
+        start_lens = {r.seq_id: self.alloc._lens[r.seq_id] for r in live}
+        for r in live:
+            self.alloc.extend(r.seq_id, n)
+        b = self.max_batch
+        tables = np.full((b, self.width), self.trash_page, np.int32)
+        lens = np.ones((b,), np.int32)
+        tokens = np.zeros((b, 1), np.int64)
         for i, r in enumerate(live):
+            t = self.alloc._tables[r.seq_id]
+            tables[i, :len(t)] = t
+            lens[i] = start_lens[r.seq_id] + 1   # first new token included
             tokens[i, 0] = r.output_ids[-1] if r.output_ids \
                 else r.prompt_ids[-1]
-        tok_t = Tensor(jnp.asarray(tokens))
-        outs = []
-        for _ in range(n):
-            for r in live:
-                self.alloc.extend(r.seq_id, 1)
-            tables, lens = self._views_np(live)
-            nxt, new_k, new_v = step(
-                tok_t, Tensor(jnp.asarray(tables)),
+        sf = self._ensure_burst_compiled(n)
+        with no_grad():
+            out = sf(
+                Tensor(jnp.asarray(tokens)), Tensor(jnp.asarray(tables)),
                 Tensor(jnp.asarray(lens)), self.k_pools, self.v_pools)
-            self.k_pools, self.v_pools = list(new_k), list(new_v)
-            outs.append(nxt._data)
-            tok_t = nxt.reshape([self.max_batch, 1])
-        all_tokens = np.asarray(jnp.concatenate(outs, axis=1))  # one D2H
+        n_layers = len(self.k_pools)
+        toks = out[0]
+        self.k_pools = list(out[1:1 + n_layers])
+        self.v_pools = list(out[1 + n_layers:])
+        all_tokens = np.asarray(toks._data)          # one D2H
+        served = 0
         for i, r in enumerate(live):
             for t in range(n):
+                if r.done:
+                    break
                 self._emit(r, int(all_tokens[i, t]))
-        return len(live) * n
+                served += 1
+        return served
+
+    def _burst_fits(self, live, n):
+        """Largest burst <= n whose page reservations fit the pool."""
+        page = self.page_size
+        while n > 1:
+            need = sum(
+                max(0, -(-(self.alloc._lens[r.seq_id] + n) // page)
+                    - len(self.alloc._tables[r.seq_id]))
+                for r in live)
+            if need <= self.alloc.free_pages:
+                break
+            n //= 2
+        return n
+
+    def decode_many(self, n):
+        """``n`` decode steps for the current live set, chunked into
+        compiled :attr:`burst`-length scans (+ per-step remainder).
+        Returns tokens served."""
+        served = 0
+        while n > 0:
+            live = [r for r in self._live.values() if not r.done]
+            if not live:
+                break
+            if n >= self.burst:
+                chunk = self._burst_fits(live, self.burst)
+                if chunk == self.burst:
+                    served += self._burst(chunk)
+                    n -= chunk
+                    continue
+            served += self.step()
+            n -= 1
+        return served
 
     def generate(self, prompts, max_new_tokens=16, eos_token_id=None):
         """Convenience batch API: admit all prompts (continuous batching
         handles ragged finish times), run to completion, return output id
-        lists in order."""
+        lists in order. Admissions happen in waves — every pending
+        request that fits prefills in ONE compiled call."""
         reqs = [Request(p, max_new_tokens, eos_token_id) for p in prompts]
         pending = list(reqs)
         while pending or any(not r.done for r in reqs):
+            wave = []
             while pending and len(self._live) < self.max_batch:
-                self.add_request(pending.pop(0))
+                self._admit(pending[0])
+                wave.append(pending.pop(0))
+            self._prefill_wave(wave)
             live = [r for r in self._live.values() if not r.done]
-            # sync-free fast path while no request can retire; with
-            # pending admissions cap the burst so a retirement (and the
-            # admission it enables) is never far away
-            if live and eos_token_id is None:
+            if live:
+                # burst until the earliest possible retirement; with EOS
+                # or pending admissions cap at the burst length so a
+                # retirement (and the admission it unblocks) is never
+                # far away
                 burst = min(r.max_new_tokens - len(r.output_ids)
                             for r in live)
-                if pending:
-                    burst = min(burst, 8)
-                if burst > 1:
+                if pending or eos_token_id is not None:
+                    burst = min(burst, self.burst)
+                if burst >= self.burst:
                     self.decode_many(burst)
                     continue
-            if not self.step() and pending:
+                for _ in range(max(burst, 1)):
+                    self.step()
                 continue
             if not pending and all(r.done for r in reqs):
                 break

@@ -17,7 +17,8 @@ __all__ = [
     "embedding", "normalize", "cosine_similarity", "bilinear",
     "label_smooth", "interpolate", "upsample", "pixel_shuffle",
     "pixel_unshuffle", "channel_shuffle", "unfold", "fold", "one_hot",
-    "grid_sample",
+    "grid_sample", "affine_grid", "linear_interp", "bilinear_interp",
+    "nearest_interp", "bicubic_interp", "trilinear_interp",
 ]
 
 
@@ -228,30 +229,170 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
     return padded[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
 
 
+def _interp_coords(out_size, in_size, align_corners, align_mode):
+    """Source coordinate of each output index for the linear/cubic
+    families (reference `phi/kernels/funcs/interpolate_function.h`:
+    align_corners -> i*(in-1)/(out-1); else align_mode 0 -> half-pixel,
+    align_mode 1 -> i*scale)."""
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners:
+        return i * (in_size - 1) / max(out_size - 1, 1)
+    if align_mode == 1:
+        return i * in_size / out_size
+    return (i + 0.5) * in_size / out_size - 0.5
+
+
+def _axis_weights(w, axis, ndim, out_size):
+    shape = [1] * ndim
+    shape[axis] = out_size
+    return w.reshape(shape)
+
+
+def _interp_axis_linear(x, axis, coords):
+    """Separable 2-tap lerp along ``axis`` at float ``coords``."""
+    n = x.shape[axis]
+    c = jnp.clip(coords, 0, n - 1)
+    i0 = jnp.clip(jnp.floor(c).astype(jnp.int32), 0, n - 1)
+    i1 = jnp.clip(i0 + 1, 0, n - 1)
+    w = (c - i0).astype(x.dtype)
+    w = _axis_weights(w, axis, x.ndim, coords.shape[0])
+    return jnp.take(x, i0, axis) * (1 - w) + jnp.take(x, i1, axis) * w
+
+
+def _cubic_kernel(t, a=-0.75):
+    """Keys cubic convolution weights for the 4 taps at offsets
+    (-1, 0, 1, 2) given fractional position t (reference
+    `phi/kernels/funcs/interpolate_function.h:cubic_interp`)."""
+    def w1(d):   # |d| <= 1
+        return (a + 2) * d ** 3 - (a + 3) * d ** 2 + 1
+
+    def w2(d):   # 1 < |d| < 2
+        return a * d ** 3 - 5 * a * d ** 2 + 8 * a * d - 4 * a
+
+    return [w2(t + 1), w1(t), w1(1 - t), w2(2 - t)]
+
+
+def _interp_axis_cubic(x, axis, coords):
+    n = x.shape[axis]
+    f = jnp.floor(coords)
+    t = (coords - f).astype(jnp.float32)
+    base = f.astype(jnp.int32)
+    out = 0
+    for k, wk in enumerate(_cubic_kernel(t)):
+        idx = jnp.clip(base + (k - 1), 0, n - 1)
+        w = _axis_weights(wk.astype(x.dtype), axis, x.ndim, coords.shape[0])
+        out = out + jnp.take(x, idx, axis) * w
+    return out
+
+
+def _interp_axis_nearest(x, axis, out_size, align_corners):
+    n = x.shape[axis]
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners:
+        idx = jnp.round(i * (n - 1) / max(out_size - 1, 1))
+    else:
+        idx = jnp.floor(i * n / out_size)
+    return jnp.take(x, jnp.clip(idx.astype(jnp.int32), 0, n - 1), axis)
+
+
 @defop()
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
-                align_corners=False, data_format="NCHW"):
-    """Resize via jax.image (reference common.py interpolate subset:
-    nearest / bilinear / bicubic / area on 4-D, trilinear on 5-D)."""
-    if data_format.startswith("NC"):
-        spatial = x.shape[2:]
-    else:
-        spatial = x.shape[1:-1]
+                align_corners=False, align_mode=0, data_format="NCHW"):
+    """Resize (reference `nn/functional/common.py:interpolate`; CUDA
+    kernels `phi/kernels/gpu/interpolate_kernel.cu`). TPU-native:
+    separable per-axis gather + lerp/cubic taps that XLA fuses — all
+    five modes honor align_corners / align_mode exactly; `area`
+    delegates to adaptive average pooling."""
+    channel_last = not data_format.startswith("NC")
+    spatial_axes = list(range(1, x.ndim - 1)) if channel_last \
+        else list(range(2, x.ndim))
+    spatial = [x.shape[a] for a in spatial_axes]
     if size is None:
         if scale_factor is None:
             raise ValueError("one of size/scale_factor is required")
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
             else [scale_factor] * len(spatial)
-        size = [int(s * f) for s, f in zip(spatial, sf)]
-    size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
-    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
-              "trilinear": "linear", "bicubic": "cubic",
-              "area": "linear"}[mode]
-    if data_format.startswith("NC"):
-        full = list(x.shape[:2]) + size
+        size = [int(s * float(f)) for s, f in zip(spatial, sf)]
     else:
-        full = [x.shape[0]] + size + [x.shape[-1]]
-    return jax.image.resize(x, tuple(full), method=method)
+        size = [int(s) for s in
+                (size if isinstance(size, (list, tuple)) else [size])]
+    if len(size) != len(spatial):
+        raise ValueError(
+            f"size has {len(size)} dims but input has {len(spatial)} "
+            "spatial dims")
+    if mode == "area":
+        from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,
+                              adaptive_avg_pool3d)
+        pool = {1: adaptive_avg_pool1d, 2: adaptive_avg_pool2d,
+                3: adaptive_avg_pool3d}[len(size)]
+        if channel_last:
+            x = jnp.moveaxis(x, -1, 1)
+        out = pool(x, size)
+        out = getattr(out, "_data", out)
+        return jnp.moveaxis(out, 1, -1) if channel_last else out
+    if mode == "nearest":
+        for a, s in zip(spatial_axes, size):
+            x = _interp_axis_nearest(x, a, s, align_corners)
+        return x
+    if mode in ("linear", "bilinear", "trilinear"):
+        fn = _interp_axis_linear
+    elif mode == "bicubic":
+        fn = _interp_axis_cubic
+    else:
+        raise ValueError(f"unsupported mode {mode!r}")
+    for a, s in zip(spatial_axes, size):
+        coords = _interp_coords(s, x.shape[a], align_corners,
+                                0 if mode == "bicubic" else align_mode)
+        x = fn(x, a, coords)
+    return x
+
+
+def _interp_family(op_name, mode, ndim):
+    @defop(name=op_name)
+    def op(x, size=None, scale_factor=None, align_corners=False,
+           align_mode=0, data_format="NCHW"):
+        if x.ndim != ndim:
+            raise ValueError(f"{op_name} expects {ndim}-D input")
+        # reuse the raw-jax interpolate body (x is already an array here)
+        return interpolate.__wrapped__(
+            x, size=size, scale_factor=scale_factor, mode=mode,
+            align_corners=align_corners, align_mode=align_mode,
+            data_format=data_format)
+    op.__name__ = op_name
+    op.__doc__ = (f"Reference op `{op_name}` "
+                  "(`paddle/phi/api/yaml/legacy_ops.yaml`): the "
+                  f"{mode} resize kernel behind F.interpolate.")
+    return op
+
+
+linear_interp = _interp_family("linear_interp", "linear", 3)
+bilinear_interp = _interp_family("bilinear_interp", "bilinear", 4)
+nearest_interp = _interp_family("nearest_interp", "nearest", 4)
+bicubic_interp = _interp_family("bicubic_interp", "bicubic", 4)
+trilinear_interp = _interp_family("trilinear_interp", "trilinear", 5)
+
+
+@defop()
+def affine_grid(theta, out_shape, align_corners=True):
+    """Sampling grid for a batch of affine transforms (reference op
+    `affine_grid`, `phi/kernels/impl/affine_grid_kernel_impl.h`).
+    theta [N,2,3] -> grid [N,H,W,2]; theta [N,3,4] -> [N,D,H,W,3]."""
+    out_shape = [int(s) for s in out_shape]
+    spatial = out_shape[2:]
+
+    def axis_coords(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        # half-pixel centers: (2i + 1)/n - 1
+        return (2 * jnp.arange(n, dtype=jnp.float32) + 1) / n - 1
+
+    coords = [axis_coords(n) for n in spatial]
+    mesh = jnp.meshgrid(*coords, indexing="ij")     # D,H,W order
+    # grid coordinate order is (x, y[, z]) = reversed spatial
+    base = jnp.stack(list(reversed(mesh)) + [jnp.ones_like(mesh[0])],
+                     axis=-1)                       # [*spatial, ndim+1]
+    base = base.astype(theta.dtype)
+    return jnp.einsum("...i,nji->n...j", base, theta)
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest",

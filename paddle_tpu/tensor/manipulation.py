@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import builtins
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -25,6 +27,9 @@ __all__ = [
     "as_real", "atleast_1d", "atleast_2d", "atleast_3d", "diagonal",
     "diagonal_scatter", "select_scatter", "slice_scatter", "unflatten",
     "unfold", "tensor_split",
+    "diag_embed", "fill_diagonal", "fill_diagonal_tensor", "multiplex",
+    "reverse", "sequence_mask", "shuffle_channel", "temporal_shift",
+    "gather_tree",
 ]
 
 
@@ -619,3 +624,162 @@ def index_fill(x, index, axis, value):
     moved = jnp.moveaxis(x, axis, 0)
     moved = moved.at[idx].set(v)
     return jnp.moveaxis(moved, 0, axis)
+
+
+# -- reference-op parity batch (phi/api/yaml: diag_embed, fill_diagonal,
+#    fill_diagonal_tensor, multiplex, reverse, sequence_mask,
+#    shuffle_channel, temporal_shift, gather_tree) ---------------------------
+@defop(method=True)
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    """Embed the last dim of ``x`` as the (offset) diagonal of new
+    trailing matrices (reference op `diag_embed`,
+    `phi/kernels/impl/diag_embed_impl.h`)."""
+    x = jnp.asarray(x)
+    n = x.shape[-1] + builtins.abs(int(offset))
+    out_ndim = x.ndim + 1
+    d1 = int(dim1) % out_ndim
+    d2 = int(dim2) % out_ndim
+    if d1 == d2:
+        raise ValueError("diag_embed: dim1 and dim2 must differ")
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + (-int(offset) if offset < 0 else 0)
+    c = idx + (int(offset) if offset > 0 else 0)
+    base = base.at[..., r, c].set(x)
+    # base has the matrix at the trailing two dims; move them to (d1, d2)
+    src = (out_ndim - 2, out_ndim - 1)
+    if (d1, d2) != src:
+        lo, hi = (d1, d2) if d1 < d2 else (d2, d1)
+        base = jnp.moveaxis(base, src, (lo, hi))
+        if d1 > d2:
+            base = jnp.swapaxes(base, d1, d2)
+    return base
+
+
+@defop(method=True, inplace_method="fill_diagonal_")
+def fill_diagonal(x, value, offset=0, wrap=False):
+    """Fill the main (offset) diagonal of ``x`` (reference op
+    `fill_diagonal`). With ``wrap`` the diagonal wraps for tall 2-D
+    matrices, matching numpy/paddle semantics."""
+    x = jnp.asarray(x)
+    if x.ndim < 2:
+        raise ValueError("fill_diagonal needs ndim >= 2")
+    if x.ndim == 2:
+        h, w = x.shape
+        flat = jnp.arange(h * w)
+        r, c = flat // w, flat % w
+        if wrap:
+            # numpy semantics: the diagonal stripe repeats every w+1
+            # flat positions, continuing past the bottom of tall mats
+            start = int(offset) if offset >= 0 else -int(offset) * w
+            on = (flat >= start) & ((flat - start) % (w + 1) == 0)
+        else:
+            on = (c - r) == int(offset)
+        return jnp.where(on.reshape(h, w), jnp.asarray(value, x.dtype), x)
+    n = builtins.min(x.shape[-2:])
+    idx = jnp.arange(n - builtins.abs(int(offset)))
+    r = idx + (-int(offset) if offset < 0 else 0)
+    c = idx + (int(offset) if offset > 0 else 0)
+    return x.at[..., r, c].set(jnp.asarray(value, x.dtype))
+
+
+@defop(method=True, inplace_method="fill_diagonal_tensor_")
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """Write tensor ``y`` onto the (dim1, dim2) diagonal of ``x``
+    (reference op `fill_diagonal_tensor`,
+    `phi/kernels/gpu/fill_diagonal_tensor_kernel.cu`)."""
+    x = jnp.asarray(x)
+    d1 = int(dim1) % x.ndim
+    d2 = int(dim2) % x.ndim
+    # move the diagonal pair to the back, write, move back
+    xt = jnp.moveaxis(x, (d1, d2), (-2, -1))
+    n = builtins.min(xt.shape[-2:]) - builtins.abs(int(offset))
+    idx = jnp.arange(n)
+    r = idx + (-int(offset) if offset < 0 else 0)
+    c = idx + (int(offset) if offset > 0 else 0)
+    # y carries the batch dims (x minus dim1/dim2) plus the diagonal
+    # length as its trailing dim — already aligned with xt[..., r, c]
+    xt = xt.at[..., r, c].set(jnp.asarray(y, x.dtype))
+    return jnp.moveaxis(xt, (-2, -1), (d1, d2))
+
+
+@defop()
+def multiplex(inputs, index):
+    """Row-wise select across candidate tensors: out[i] =
+    inputs[index[i]][i] (reference op `multiplex`,
+    `phi/kernels/gpu/multiplex_kernel.cu`)."""
+    stacked = jnp.stack([jnp.asarray(t) for t in inputs], axis=0)  # [K,N,...]
+    idx = jnp.asarray(index).reshape(-1).astype(jnp.int32)
+    n = stacked.shape[1]
+    return stacked[idx, jnp.arange(n)]
+
+
+def reverse(x, axis, name=None):
+    """Deprecated paddle alias of :func:`flip` (reference legacy op
+    `reverse`)."""
+    return flip(x, axis)
+
+
+@defop()
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    """mask[i, j] = j < x[i] (reference op `sequence_mask`,
+    `phi/kernels/funcs/sequence_mask_kernel.h`)."""
+    lens = jnp.asarray(x)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(lens))
+    mask = jnp.arange(m)[None, :] < lens.reshape(-1, 1)
+    return mask.reshape(lens.shape + (m,)).astype(dtypes.convert_dtype(dtype))
+
+
+@defop()
+def shuffle_channel(x, group):
+    """NCHW channel shuffle (reference op `shuffle_channel`) — the
+    ShuffleNet channel mix: [N, G, C/G, H, W] transpose."""
+    n, c, h, w = x.shape
+    g = int(group)
+    return x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(n, c, h, w)
+
+
+@defop()
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """TSM temporal shift (reference op `temporal_shift`,
+    `phi/kernels/gpu/temporal_shift_kernel.cu`): within each segment
+    group, shift the first fold of channels backward in time, the
+    second forward, keep the rest."""
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    nt, c, h, w = x.shape
+    t = int(seg_num)
+    n = nt // t
+    fold = int(c * float(shift_ratio))
+    v = x.reshape(n, t, c, h, w)
+    back = jnp.concatenate(
+        [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]],
+        axis=1)
+    out = jnp.concatenate([back, fwd, v[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@defop(differentiable=False)
+def gather_tree(ids, parents):
+    """Beam-search back-trace (reference op `gather_tree`,
+    `phi/kernels/gpu/gather_tree_kernel.cu`): ids/parents are
+    [max_time, batch, beam]; walk parents from the last step back,
+    emitting the full token path per beam."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    tmax, batch, beam = ids.shape
+    b_idx = jnp.arange(batch)[:, None]
+    k_idx = jnp.arange(beam)[None, :]
+
+    def body(parent, t):                          # parent: [batch, beam]
+        tok = ids[t][b_idx, parent]
+        return parents[t][b_idx, parent], tok
+
+    init = jnp.broadcast_to(k_idx, (batch, beam)).astype(parents.dtype)
+    _, toks = jax.lax.scan(body, init, jnp.arange(tmax - 1, -1, -1))
+    return toks[::-1]

@@ -17,6 +17,10 @@ from .registry import defop
 
 __all__ = [
     "trapezoid", "cumulative_trapezoid",
+    "copysign", "nextafter", "gammaln", "gammainc", "gammaincc",
+    "polygamma", "multigammaln", "sinc", "hypot", "i0e", "i1e",
+    "p_norm", "frobenius_norm", "squared_l2_norm", "l1_norm",
+    "clip_by_norm", "mean_all", "reduce_as", "elementwise_pow",
     "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
     "pow", "float_power", "maximum", "minimum", "fmax", "fmin",
     "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
@@ -469,3 +473,140 @@ def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
     else:
         d = 1.0 if dx is None else dx
     return jnp.cumsum((y0 + y1) * 0.5 * d, axis=axis)
+
+
+# -- special functions (reference `phi/api/yaml/ops.yaml`: copysign,
+#    nextafter, gammaln, gammainc(c), polygamma, i0e, i1e) ------------------
+@defop(method=True, inplace_method="copysign_")
+def copysign(x, y):
+    """Magnitude of ``x`` with the sign of ``y`` (reference op
+    `copysign`, CUDA kernel `phi/kernels/gpu/copysign_kernel.cu`)."""
+    return jnp.copysign(x, y)
+
+
+@defop(method=True)
+def nextafter(x, y):
+    """Next representable float after ``x`` toward ``y`` (reference op
+    `nextafter`)."""
+    return jnp.nextafter(x, y)
+
+
+gammaln = _unary("gammaln", jax.scipy.special.gammaln)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+
+
+@defop(method=True, inplace_method="gammainc_")
+def gammainc(x, y):
+    """Regularized lower incomplete gamma P(x, y) (reference op
+    `gammainc`)."""
+    return jax.scipy.special.gammainc(x, y)
+
+
+@defop(method=True, inplace_method="gammaincc_")
+def gammaincc(x, y):
+    """Regularized upper incomplete gamma Q(x, y) (reference op
+    `gammaincc`, `phi/kernels/impl/gammaincc_kernel_impl.h`)."""
+    return jax.scipy.special.gammaincc(x, y)
+
+
+@defop(method=True, inplace_method="polygamma_")
+def polygamma(x, n):
+    """n-th derivative of digamma at ``x`` (reference op `polygamma`)."""
+    return jax.scipy.special.polygamma(n, x)
+
+
+@defop(method=True)
+def multigammaln(x, p):
+    """Log multivariate gamma (reference `tensor/math.py:multigammaln`)."""
+    return jax.scipy.special.multigammaln(x, p)
+
+
+@defop(method=True)
+def sinc(x):
+    """sin(pi x)/(pi x) (reference op `sinc`)."""
+    return jnp.sinc(x)
+
+
+@defop(method=True)
+def hypot(x, y):
+    """sqrt(x^2 + y^2) without overflow (reference `tensor/math.py`)."""
+    return jnp.hypot(x, y)
+
+
+# -- reduction / norm kernels (reference ops p_norm, frobenius_norm,
+#    squared_l2_norm, l1_norm, clip_by_norm, mean_all, reduce_as) -----------
+@defop()
+def p_norm(x, porder=2.0, axis=None, keepdim=False, asvector=False):
+    """Vector p-norm along ``axis`` (reference op `p_norm`,
+    `phi/kernels/gpu/p_norm_kernel.cu`). ``asvector`` flattens first."""
+    if asvector or axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    p = float(porder)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@defop()
+def frobenius_norm(x, axis=None, keepdim=False):
+    """Frobenius norm over the trailing two dims by default (reference op
+    `frobenius_norm`)."""
+    if axis is None:
+        axis = (-2, -1) if x.ndim >= 2 else (-1,)
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+
+
+@defop()
+def squared_l2_norm(x):
+    """sum(x^2) as a 0-d tensor (reference op `squared_l2_norm` — the
+    gradient-clipping workhorse)."""
+    return jnp.sum(jnp.square(x))
+
+
+@defop()
+def l1_norm(x):
+    """sum(|x|) (reference op `l1_norm`)."""
+    return jnp.sum(jnp.abs(x))
+
+
+@defop()
+def clip_by_norm(x, max_norm):
+    """Scale ``x`` so its L2 norm is at most ``max_norm`` (reference op
+    `clip_by_norm`, `phi/kernels/clip_by_norm_kernel.h`)."""
+    nrm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.minimum(max_norm / jnp.maximum(nrm, 1e-12), 1.0)
+    return x * scale
+
+
+@defop()
+def mean_all(x):
+    """Global mean as a 0-d tensor (reference op `mean_all`)."""
+    return jnp.mean(x)
+
+
+@defop()
+def reduce_as(x, target):
+    """Sum-reduce ``x`` down to ``target``'s shape (reference op
+    `reduce_as` — the broadcast-gradient reducer)."""
+    t_shape = target.shape if hasattr(target, "shape") else tuple(target)
+    extra = x.ndim - len(t_shape)
+    if extra:
+        x = jnp.sum(x, axis=tuple(range(extra)))
+    axes = tuple(i for i, (a, b) in enumerate(zip(x.shape, t_shape))
+                 if a != b and b == 1)
+    if axes:
+        x = jnp.sum(x, axis=axes, keepdims=True)
+    return x
+
+
+@defop(name="elementwise_pow", method=False)
+def elementwise_pow(x, y):
+    """Elementwise x**y (reference legacy op `elementwise_pow`)."""
+    return jnp.power(x, y)

@@ -106,3 +106,56 @@ def test_page_boundary_crossing(model):
                                 num_pages=32)
     got = engine.generate([p], max_new_tokens=20)[0]
     assert got == want
+
+
+def test_burst_matches_per_step(model):
+    """decode_many's scanned burst program must emit exactly the tokens
+    the per-step program does."""
+    rng = np.random.RandomState(4)
+    v = model.config.vocab_size
+    prompts = [rng.randint(0, v, (n,)).tolist() for n in (5, 11)]
+    n_new = LlamaServingEngine.BURST + 3     # one burst + step remainder
+
+    e1 = LlamaServingEngine(model, max_batch=2, page_size=8, num_pages=32)
+    for p in prompts:
+        e1.add_request(Request(p, max_new_tokens=n_new))
+    while any(not r.done for r in e1._live.values()) or e1._live:
+        if not e1.step():
+            break
+    per_step = [None, None]
+
+    e2 = LlamaServingEngine(model, max_batch=2, page_size=8, num_pages=32)
+    reqs = [Request(p, max_new_tokens=n_new) for p in prompts]
+    for r in reqs:
+        e2.add_request(r)
+    e2.decode_many(n_new - 1)
+    want = [_reference_continuation(model, p, n_new) for p in prompts]
+    assert [r.output_ids for r in reqs] == want
+
+
+def test_eos_mid_burst(model):
+    """A request hitting EOS inside a burst retires with the tail
+    tokens discarded."""
+    rng = np.random.RandomState(5)
+    v = model.config.vocab_size
+    p = rng.randint(0, v, (5,)).tolist()
+    ref = _reference_continuation(model, p, LlamaServingEngine.BURST + 8)
+    eos = ref[3]
+    engine = LlamaServingEngine(model, max_batch=2, page_size=8,
+                                num_pages=48)
+    out = engine.generate([p], max_new_tokens=LlamaServingEngine.BURST + 8,
+                          eos_token_id=eos)[0]
+    want = ref[:ref.index(eos) + 1]
+    assert out == want
+    assert not engine._live and engine.alloc.free_pages == 47
+
+
+def test_burst_page_pressure_falls_back(model):
+    """When the page pool can't hold a full burst reservation the engine
+    still makes progress via smaller chunks / single steps."""
+    p = [1, 2, 3, 4, 5]
+    want = _reference_continuation(model, p, 24)
+    engine = LlamaServingEngine(model, max_batch=1, page_size=8,
+                                num_pages=8)   # 7 usable pages = 56 slots
+    got = engine.generate([p], max_new_tokens=24)[0]
+    assert got == want
