@@ -258,7 +258,8 @@ def bench_serving(model, n_requests=24, new_tokens=48, max_batch=16,
 
     model.eval()
     engine = LlamaServingEngine(model, max_batch=max_batch, page_size=64,
-                                num_pages=max_batch * 6 + 8)
+                                num_pages=max_batch * 8 + 8,
+                                max_pages_per_seq=8, burst=32)
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, model.config.vocab_size,
                            (int(rng.randint(16, 128)),)).tolist()
@@ -295,7 +296,7 @@ def bench_serving(model, n_requests=24, new_tokens=48, max_batch=16,
         "serving_tokens_per_sec": round(total / dt, 1),
         "serving_steady_tokens_per_sec": round(steady, 1),
         "serving_max_batch": max_batch,
-        "serving_burst": LlamaServingEngine.BURST,
+        "serving_burst": engine.burst,
     }
     if decode_ceiling:
         out["serving_ceiling_frac"] = round(steady / decode_ceiling, 3)

@@ -19,9 +19,10 @@ def _build():
     global _table
     if _table is not None:
         return _table
-    from .ops.schema import load_schema
+    from .ops.schema import load_schema, _import_op_surface
     from .tensor.registry import OPS
 
+    _import_op_surface()   # lazy subpackages (vision/text/...) hold ops too
     _table = {}
     for name in load_schema():
         info = OPS.get(name)

@@ -440,23 +440,33 @@ class LlamaServingEngine:
             n //= 2
         return n
 
-    def decode_many(self, n):
+    def decode_many(self, n, exact=True):
         """``n`` decode steps for the current live set, chunked into
-        compiled :attr:`burst`-length scans (+ per-step remainder).
+        compiled scans: full :attr:`burst`-length bursts, then
+        burst/4-length bursts, then single steps. With ``exact=False``
+        the tail may overshoot by up to burst/4 - 1 ticks — callers use
+        this when every live request retires by step ``n`` (the
+        overshot ticks are discarded at emit time), trading a few idle
+        ticks for never paying the per-step dispatch round trip.
         Returns tokens served."""
         served = 0
+        small = max(self.burst // 4, 2)
         while n > 0:
             live = [r for r in self._live.values() if not r.done]
             if not live:
                 break
             if n >= self.burst:
                 chunk = self._burst_fits(live, self.burst)
-                if chunk == self.burst:
-                    served += self._burst(chunk)
-                    n -= chunk
-                    continue
-            served += self.step()
-            n -= 1
+            elif n >= small or not exact:
+                chunk = self._burst_fits(live, small)
+            else:
+                chunk = 1
+            if chunk > 1:
+                served += self._burst(chunk)
+                n -= chunk
+            else:
+                served += self.step()
+                n -= 1
         return served
 
     def generate(self, prompts, max_new_tokens=16, eos_token_id=None):
@@ -477,16 +487,14 @@ class LlamaServingEngine:
                 # burst until the earliest possible retirement; with EOS
                 # or pending admissions cap at the burst length so a
                 # retirement (and the admission it unblocks) is never
-                # far away
+                # far away. The tail may overshoot (exact=False): every
+                # live request retires by then, so overshot ticks are
+                # discarded, never mis-emitted.
                 burst = min(r.max_new_tokens - len(r.output_ids)
                             for r in live)
                 if pending or eos_token_id is not None:
                     burst = min(burst, self.burst)
-                if burst >= self.burst:
-                    self.decode_many(burst)
-                    continue
-                for _ in range(max(burst, 1)):
-                    self.step()
+                self.decode_many(burst, exact=False)
                 continue
             if not pending and all(r.done for r in reqs):
                 break

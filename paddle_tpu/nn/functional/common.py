@@ -19,6 +19,7 @@ __all__ = [
     "pixel_unshuffle", "channel_shuffle", "unfold", "fold", "one_hot",
     "grid_sample", "affine_grid", "linear_interp", "bilinear_interp",
     "nearest_interp", "bicubic_interp", "trilinear_interp",
+    "class_center_sample",
 ]
 
 
@@ -455,3 +456,31 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
             (y0 + 1, x0 + 1, wy1 * wx1)]:
         out = out + gather(yy, xx) * (wgt * in_bounds(yy, xx))[:, None]
     return out
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """PartialFC class-center sampling (reference op
+    `class_center_sample`, `phi/kernels/gpu/class_center_sample_kernel.cu`
+    — `nn/functional/common.py:2104`): keep every positive class, fill
+    up to ``num_samples`` with random negatives, remap labels into the
+    sampled index space. Sampling is host-side bookkeeping (the result
+    feeds a partial FC layer); returns (remapped_label,
+    sampled_class_center)."""
+    import numpy as _np
+
+    from ...framework.tensor import Tensor as _T
+
+    lbl = _np.asarray(getattr(label, "_data", label)).reshape(-1)
+    pos = _np.unique(lbl)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = _np.setdiff1d(_np.arange(num_classes), pos,
+                                 assume_unique=True)
+        extra = _np.random.permutation(neg_pool)[:num_samples - len(pos)]
+        sampled = _np.sort(_np.concatenate([pos, extra]))
+    remap = _np.full((num_classes,), -1, _np.int64)
+    remap[sampled] = _np.arange(len(sampled))
+    return (_T(jnp.asarray(remap[lbl])),
+            _T(jnp.asarray(sampled.astype(_np.int64))))

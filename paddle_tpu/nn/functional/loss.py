@@ -5,6 +5,8 @@ Reference: `python/paddle/nn/functional/loss.py`.
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -17,7 +19,8 @@ __all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
            "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
            "square_error_cost", "log_loss", "sigmoid_focal_loss",
            "triplet_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
-           "multi_label_soft_margin_loss", "margin_cross_entropy"]
+           "multi_label_soft_margin_loss", "margin_cross_entropy",
+           "huber_loss", "identity_loss", "hsigmoid_loss", "edit_distance"]
 
 
 def _reduce(x, reduction):
@@ -325,3 +328,130 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
     if return_softmax:
         return loss, softmax
     return loss
+
+
+@defop()
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    """Huber loss (reference op `huber_loss`,
+    `phi/kernels/impl/huber_loss_kernel_impl.h`): quadratic within
+    ``delta`` of the target, linear beyond."""
+    d = float(delta)
+    r = jnp.abs(input - label)
+    loss = jnp.where(r <= d, 0.5 * r * r, d * (r - 0.5 * d))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def identity_loss(x, reduction="none"):
+    """Pass-through loss head (reference op `identity_loss`) — reduces
+    its input and marks it as the optimization target."""
+    if isinstance(reduction, int):
+        reduction = {0: "sum", 1: "mean", 2: "none"}[reduction]
+    return _reduce(x, reduction)
+
+
+@defop()
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None):
+    """Hierarchical sigmoid loss (reference op `hsigmoid_loss`,
+    `phi/kernels/cpu/hsigmoid_loss_kernel.cc`). Default mode walks a
+    complete binary tree over ``num_classes`` leaves (internal nodes
+    0..C-2, leaf of class c at c + C - 1); custom mode takes explicit
+    ``path_table``/``path_code``. Cost per sample is the summed
+    BCE-with-logits of each branch decision on the path:
+    sum(softplus(z) - code * z), z = x . w_node + b_node."""
+    x = jnp.asarray(input)
+    lbl = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    n = x.shape[0]
+    if path_table is not None:
+        tbl = jnp.asarray(path_table).astype(jnp.int32)   # [N, L]
+        code = jnp.asarray(path_code).astype(x.dtype)     # [N, L]
+        valid = tbl >= 0
+        tbl = jnp.maximum(tbl, 0)
+    else:
+        c = int(num_classes)
+        depth = max(int(math.ceil(math.log2(max(c, 2)))), 1)
+        # walk leaf -> root in the complete binary tree, then reverse
+        leaf = lbl + (c - 1)
+        steps = []
+        node = leaf
+        for _ in range(depth + 1):
+            parent = (node - 1) // 2
+            is_right = (node == 2 * parent + 2)
+            at_root = node <= 0
+            steps.append((jnp.where(at_root, -1, parent),
+                          is_right.astype(x.dtype),
+                          ~at_root))
+            node = jnp.maximum(parent, 0)
+        tbl = jnp.stack([s[0] for s in steps], axis=1)
+        code = jnp.stack([s[1] for s in steps], axis=1)
+        valid = jnp.stack([s[2] for s in steps], axis=1) & (tbl >= 0)
+        tbl = jnp.maximum(tbl, 0)
+    w = jnp.asarray(weight)                               # [C-1, D]
+    z = jnp.einsum("nd,nld->nl", x, w[tbl])
+    if bias is not None:
+        z = z + jnp.asarray(bias).reshape(-1)[tbl]
+    per = jax.nn.softplus(z) - code * z
+    cost = jnp.sum(jnp.where(valid, per, 0.0), axis=1, keepdims=True)
+    return cost
+
+
+def _edit_distance_one(hyp, ref, hlen, rlen):
+    """Levenshtein DP as nested scans: the outer scan walks hypothesis
+    tokens (rows frozen past hlen), the inner scan threads the
+    left-neighbor dependency along the reference axis."""
+    s2 = ref.shape[0]
+    row0 = jnp.arange(s2 + 1, dtype=jnp.float32)
+
+    def outer(prev, i):
+        first = prev[0] + 1
+
+        def inner(left, j):
+            cost = jnp.where(hyp[i] == ref[j], 0.0, 1.0)
+            val = jnp.minimum(jnp.minimum(prev[j + 1] + 1, left + 1),
+                              prev[j] + cost)
+            return val, val
+
+        _, rest = jax.lax.scan(inner, first, jnp.arange(s2))
+        new = jnp.concatenate([first[None], rest])
+        return jnp.where(i < hlen, new, prev), None
+
+    last, _ = jax.lax.scan(outer, row0, jnp.arange(hyp.shape[0]))
+    return jnp.take(last, rlen)
+
+
+@defop(differentiable=False)
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance per sequence pair (reference op
+    `edit_distance`, `phi/kernels/impl/edit_distance_kernel_impl.h`).
+    Returns (distance [B, 1], sequence_num [1])."""
+    hyp = jnp.asarray(input)
+    ref = jnp.asarray(label)
+    if hyp.ndim == 3:
+        hyp = hyp[..., 0]
+    if ref.ndim == 3:
+        ref = ref[..., 0]
+    b = hyp.shape[0]
+    hlen = (jnp.asarray(input_length).reshape(-1) if input_length is not None
+            else jnp.full((b,), hyp.shape[1]))
+    rlen = (jnp.asarray(label_length).reshape(-1) if label_length is not None
+            else jnp.full((b,), ref.shape[1]))
+    if ignored_tokens:
+        # compact each row: drop ignored tokens, shift survivors left
+        def compact(seq, ln):
+            keep = jnp.ones(seq.shape, bool)
+            for t in ignored_tokens:
+                keep &= seq != t
+            keep &= jnp.arange(seq.shape[0]) < ln
+            order = jnp.argsort(~keep, stable=True)
+            return seq[order], jnp.sum(keep.astype(jnp.int32))
+
+        hyp, hlen = jax.vmap(compact)(hyp, hlen)
+        ref, rlen = jax.vmap(compact)(ref, rlen)
+    hlen = hlen.astype(jnp.int32)
+    rlen = rlen.astype(jnp.int32)
+    dist = jax.vmap(_edit_distance_one)(hyp, ref, hlen, rlen)
+    if normalized:
+        dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+    return dist[:, None], jnp.asarray([b], jnp.int32)

@@ -67,11 +67,25 @@ def _signature_entry(fn):
     return params
 
 
+def _import_op_surface():
+    """Import every op-bearing module so the registry is complete.
+
+    The top-level package keeps heavy subpackages (vision, text,
+    geometric) lazy; the schema is the inventory of ALL ops, so the
+    snapshot/validation path must load them deterministically."""
+    import importlib
+
+    for mod in ("paddle_tpu", "paddle_tpu.vision.ops", "paddle_tpu.text",
+                "paddle_tpu.geometric", "paddle_tpu.signal",
+                "paddle_tpu.incubate.nn.functional"):
+        importlib.import_module(mod)
+
+
 def snapshot_registry():
     """The live @defop registry in schema form (sorted by op name)."""
     from paddle_tpu.tensor.registry import OPS
 
-    # importing the package registers every op; make sure it happened
+    _import_op_surface()
     if not OPS:
         raise RuntimeError("op registry empty — import paddle_tpu first")
     out = []

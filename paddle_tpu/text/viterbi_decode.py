@@ -54,7 +54,19 @@ def _viterbi(pot, trans, lengths, include_bos_eos_tag):
         [tags_rev[::-1], last_tag[None]], axis=0).swapaxes(0, 1)  # [B, L]
     pos = jnp.arange(l, dtype=jnp.int32)[None, :]
     paths = jnp.where(pos < lengths[:, None], paths, 0)
-    return scores, paths.astype(jnp.int64)
+    return scores, paths.astype(jnp.int32)
+
+
+from ..tensor.registry import defop
+
+
+@defop(name="viterbi_decode", differentiable=False)
+def _viterbi_op(potentials, transition_params, lengths,
+                include_bos_eos_tag=True):
+    """Schema entry for the reference op `viterbi_decode`
+    (`phi/kernels/cpu/viterbi_decode_kernel.cc`)."""
+    return _viterbi(potentials, transition_params, lengths,
+                    include_bos_eos_tag)
 
 
 def viterbi_decode(potentials, transition_params, lengths,
@@ -65,11 +77,8 @@ def viterbi_decode(potentials, transition_params, lengths,
     reference, the path tensor is truncated to the longest real
     sequence; shorter rows are zero-padded.
     """
-    out = run_op(
-        "viterbi_decode",
-        lambda p, t, ln: _viterbi(p, t, ln, include_bos_eos_tag),
-        (potentials, transition_params, lengths), differentiable=False)
-    scores, paths = out
+    scores, paths = _viterbi_op(potentials, transition_params, lengths,
+                                include_bos_eos_tag=include_bos_eos_tag)
     max_len = int(np.asarray(
         getattr(lengths, "_data", lengths)).max())
     return scores, paths[:, :max_len]

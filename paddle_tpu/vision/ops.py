@@ -16,7 +16,9 @@ import numpy as np
 from ..framework.tensor import run_op
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_iou", "deform_conv2d",
-           "DeformConv2D"]
+           "DeformConv2D", "box_coder", "prior_box", "yolo_box",
+           "matrix_nms", "psroi_pool", "distribute_fpn_proposals",
+           "generate_proposals"]
 
 
 def _iou_matrix(boxes):
@@ -337,3 +339,353 @@ class DeformConv2D:
     def __call__(self, x, offset, mask=None):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              mask=mask, **self._cfg)
+
+
+# -- reference detection-op parity batch (phi/api/yaml: box_coder,
+#    prior_box, yolo_box, matrix_nms, psroi_pool,
+#    distribute_fpn_proposals, generate_proposals) --------------------------
+from ..tensor.registry import defop  # noqa: E402
+
+
+@defop(differentiable=False)
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0):
+    """Encode/decode boxes against priors (reference op `box_coder`,
+    kernel `phi/kernels/cpu/box_coder_kernel.cc` — formulas match
+    EncodeCenterSize/DecodeCenterSize exactly, including the +1
+    width/height for unnormalized boxes)."""
+    pb = jnp.asarray(prior_box, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    one = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + one
+    ph = pb[:, 3] - pb[:, 1] + one
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if prior_box_var is None:
+        var = jnp.ones((pb.shape[0], 4), jnp.float32)
+    elif isinstance(prior_box_var, (list, tuple)):
+        var = jnp.broadcast_to(jnp.asarray(prior_box_var, jnp.float32),
+                               (pb.shape[0], 4))
+    else:
+        var = jnp.asarray(prior_box_var, jnp.float32)
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + one
+        th = tb[:, 3] - tb[:, 1] + one
+        tcx = (tb[:, 0] + tb[:, 2]) / 2
+        tcy = (tb[:, 1] + tb[:, 3]) / 2
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)     # [N, M, 4]
+        return out / var[None, :, :]
+    if code_type != "decode_center_size":
+        raise ValueError(f"bad code_type {code_type!r}")
+    # decode: target [N, M, 4]; prior broadcast along `axis`
+    exp = (slice(None), None) if axis == 0 else (None, slice(None))
+    pw_, ph_ = pw[exp], ph[exp]
+    pcx_, pcy_ = pcx[exp], pcy[exp]
+    var_ = var[exp + (slice(None),)]
+    cx = var_[..., 0] * tb[..., 0] * pw_ + pcx_
+    cy = var_[..., 1] * tb[..., 1] * ph_ + pcy_
+    w = jnp.exp(var_[..., 2] * tb[..., 2]) * pw_
+    h = jnp.exp(var_[..., 3] * tb[..., 3]) * ph_
+    return jnp.stack([cx - w / 2, cy - h / 2,
+                      cx + w / 2 - one, cy + h / 2 - one], axis=-1)
+
+
+@defop(differentiable=False)
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes (reference op `prior_box`,
+    `phi/kernels/cpu/prior_box_kernel.cc`). Returns (boxes, variances)
+    each [H, W, num_priors, 4]."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    max_sizes = list(max_sizes or [])
+    cx = (np.arange(fw) + offset) * step_w        # [W]
+    cy = (np.arange(fh) + offset) * step_h        # [H]
+    whs = []                                       # (w/2, h/2) per prior
+    for s, mn in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((mn / 2, mn / 2))
+            if max_sizes:
+                mx = max_sizes[s]
+                whs.append((np.sqrt(mn * mx) / 2,) * 2)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((mn * np.sqrt(ar) / 2, mn / np.sqrt(ar) / 2))
+        else:
+            for ar in ars:
+                whs.append((mn * np.sqrt(ar) / 2, mn / np.sqrt(ar) / 2))
+            if max_sizes:
+                mx = max_sizes[s]
+                whs.append((np.sqrt(mn * mx) / 2,) * 2)
+    wh = np.asarray(whs, np.float32)              # [P, 2]
+    ccx = np.broadcast_to(cx[None, :, None], (fh, fw, wh.shape[0]))
+    ccy = np.broadcast_to(cy[:, None, None], (fh, fw, wh.shape[0]))
+    boxes = np.stack([(ccx - wh[None, None, :, 0]) / iw,
+                      (ccy - wh[None, None, :, 1]) / ih,
+                      (ccx + wh[None, None, :, 0]) / iw,
+                      (ccy + wh[None, None, :, 1]) / ih], axis=-1)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(np.asarray(variance, np.float32),
+                            boxes.shape).copy()
+    return jnp.asarray(boxes), jnp.asarray(vars_)
+
+
+@defop(differentiable=False)
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """YOLOv3 head decode (reference op `yolo_box`,
+    `phi/kernels/funcs/yolo_box_util.h:GetYoloBox` — same center/size
+    formulas, clipping, and confidence gating)."""
+    x = jnp.asarray(x, jnp.float32)
+    n, _, h, w = x.shape
+    an = len(anchors) // 2
+    aw = jnp.asarray(anchors[0::2], jnp.float32)
+    ah = jnp.asarray(anchors[1::2], jnp.float32)
+    isz = jnp.asarray(img_size, jnp.float32)       # [N, 2] = (h, w)
+    img_h = isz[:, 0][:, None, None, None]
+    img_w = isz[:, 1][:, None, None, None]
+    in_w = downsample_ratio * w
+    in_h = downsample_ratio * h
+    if iou_aware:
+        ious = jax.nn.sigmoid(x[:, :an].reshape(n, an, 1, h, w))
+        x = x[:, an:]
+    v = x.reshape(n, an, 5 + int(class_num), h, w)
+    gi = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gj = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    scale, bias = float(scale_x_y), -0.5 * (float(scale_x_y) - 1)
+    cx = (gi + jax.nn.sigmoid(v[:, :, 0]) * scale + bias) * img_w / w
+    cy = (gj + jax.nn.sigmoid(v[:, :, 1]) * scale + bias) * img_h / h
+    bw = jnp.exp(v[:, :, 2]) * aw[None, :, None, None] * img_w / in_w
+    bh = jnp.exp(v[:, :, 3]) * ah[None, :, None, None] * img_h / in_h
+    conf = jax.nn.sigmoid(v[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) \
+            * ious[:, :, 0] ** iou_aware_factor
+    x1, y1 = cx - bw / 2, cy - bh / 2
+    x2, y2 = cx + bw / 2, cy + bh / 2
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    keep = (conf > conf_thresh).astype(jnp.float32)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=2) * keep[:, :, None]
+    scores = jax.nn.sigmoid(v[:, :, 5:]) * (conf * keep)[:, :, None]
+    boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(n, -1, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, -1, int(class_num))
+    return boxes, scores
+
+
+@defop(differentiable=False)
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None):
+    """Assign RoIs to FPN levels (reference op
+    `distribute_fpn_proposals`,
+    `phi/kernels/impl/distribute_fpn_proposals_kernel_impl.h`):
+    level = floor(refer_level + log2(sqrt(area) / refer_scale)),
+    clamped to [min_level, max_level]. Returns (rois per level,
+    restore_index) with each level's rois gathered in order."""
+    rois = jnp.asarray(fpn_rois, jnp.float32)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = jnp.sqrt(ws * hs)
+    lvl = jnp.floor(jnp.log2(scale / float(refer_scale) + 1e-8)) \
+        + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    order = jnp.argsort(lvl, stable=True)
+    restore = jnp.argsort(order, stable=True)
+    multi_rois, counts = [], []
+    for level in range(int(min_level), int(max_level) + 1):
+        mask = lvl == level
+        counts.append(jnp.sum(mask.astype(jnp.int32)))
+        # stable partition: rois of this level in original order,
+        # padded region filled by duplicating the sort gather (callers
+        # use the per-level count to slice)
+        sel = jnp.argsort(jnp.where(mask, 0, 1), stable=True)
+        multi_rois.append(rois[sel])
+    return tuple(multi_rois) + (restore,) + tuple(counts)
+
+
+@defop(differentiable=False)
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=-1, keep_top_k=-1, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True):
+    """Matrix NMS (reference op `matrix_nms`,
+    `phi/kernels/impl/matrix_nms_kernel_impl.h` — SOLOv2's parallel
+    soft suppression). bboxes [N, M, 4], scores [N, C, M]; returns
+    ([N, K, 6] (class, score, box) sorted by decayed score, padded with
+    -1 rows, and per-image kept counts [N])."""
+    b = jnp.asarray(bboxes, jnp.float32)
+    s = jnp.asarray(scores, jnp.float32)
+    n, c, m = s.shape
+    top_k = m if nms_top_k < 0 else min(int(nms_top_k), m)
+
+    def one_class(boxes, sc):
+        order = jnp.argsort(-sc)[:top_k]
+        bs, ss = boxes[order], sc[order]
+        valid = ss > score_threshold
+        x1, y1, x2, y2 = bs[:, 0], bs[:, 1], bs[:, 2], bs[:, 3]
+        one = 0.0 if normalized else 1.0
+        area = (x2 - x1 + one) * (y2 - y1 + one)
+        ix1 = jnp.maximum(x1[:, None], x1[None, :])
+        iy1 = jnp.maximum(y1[:, None], y1[None, :])
+        ix2 = jnp.minimum(x2[:, None], x2[None, :])
+        iy2 = jnp.minimum(y2[:, None], y2[None, :])
+        iw = jnp.maximum(ix2 - ix1 + one, 0)
+        ih = jnp.maximum(iy2 - iy1 + one, 0)
+        inter = iw * ih
+        iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                                  1e-10)
+        upper = jnp.tril(iou, k=-1)                 # [i, j<i]: iou with
+        #                                             higher-scored box j
+        # compensate iou of j = its own max iou with anything above it
+        comp = jnp.max(upper, axis=1)
+        if use_gaussian:
+            decay = jnp.exp((comp[None, :] ** 2 - upper ** 2)
+                            / gaussian_sigma)
+        else:
+            decay = (1 - upper) / jnp.maximum(1 - comp[None, :], 1e-10)
+        decay = jnp.where(jnp.tril(jnp.ones_like(iou), k=-1) > 0,
+                          decay, jnp.inf)
+        dec = jnp.min(decay, axis=1)     # over higher-scored boxes j < i
+        dec = jnp.where(jnp.isinf(dec), 1.0, dec)
+        out_s = jnp.where(valid, ss * dec, -1.0)
+        return bs, out_s
+
+    outs, cnts = [], []
+    for bi in range(n):
+        rows = []
+        for ci in range(c):
+            if ci == background_label:
+                continue
+            bs, ds = one_class(b[bi], s[bi, ci])
+            keep = ds > post_threshold
+            rows.append(jnp.concatenate(
+                [jnp.full((bs.shape[0], 1), ci, jnp.float32),
+                 jnp.where(keep, ds, -1.0)[:, None],
+                 jnp.where(keep[:, None], bs, -1.0)], axis=1))
+        allr = jnp.concatenate(rows, axis=0)
+        order = jnp.argsort(-allr[:, 1])
+        k = allr.shape[0] if keep_top_k < 0 else min(int(keep_top_k),
+                                                     allr.shape[0])
+        top = allr[order[:k]]
+        cnts.append(jnp.sum((top[:, 1] > 0).astype(jnp.int32)))
+        outs.append(top)
+    return jnp.stack(outs), jnp.stack(cnts)
+
+
+@defop(differentiable=False)
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive RoI pooling (reference op `psroi_pool`,
+    `phi/kernels/gpu/psroi_pool_kernel.cu`): channel block (i, j) of
+    the output grid average-pools its own C/(k*k) input channels over
+    the (i, j) spatial bin."""
+    oh, ow = (output_size if isinstance(output_size, (list, tuple))
+              else (output_size, output_size))
+    x = jnp.asarray(x, jnp.float32)
+    rois = jnp.asarray(boxes, jnp.float32)
+    n, c, h, w = x.shape
+    out_c = c // (oh * ow)
+    nb = np.asarray(boxes_num).astype(np.int64)
+    batch_of = np.repeat(np.arange(nb.shape[0]), nb)
+
+    def pool_one(roi, img):
+        x1 = roi[0] * spatial_scale
+        y1 = roi[1] * spatial_scale
+        x2 = roi[2] * spatial_scale
+        y2 = roi[3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / ow, rh / oh
+        # mask-based average per bin: differentiable-free gather of the
+        # whole feature map with per-bin membership weights
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        out = []
+        for i in range(oh):
+            for j in range(ow):
+                hs = jnp.floor(y1 + i * bin_h)
+                he = jnp.ceil(y1 + (i + 1) * bin_h)
+                ws_ = jnp.floor(x1 + j * bin_w)
+                we = jnp.ceil(x1 + (j + 1) * bin_w)
+                mask = ((ys[:, None] >= hs) & (ys[:, None] < he)
+                        & (xs[None, :] >= ws_) & (xs[None, :] < we))
+                cnt = jnp.maximum(jnp.sum(mask), 1)
+                chans = img[(i * ow + j) * out_c:(i * ow + j + 1) * out_c]
+                out.append(jnp.sum(chans * mask[None], axis=(1, 2)) / cnt)
+        return jnp.stack(out, axis=0).reshape(oh, ow, out_c) \
+            .transpose(2, 0, 1)
+
+    return jnp.stack([pool_one(rois[r], x[batch_of[r]])
+                      for r in range(rois.shape[0])])
+
+
+@defop(differentiable=False)
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False):
+    """RPN proposal generation (reference op `generate_proposals`,
+    `phi/kernels/gpu/generate_proposals_kernel.cu`): decode anchor
+    deltas, clip to image, filter small boxes, NMS, keep top-N. Single
+    image ([1, ...] inputs); returns (rois [post_nms_top_n, 4],
+    roi_scores, count) padded with zeros."""
+    sc = jnp.asarray(scores, jnp.float32)[0]        # [A, H, W]
+    bd = jnp.asarray(bbox_deltas, jnp.float32)[0]   # [A*4, H, W]
+    a, h, w = sc.shape
+    anc = jnp.asarray(anchors, jnp.float32).reshape(-1, 4)
+    var = jnp.asarray(variances, jnp.float32).reshape(-1, 4)
+    s_flat = sc.transpose(1, 2, 0).reshape(-1)
+    d = bd.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+    off = 1.0 if pixel_offset else 0.0
+    aw = anc[:, 2] - anc[:, 0] + off
+    ah = anc[:, 3] - anc[:, 1] + off
+    acx = anc[:, 0] + aw / 2
+    acy = anc[:, 1] + ah / 2
+    cx = var[:, 0] * d[:, 0] * aw + acx
+    cy = var[:, 1] * d[:, 1] * ah + acy
+    bw = jnp.exp(jnp.minimum(var[:, 2] * d[:, 2], 10.0)) * aw
+    bh = jnp.exp(jnp.minimum(var[:, 3] * d[:, 3], 10.0)) * ah
+    props = jnp.stack([cx - bw / 2, cy - bh / 2,
+                       cx + bw / 2 - off, cy + bh / 2 - off], axis=1)
+    ih, iw = (jnp.asarray(img_size, jnp.float32).reshape(-1)[0],
+              jnp.asarray(img_size, jnp.float32).reshape(-1)[1])
+    props = jnp.stack([jnp.clip(props[:, 0], 0, iw - off),
+                       jnp.clip(props[:, 1], 0, ih - off),
+                       jnp.clip(props[:, 2], 0, iw - off),
+                       jnp.clip(props[:, 3], 0, ih - off)], axis=1)
+    pw = props[:, 2] - props[:, 0] + off
+    ph = props[:, 3] - props[:, 1] + off
+    ok = (pw >= min_size) & (ph >= min_size)
+    s_flat = jnp.where(ok, s_flat, -1e10)
+    top = min(int(pre_nms_top_n), s_flat.shape[0])
+    order = jnp.argsort(-s_flat)[:top]
+    props, s_top = props[order], s_flat[order]
+    keep = _nms_kept_mask(props, nms_thresh)
+    s_kept = jnp.where(keep & (s_top > -1e9), s_top, -1e10)
+    order2 = jnp.argsort(-s_kept)[:int(post_nms_top_n)]
+    rois = props[order2]
+    rs = s_kept[order2]
+    count = jnp.sum((rs > -1e9).astype(jnp.int32))
+    valid = (rs > -1e9)[:, None]
+    return jnp.where(valid, rois, 0.0), jnp.where(valid[:, 0], rs, 0.0), \
+        count
