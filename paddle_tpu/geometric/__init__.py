@@ -115,3 +115,11 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
         return combine(xa[s], ya[d])
 
     return run_op("send_uv", fn, (x, y, src_index, dst_index))
+
+
+def segment_pool(data, segment_ids, pool_type="sum", name=None):
+    """Legacy unified segment op (reference op `segment_pool`):
+    dispatches to segment_{sum,mean,max,min}."""
+    fn = {"sum": segment_sum, "mean": segment_mean, "max": segment_max,
+          "min": segment_min}[pool_type.lower()]
+    return fn(data, segment_ids)

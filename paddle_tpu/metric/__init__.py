@@ -175,3 +175,22 @@ class Auc(Metric):
         tpr = tp / tot_pos
         fpr = fp / tot_neg
         return float(np.trapezoid(tpr, fpr))
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy of a prediction batch (reference op `accuracy`,
+    `phi/kernels/gpu/accuracy_kernel.cu`): input [N, C] scores, label
+    [N, 1] or [N]; returns a 0-d fraction tensor."""
+    import jax.numpy as jnp
+
+    from ..framework.tensor import run_op
+
+    kk = int(k)
+
+    def fn(inp, lbl):
+        topk = jnp.argsort(-inp, axis=1)[:, :kk]
+        lbl = lbl.reshape(-1, 1)
+        hit = jnp.any(topk == lbl, axis=1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return run_op("accuracy", fn, (input, label), differentiable=False)

@@ -19,8 +19,8 @@ __all__ = [
     "pixel_unshuffle", "channel_shuffle", "unfold", "fold", "one_hot",
     "grid_sample", "affine_grid", "linear_interp", "bilinear_interp",
     "nearest_interp", "bicubic_interp", "trilinear_interp",
-    "class_center_sample",
-]
+    "class_center_sample", "pad3d", "fused_softmax_mask",
+    "fused_softmax_mask_upper_triangle"]
 
 
 @defop()
@@ -484,3 +484,40 @@ def class_center_sample(label, num_classes, num_samples, group=None,
     remap[sampled] = _np.arange(len(sampled))
     return (_T(jnp.asarray(remap[lbl])),
             _T(jnp.asarray(sampled.astype(_np.int64))))
+
+
+@defop()
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    """5-D padding (reference op `pad3d`,
+    `phi/kernels/gpu/pad3d_kernel.cu`). ``paddings`` is
+    (left, right, top, bottom, front, back) on the spatial dims."""
+    pl, pr, pt, pb, pf, pbk = (int(p) for p in paddings)
+    if data_format == "NCDHW":
+        cfg = ((0, 0), (0, 0), (pf, pbk), (pt, pb), (pl, pr))
+    else:
+        cfg = ((0, 0), (pf, pbk), (pt, pb), (pl, pr), (0, 0))
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@defop()
+def fused_softmax_mask(x, mask):
+    """softmax(x + mask) in one op (reference fused op
+    `fused_softmax_mask`, `phi/kernels/fusion/gpu/`) — XLA fuses the
+    add into the softmax; the op exists for API parity."""
+    return jax.nn.softmax(x.astype(jnp.float32) + mask.astype(jnp.float32),
+                          axis=-1).astype(x.dtype)
+
+
+@defop()
+def fused_softmax_mask_upper_triangle(x):
+    """Causal-masked softmax (reference
+    `fused_softmax_mask_upper_triangle`): positions above the diagonal
+    are -inf before the softmax."""
+    s = x.shape[-1]
+    mask = jnp.triu(jnp.full((s, s), -1e9, jnp.float32), k=1)
+    return jax.nn.softmax(x.astype(jnp.float32) + mask, axis=-1) \
+        .astype(x.dtype)

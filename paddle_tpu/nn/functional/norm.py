@@ -16,7 +16,7 @@ from ...tensor.registry import defop
 from ...framework.tensor import Tensor, run_op, no_grad
 
 __all__ = ["layer_norm", "rms_norm", "batch_norm", "instance_norm",
-           "group_norm", "local_response_norm"]
+           "group_norm", "local_response_norm", "spectral_norm"]
 
 
 @defop()
@@ -177,3 +177,21 @@ def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0,
                                 tuple(window), (1,) * x.ndim, "VALID")
     # reference normalizes by the window *mean* (avg_pool), not the sum
     return x / jnp.power(k + alpha * acc / size, beta)
+
+
+@defop()
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12):
+    """Normalize ``weight`` by its largest singular value, estimated by
+    power iteration (reference op `spectral_norm`,
+    `phi/kernels/impl/spectral_norm_kernel_impl.h`)."""
+    w = jnp.moveaxis(weight, int(dim), 0)
+    mat = w.reshape(w.shape[0], -1)
+    u = jnp.ones((mat.shape[0],), mat.dtype)
+    v = jnp.ones((mat.shape[1],), mat.dtype)
+    for _ in range(max(int(power_iters), 1)):
+        v = mat.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+        u = mat @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    sigma = u @ mat @ v
+    return weight / jnp.maximum(sigma, eps)

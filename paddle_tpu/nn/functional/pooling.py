@@ -17,7 +17,7 @@ __all__ = ["max_pool1d", "max_pool2d", "max_pool3d",
            "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
            "max_pool2d_with_index", "max_pool3d_with_index",
            "fractional_max_pool2d", "fractional_max_pool3d",
-           "max_unpool1d", "max_unpool2d", "max_unpool3d"]
+           "max_unpool1d", "max_unpool2d", "max_unpool3d", "pool2d", "pool3d"]
 
 
 def _tuple(v, n):
@@ -442,3 +442,44 @@ def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
     p = _tuple(padding, 1)
     return _unpool(x, indices,
                    _unpool_out_size(x.shape[2:], k, st, p, output_size))
+
+
+@defop()
+def pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+           exclusive=True, data_format="NCHW", pooling_type="max",
+           global_pooling=False, adaptive=False):
+    """Legacy unified pooling op (reference legacy op `pool2d`)."""
+    if global_pooling:
+        kernel_size = x.shape[2:] if data_format == "NCHW" else x.shape[1:3]
+        stride, padding = kernel_size, 0
+    if adaptive:
+        fn = (adaptive_max_pool2d if pooling_type == "max"
+              else adaptive_avg_pool2d)
+        out = fn(x, kernel_size, data_format=data_format)
+        return getattr(out, "_data", out)
+    if pooling_type == "max":
+        return _max_pool(x, kernel_size, stride, padding, 2, data_format,
+                         ceil_mode)
+    return _avg_pool(x, kernel_size, stride, padding, 2, data_format,
+                     exclusive, ceil_mode)
+
+
+@defop()
+def pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+           exclusive=True, data_format="NCDHW", pooling_type="max",
+           global_pooling=False, adaptive=False):
+    """Legacy unified pooling op (reference legacy op `pool3d`)."""
+    if global_pooling:
+        kernel_size = x.shape[2:] if data_format == "NCDHW" \
+            else x.shape[1:4]
+        stride, padding = kernel_size, 0
+    if adaptive:
+        fn = (adaptive_max_pool3d if pooling_type == "max"
+              else adaptive_avg_pool3d)
+        out = fn(x, kernel_size, data_format=data_format)
+        return getattr(out, "_data", out)
+    if pooling_type == "max":
+        return _max_pool(x, kernel_size, stride, padding, 3, data_format,
+                         ceil_mode)
+    return _avg_pool(x, kernel_size, stride, padding, 3, data_format,
+                     exclusive, ceil_mode)

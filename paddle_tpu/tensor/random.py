@@ -20,6 +20,7 @@ __all__ = [
     "standard_normal", "gaussian", "randperm", "bernoulli", "multinomial",
     "poisson", "exponential_", "uniform_", "normal_", "shuffle", "binomial",
     "log_normal", "standard_gamma",
+    "truncated_gaussian_random", "dirichlet",
 ]
 
 
@@ -174,3 +175,35 @@ def shuffle(x, name=None):
     perm = jax.random.permutation(key, x.shape[0])
     from . import manipulation
     return manipulation.index_select(x, Tensor(perm), axis=0)
+
+
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, dtype=None, a=-2.0,
+                              b=2.0, name=None):
+    """Gaussian truncated to [a, b] std units (reference op
+    `truncated_gaussian_random` — the TruncatedNormal initializer's
+    kernel)."""
+    import jax
+
+    key = framework_random.next_key()
+
+    def fn(key):
+        z = jax.random.truncated_normal(key, a, b, _shape(shape))
+        return (z * std + mean).astype(_dt(dtype))
+
+    return run_op("truncated_gaussian_random", fn, (key,),
+                  differentiable=False)
+
+
+def dirichlet(alpha, name=None):
+    """Sample from Dirichlet(alpha) (reference op `dirichlet`,
+    `phi/kernels/gpu/dirichlet_kernel.cu`): normalized standard-gamma
+    draws along the last axis."""
+    import jax
+
+    key = framework_random.next_key()
+
+    def fn(alpha, key):
+        g = jax.random.gamma(key, alpha)
+        return g / jnp.sum(g, axis=-1, keepdims=True)
+
+    return run_op("dirichlet", fn, (alpha, key), differentiable=False)

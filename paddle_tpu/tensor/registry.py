@@ -66,3 +66,17 @@ def attach_tensor_method(name, fn):
     ``python/paddle/base/dygraph/math_op_patch.py`` monkey-patching)."""
     if getattr(fn, "__self_is_first_arg__", True):
         setattr(Tensor, name, fn)
+
+
+def register_existing(fn, name, differentiable=True):
+    """Inventory an EXISTING public function as a schema op.
+
+    Some reference ops (`concat`, `topk`, creation/random ops, ...) are
+    implemented here as plain functions wrapping ``run_op`` directly —
+    variadic inputs or eager RNG handling don't fit the ``@defop``
+    template. They are still ops of the framework; this records them in
+    ``OPS`` (and therefore in ops.yaml and ``_C_ops``) with the public
+    function as the dispatch target."""
+    OPS[name] = {"fn": fn, "wrapper": fn, "differentiable": differentiable,
+                 "method": None, "inplace": None, "module": fn.__module__}
+    return fn

@@ -18,7 +18,7 @@ from ..framework.tensor import run_op
 __all__ = ["nms", "roi_align", "roi_pool", "box_iou", "deform_conv2d",
            "DeformConv2D", "box_coder", "prior_box", "yolo_box",
            "matrix_nms", "psroi_pool", "distribute_fpn_proposals",
-           "generate_proposals"]
+           "generate_proposals", "multiclass_nms3", "read_file", "decode_jpeg"]
 
 
 def _iou_matrix(boxes):
@@ -583,6 +583,8 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
                 [jnp.full((bs.shape[0], 1), ci, jnp.float32),
                  jnp.where(keep, ds, -1.0)[:, None],
                  jnp.where(keep[:, None], bs, -1.0)], axis=1))
+        if not rows:  # every class was the background class
+            rows = [jnp.full((1, 6), -1.0, jnp.float32)]
         allr = jnp.concatenate(rows, axis=0)
         order = jnp.argsort(-allr[:, 1])
         k = allr.shape[0] if keep_top_k < 0 else min(int(keep_top_k),
@@ -689,3 +691,74 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     valid = (rs > -1e9)[:, None]
     return jnp.where(valid, rois, 0.0), jnp.where(valid[:, 0], rs, 0.0), \
         count
+
+
+@defop(differentiable=False)
+def multiclass_nms3(bboxes, scores, score_threshold=0.05, nms_top_k=-1,
+                    keep_top_k=100, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=-1, rois_num=None):
+    """Per-class greedy NMS + cross-class top-k (reference op
+    `multiclass_nms3`, `phi/kernels/funcs/detection/nms_util.h`).
+    bboxes [N, M, 4], scores [N, C, M]; returns ([N, keep_top_k, 6]
+    rows (class, score, box) padded with -1, kept counts [N])."""
+    b = jnp.asarray(bboxes, jnp.float32)
+    s = jnp.asarray(scores, jnp.float32)
+    n, c, m = s.shape
+    top_k = m if nms_top_k < 0 else min(int(nms_top_k), m)
+    outs, cnts = [], []
+    for bi in range(n):
+        rows = []
+        for ci in range(c):
+            if ci == background_label:
+                continue
+            sc = s[bi, ci]
+            order = jnp.argsort(-sc)[:top_k]
+            bs, ss = b[bi][order], sc[order]
+            keep = _nms_kept_mask(bs, nms_threshold) \
+                & (ss > score_threshold)
+            rows.append(jnp.concatenate(
+                [jnp.full((top_k, 1), ci, jnp.float32),
+                 jnp.where(keep, ss, -1.0)[:, None],
+                 jnp.where(keep[:, None], bs, -1.0)], axis=1))
+        if not rows:  # every class was the background class
+            rows = [jnp.full((1, 6), -1.0, jnp.float32)]
+        allr = jnp.concatenate(rows, axis=0)
+        order = jnp.argsort(-allr[:, 1])
+        k = allr.shape[0] if keep_top_k < 0 else min(int(keep_top_k),
+                                                     allr.shape[0])
+        top = allr[order[:k]]
+        cnts.append(jnp.sum((top[:, 1] > 0).astype(jnp.int32)))
+        outs.append(top)
+    return jnp.stack(outs), jnp.stack(cnts)
+
+
+@defop(differentiable=False)
+def read_file(filename):
+    """Read a file's bytes as a uint8 tensor (reference op
+    `read_file`)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return jnp.asarray(np.frombuffer(data, np.uint8))
+
+
+@defop(differentiable=False)
+def decode_jpeg(x, mode="unchanged"):
+    """Decode a JPEG byte tensor to CHW uint8 (reference op
+    `decode_jpeg`, `phi/kernels/gpu/decode_jpeg_kernel.cu` — nvjpeg
+    there; PIL on the host here, feeding the device pipeline)."""
+    import io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(x).astype(np.uint8).tobytes())
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)

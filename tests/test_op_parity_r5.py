@@ -369,3 +369,297 @@ class TestPoolingParity:
         out.sum().backward()
         np.testing.assert_allclose(float(xg.grad.sum().numpy()),
                                    out.numpy().size)
+
+
+class TestDetectionOps:
+    def test_box_coder_roundtrip(self):
+        import paddle_tpu.vision.ops as VO
+
+        priors = np.array([[10, 10, 30, 40], [20, 20, 60, 80]], np.float32)
+        targets = np.array([[12, 14, 28, 38], [25, 22, 55, 70]], np.float32)
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = VO.box_coder(_t(priors), var, _t(targets)).numpy()
+        assert enc.shape == (2, 2, 4)
+        dec = VO.box_coder(
+            _t(priors), var,
+            _t(np.stack([enc[0, 0], enc[1, 1]])[:, None, :].repeat(2, 1)),
+            code_type="decode_center_size").numpy()
+        np.testing.assert_allclose(dec[0, 0], targets[0], atol=1e-3)
+        np.testing.assert_allclose(dec[1, 1], targets[1], atol=1e-3)
+
+    def test_prior_box(self):
+        import paddle_tpu.vision.ops as VO
+
+        feat = paddle.zeros([1, 8, 4, 4])
+        img = paddle.zeros([1, 3, 32, 32])
+        b, v = VO.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                            aspect_ratios=[2.0], flip=True, clip=True)
+        # expanded ars [1, 2, 0.5] -> 3 + 1 max-size square = 4 priors
+        assert tuple(b.shape) == (4, 4, 4, 4)
+        bn = b.numpy()
+        assert bn.min() >= 0 and bn.max() <= 1
+        # center prior of cell (0,0): center 4/32, min 8 -> [0, 0.25]
+        np.testing.assert_allclose(bn[0, 0, 0], [0, 0, 0.25, 0.25],
+                                   atol=1e-6)
+
+    def test_yolo_box_zero_logits(self):
+        import paddle_tpu.vision.ops as VO
+
+        x = np.zeros((1, 2 * 7, 2, 2), np.float32)
+        boxes, scores = VO.yolo_box(
+            _t(x), _t(np.array([[64, 64]], np.int64)), [10, 13, 16, 30],
+            2, 0.01, downsample_ratio=32)
+        boxes, scores = boxes.numpy(), scores.numpy()
+        # sigmoid(0)=0.5: cx = 0.5/2*64 = 16, w = anchor0 = 10 -> x1=11
+        np.testing.assert_allclose(boxes[0, 0, 0], 11.0, atol=1e-4)
+        np.testing.assert_allclose(boxes[0, 0, 2], 21.0, atol=1e-4)
+        np.testing.assert_allclose(scores[0, 0], [0.25, 0.25], atol=1e-5)
+
+    def test_matrix_nms_decay(self):
+        import paddle_tpu.vision.ops as VO
+
+        bb = np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                        [20, 20, 30, 30]]], np.float32)
+        ss = np.zeros((1, 2, 3), np.float32)
+        ss[0, 1] = [0.9, 0.8, 0.7]
+        out, cnt = VO.matrix_nms(_t(bb), _t(ss), 0.1, background_label=0)
+        o = out.numpy()[0]
+        np.testing.assert_allclose(o[0, 1], 0.9, atol=1e-6)
+        np.testing.assert_allclose(o[1, 1], 0.7, atol=1e-6)
+        assert o[2, 1] < 1e-5          # exact duplicate fully decayed
+
+    def test_multiclass_nms3(self):
+        import paddle_tpu.vision.ops as VO
+
+        bb = np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                        [20, 20, 30, 30]]], np.float32)
+        ss = np.zeros((1, 2, 3), np.float32)
+        ss[0, 1] = [0.9, 0.8, 0.7]
+        out, cnt = VO.multiclass_nms3(_t(bb), _t(ss), 0.05,
+                                      nms_threshold=0.5,
+                                      background_label=0)
+        o = out.numpy()[0]
+        assert int(cnt.numpy()[0]) == 2
+        np.testing.assert_allclose(o[0, 1], 0.9, atol=1e-6)
+        np.testing.assert_allclose(o[1, 1], 0.7, atol=1e-6)
+
+    def test_distribute_fpn_proposals(self):
+        import paddle_tpu.vision.ops as VO
+
+        rois = np.array([[0, 0, 10, 10], [0, 0, 224, 224],
+                         [0, 0, 500, 500]], np.float32)
+        out = VO.distribute_fpn_proposals(_t(rois), 2, 5, 4, 224)
+        counts = [int(c) for c in out[5:]]
+        assert counts == [1, 0, 1, 1]
+
+    def test_psroi_pool_constant_channels(self):
+        import paddle_tpu.vision.ops as VO
+
+        x = np.zeros((1, 8, 6, 6), np.float32)
+        for ch in range(8):
+            x[0, ch] = ch
+        out = VO.psroi_pool(_t(x), _t(np.array([[0, 0, 6, 6]], np.float32)),
+                            _t(np.array([1])), 2, 1.0).numpy()
+        np.testing.assert_allclose(out[0, 0].reshape(-1), [0, 2, 4, 6])
+        np.testing.assert_allclose(out[0, 1].reshape(-1), [1, 3, 5, 7])
+
+    def test_generate_proposals_smoke(self):
+        import paddle_tpu.vision.ops as VO
+
+        rng = np.random.RandomState(0)
+        sc = rng.rand(1, 3, 4, 4).astype(np.float32)
+        bd = rng.randn(1, 12, 4, 4).astype(np.float32) * 0.1
+        anchors = rng.rand(4, 4, 3, 4).astype(np.float32) * 20
+        anchors[..., 2:] += 30
+        vv = np.ones((4, 4, 3, 4), np.float32)
+        rois, rsc, cnt = VO.generate_proposals(
+            _t(sc), _t(bd), _t(np.array([32.0, 32.0])), _t(anchors),
+            _t(vv), pre_nms_top_n=20, post_nms_top_n=5, min_size=1.0)
+        assert tuple(rois.shape) == (5, 4) and int(cnt) >= 1
+
+
+class TestLossAndTextOps:
+    def test_huber_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 5).astype(np.float32)
+        y = rng.randn(4, 5).astype(np.float32)
+        want = TF.huber_loss(torch.tensor(x), torch.tensor(y),
+                             delta=0.7).numpy()
+        got = F.huber_loss(_t(x), _t(y), delta=0.7).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_hsigmoid_partition_of_unity(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(0)
+        C, D, N = 6, 4, 3
+        w = rng.randn(C - 1, D).astype(np.float32)
+        b = rng.randn(C - 1).astype(np.float32)
+        feats = rng.randn(N, D).astype(np.float32)
+        tot = np.zeros(N)
+        for c in range(C):
+            cost = F.hsigmoid_loss(_t(feats), _t(np.full((N,), c)), C,
+                                   _t(w), _t(b)).numpy()
+            tot += np.exp(-cost[:, 0])
+        np.testing.assert_allclose(tot, 1.0, atol=1e-4)
+
+    def test_edit_distance_vs_python_dp(self):
+        import paddle_tpu.nn.functional as F
+
+        def py_edit(a, b):
+            dp = list(range(len(b) + 1))
+            for i, ca in enumerate(a, 1):
+                prev, dp[0] = dp[0], i
+                for j, cb in enumerate(b, 1):
+                    prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
+                                             prev + (ca != cb))
+            return dp[-1]
+
+        rng = np.random.RandomState(0)
+        hyp = rng.randint(0, 5, (3, 8)).astype(np.int64)
+        ref = rng.randint(0, 5, (3, 6)).astype(np.int64)
+        hl = np.array([8, 5, 3])
+        rl = np.array([6, 6, 2])
+        d, n = F.edit_distance(_t(hyp), _t(ref), normalized=False,
+                               input_length=_t(hl), label_length=_t(rl))
+        want = [py_edit(hyp[i][:hl[i]].tolist(), ref[i][:rl[i]].tolist())
+                for i in range(3)]
+        np.testing.assert_allclose(d.numpy().reshape(-1), want)
+        d2, _ = F.edit_distance(_t(hyp), _t(ref), normalized=False,
+                                ignored_tokens=[0], input_length=_t(hl),
+                                label_length=_t(rl))
+        want2 = [py_edit([t for t in hyp[i][:hl[i]].tolist() if t != 0],
+                         [t for t in ref[i][:rl[i]].tolist() if t != 0])
+                 for i in range(3)]
+        np.testing.assert_allclose(d2.numpy().reshape(-1), want2)
+
+    def test_viterbi_matches_brute_force(self):
+        import itertools
+
+        import paddle_tpu.text as T
+
+        rng = np.random.RandomState(0)
+        pot = rng.randn(2, 5, 4).astype(np.float32)
+        trans = rng.randn(4, 4).astype(np.float32)
+        lens = np.array([5, 3], np.int64)
+        sc, path = T.viterbi_decode(_t(pot), _t(trans), _t(lens),
+                                    include_bos_eos_tag=False)
+
+        def brute(p, t, L):
+            best, bs = None, -1e9
+            for seq in itertools.product(range(4), repeat=L):
+                s = p[0][seq[0]] + sum(t[seq[i - 1]][seq[i]] + p[i][seq[i]]
+                                       for i in range(1, L))
+                if s > bs:
+                    bs, best = s, seq
+            return bs, list(best)
+
+        for i, L in enumerate([5, 3]):
+            bs, bseq = brute(pot[i], trans, L)
+            assert abs(float(sc.numpy()[i]) - bs) < 1e-4
+            assert path.numpy()[i][:L].tolist() == bseq
+
+    def test_class_center_sample(self):
+        import paddle_tpu.nn.functional as F
+
+        lbl = np.array([3, 7, 3, 1], np.int64)
+        rem, centers = F.class_center_sample(_t(lbl), 20, 6)
+        cn, rn = centers.numpy(), rem.numpy()
+        assert set([1, 3, 7]).issubset(set(cn.tolist())) and len(cn) == 6
+        np.testing.assert_array_equal(cn[rn], lbl)
+
+
+class TestFinalWave:
+    def test_pad3d_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        import paddle_tpu.nn.functional as F
+
+        x = np.random.RandomState(0).randn(1, 2, 3, 4, 5) \
+            .astype(np.float32)
+        for mode, tmode in [("constant", "constant"), ("reflect", "reflect"),
+                            ("replicate", "replicate"),
+                            ("circular", "circular")]:
+            want = TF.pad(torch.tensor(x), (1, 2, 1, 0, 1, 1),
+                          mode=tmode).numpy()
+            got = F.pad3d(_t(x), (1, 2, 1, 0, 1, 1), mode=mode).numpy()
+            np.testing.assert_allclose(got, want)
+
+    def test_spectral_norm_unit_sigma(self):
+        import paddle_tpu.nn.functional as F
+
+        w = np.random.RandomState(0).randn(6, 8).astype(np.float32)
+        sn = F.spectral_norm(_t(w), power_iters=50).numpy()
+        np.testing.assert_allclose(
+            np.linalg.svd(sn, compute_uv=False)[0], 1.0, atol=1e-3)
+
+    def test_weight_only_quant_ops(self):
+        import paddle_tpu.quantization as Q
+
+        rng = np.random.RandomState(0)
+        w = rng.randn(6, 8).astype(np.float32)
+        wq, sc = Q.weight_quantize(_t(w))
+        assert wq.numpy().dtype == np.int8
+        wd = Q.weight_dequantize(wq, sc).numpy()
+        assert np.abs(wd - w).max() < np.abs(w).max() / 100
+        x = rng.randn(3, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            Q.weight_only_linear(_t(x), wq, weight_scale=sc).numpy(),
+            x @ wd, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            Q.llm_int8_linear(_t(x), wq, weight_scale=sc).numpy(),
+            x @ wd, rtol=1e-4, atol=1e-4)
+
+    def test_decode_jpeg_roundtrip(self):
+        import paddle_tpu.vision.ops as VO
+        from PIL import Image
+
+        arr = (np.random.RandomState(0).rand(8, 8, 3) * 255) \
+            .astype(np.uint8)
+        Image.fromarray(arr).save("/tmp/_op_parity.jpg")
+        dec = VO.decode_jpeg(VO.read_file("/tmp/_op_parity.jpg")).numpy()
+        assert dec.shape == (3, 8, 8) and dec.dtype == np.uint8
+
+    def test_fill_and_random_ops(self):
+        f = paddle.zeros([2, 2])
+        f.fill_(3.0)
+        assert (f.numpy() == 3).all()
+        t = paddle.tensor.random.truncated_gaussian_random([10000],
+                                                           std=1.0)
+        assert np.abs(t.numpy()).max() <= 2.0 + 1e-5
+        dd = paddle.tensor.random.dirichlet(
+            _t(np.ones((5, 3), np.float32)))
+        np.testing.assert_allclose(dd.numpy().sum(-1), 1.0, rtol=1e-5)
+
+    def test_fused_softmax_masks(self):
+        import torch
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 2, 4, 4).astype(np.float32)
+        m = rng.randn(2, 2, 4, 4).astype(np.float32)
+        want = torch.softmax(
+            torch.tensor(x) + torch.triu(torch.full((4, 4), -1e9), 1),
+            -1).numpy()
+        np.testing.assert_allclose(
+            F.fused_softmax_mask_upper_triangle(_t(x)).numpy(), want,
+            atol=1e-5)
+        np.testing.assert_allclose(
+            F.fused_softmax_mask(_t(x), _t(m)).numpy(),
+            torch.softmax(torch.tensor(x + m), -1).numpy(), atol=1e-5)
+
+    def test_accuracy_and_segment_pool(self):
+        import paddle_tpu.geometric as G
+        import paddle_tpu.metric as M
+
+        inp = _t(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+        got = float(M.accuracy(inp, _t(np.array([[1], [1]])), k=1).numpy())
+        np.testing.assert_allclose(got, 0.5)
+        d = _t(np.array([[1.0, 2], [3, 4], [5, 6]], np.float32))
+        np.testing.assert_allclose(
+            G.segment_pool(d, _t(np.array([0, 0, 1])), "mean").numpy(),
+            [[2, 3], [5, 6]])
