@@ -32,8 +32,8 @@ from ... import nn
 from ...framework.tensor import Parameter, Tensor, run_op
 from ...framework import random as frandom
 
-__all__ = ["MoELayer", "top_k_gating", "NaiveGate", "GShardGate",
-           "SwitchGate"]
+__all__ = ["MoELayer", "top_k_gating", "top_k_routing", "NaiveGate",
+           "GShardGate", "SwitchGate"]
 
 
 def top_k_gating(logits, k, capacity, normalize=True):
@@ -69,6 +69,54 @@ def top_k_gating(logits, k, capacity, normalize=True):
         dispatch = dispatch + mask
         combine = combine + topv[:, j][:, None, None] * mask
     return dispatch, combine, aux
+
+
+def top_k_routing(logits, k, capacity, normalize=True):
+    """Sort-based (ragged) routing — the scalable replacement for the
+    dense one-hot masks (reference semantics:
+    `fluid/operators/collective/global_scatter_op.cu.cc` — index-based
+    dispatch). Cost is O(Nk log Nk) sort + O(E*C) scatter instead of the
+    dense O(N*E*C) mask build, so it survives DeepSeekMoE-class expert
+    counts.
+
+    Slot assignment mirrors the dense path bit-for-bit: entries are laid
+    out k-major (all first choices, then all second choices, token order
+    within each), and the stable sort by expert preserves that order, so
+    capacity overflow drops the same tokens.
+
+    Returns (slot_token [E*C] int32 (-1 = empty slot),
+             expert_of [N, k], pos_of [N, k], keep [N, k],
+             weights [N, k], aux_loss).
+    """
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)                 # [N, k]
+    if normalize:
+        topv = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-9)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(topi[:, 0], e, dtype=jnp.float32), axis=0)
+    aux = e * jnp.sum(me * ce)
+
+    nk = n * k
+    flat_expert = topi.T.reshape(-1)                     # k-major [nk]
+    flat_token = jnp.tile(jnp.arange(n, dtype=jnp.int32), k)
+    order = jnp.argsort(flat_expert, stable=True)
+    se = flat_expert[order]
+    st = flat_token[order]
+    # position within each expert's contiguous group
+    group_start = jnp.searchsorted(se, jnp.arange(e), side="left")
+    pos_sorted = jnp.arange(nk, dtype=jnp.int32) - group_start[se]
+    keep_sorted = pos_sorted < capacity
+    buf_idx = se * capacity + jnp.clip(pos_sorted, 0, capacity - 1)
+    buf_idx = jnp.where(keep_sorted, buf_idx, e * capacity)  # OOB -> drop
+    slot_token = jnp.full((e * capacity,), -1, jnp.int32) \
+        .at[buf_idx].set(st, mode="drop")
+    # un-sort pos/keep back to [N, k] for the combine gather
+    pos_flat = jnp.zeros((nk,), jnp.int32).at[order].set(pos_sorted)
+    keep_flat = jnp.zeros((nk,), bool).at[order].set(keep_sorted)
+    pos_of = pos_flat.reshape(k, n).T
+    keep = keep_flat.reshape(k, n).T
+    return slot_token, topi, pos_of, keep, topv, aux
 
 
 class _Gate:
@@ -112,8 +160,11 @@ class MoELayer(nn.Layer):
 
     def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
                  top_k=None, capacity_factor=1.25, mesh=None, ep_axis="ep",
-                 name=None):
+                 dispatch_mode="ragged", name=None):
         super().__init__()
+        if dispatch_mode not in ("ragged", "dense"):
+            raise ValueError("dispatch_mode must be 'ragged' or 'dense'")
+        self.dispatch_mode = dispatch_mode
         self.d_model = d_model
         self.d_hidden = d_hidden
         self.num_experts = num_experts
@@ -160,12 +211,22 @@ class MoELayer(nn.Layer):
     def _build_fn(self, n_tokens):
         k = self.gate.top_k
         cap = self.capacity(n_tokens)
+        e = self.num_experts
         normalize = self.gate.normalize
         constrain = self.mesh is not None
         if constrain:
             disp_sharding = self._expert_sharding(3)
+        ragged = self.dispatch_mode == "ragged"
 
-        def fn(x2d, wg, w1, b1, w2, b2):
+        def expert_ffn(dispatched, w1, b1, w2, b2):
+            h = jax.nn.gelu(
+                jnp.einsum("ecd,edh->ech", dispatched, w1) + b1[:, None, :])
+            eo = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+            if constrain:
+                eo = jax.lax.with_sharding_constraint(eo, disp_sharding)
+            return eo
+
+        def fn_dense(x2d, wg, w1, b1, w2, b2):
             logits = jnp.matmul(x2d.astype(jnp.float32), wg)
             dispatch, combine, aux = top_k_gating(logits, k, cap, normalize)
             dispatch = dispatch.astype(x2d.dtype)
@@ -175,15 +236,32 @@ class MoELayer(nn.Layer):
             if constrain:
                 dispatched = jax.lax.with_sharding_constraint(
                     dispatched, disp_sharding)
-            h = jax.nn.gelu(
-                jnp.einsum("ecd,edh->ech", dispatched, w1) + b1[:, None, :])
-            eo = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
-            if constrain:
-                eo = jax.lax.with_sharding_constraint(eo, disp_sharding)
+            eo = expert_ffn(dispatched, w1, b1, w2, b2)
             out = jnp.einsum("nec,ecd->nd", combine, eo)
             return out, aux
 
-        return fn
+        def fn_ragged(x2d, wg, w1, b1, w2, b2):
+            logits = jnp.matmul(x2d.astype(jnp.float32), wg)
+            slot_token, expert_of, pos_of, keep, weights, aux = \
+                top_k_routing(logits, k, cap, normalize)
+            # dispatch = one gather: slot (e, c) reads its token's row
+            # (empty slots read row 0, zeroed by the mask)
+            slots = slot_token.reshape(e, cap)
+            dispatched = x2d[jnp.maximum(slots, 0)] \
+                * (slots >= 0)[..., None].astype(x2d.dtype)
+            if constrain:
+                dispatched = jax.lax.with_sharding_constraint(
+                    dispatched, disp_sharding)
+            eo = expert_ffn(dispatched, w1, b1, w2, b2)
+            # combine = one gather back: token n reads its k slots
+            flat_eo = eo.reshape(e * cap, -1)
+            idx = expert_of * cap + jnp.clip(pos_of, 0, cap - 1)  # [N, k]
+            picked = flat_eo[idx]                                 # [N,k,D]
+            w = (weights * keep).astype(x2d.dtype)
+            out = jnp.einsum("nk,nkd->nd", w, picked)
+            return out, aux
+
+        return fn_ragged if ragged else fn_dense
 
     def forward(self, x):
         shape = x.shape
