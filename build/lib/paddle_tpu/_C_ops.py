@@ -1,0 +1,47 @@
+"""``paddle_tpu._C_ops`` — the generated op-dispatch surface.
+
+Reference: `python/paddle/_C_ops.py:20` re-exports the pybind functions
+generated from `phi/api/yaml/ops.yaml`. Here the namespace is generated
+at first access from the same single source (`ops/schema/ops.yaml`):
+only ops listed in the schema are reachable, and each resolves to the
+``@defop``-registered autograd-aware wrapper. User code written against
+paddle's private ``_C_ops`` API ports over unchanged.
+"""
+
+from __future__ import annotations
+
+import difflib
+
+_table = None
+
+
+def _build():
+    global _table
+    if _table is not None:
+        return _table
+    from .ops.schema import load_schema, _import_op_surface
+    from .tensor.registry import OPS
+
+    _import_op_surface()   # lazy subpackages (vision/text/...) hold ops too
+    _table = {}
+    for name in load_schema():
+        info = OPS.get(name)
+        if info is not None:
+            _table[name] = info["wrapper"]
+    return _table
+
+
+def __getattr__(name):
+    table = _build()
+    try:
+        return table[name]
+    except KeyError:
+        near = difflib.get_close_matches(name, table, n=3)
+        hint = f" (did you mean {', '.join(near)}?)" if near else ""
+        raise AttributeError(
+            f"_C_ops has no op '{name}'{hint} — ops are generated from "
+            "paddle_tpu/ops/schema/ops.yaml") from None
+
+
+def __dir__():
+    return sorted(_build())

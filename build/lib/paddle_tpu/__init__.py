@@ -1,0 +1,105 @@
+"""paddle_tpu: a TPU-native deep learning framework.
+
+Capability target: PaddlePaddle (reference at `/root/reference`, see
+SURVEY.md). Architecture: JAX/XLA/Pallas compute path, eager define-by-run
+autograd on a jax.vjp tape, trace-compilation to XLA for performance, and
+GSPMD mesh sharding for DP/FSDP/TP/SP/CP/EP parallelism.
+"""
+
+from __future__ import annotations
+
+import os as _os
+
+# Multi-host bootstrap MUST precede any jax call that initializes the XLA
+# backend (importing the framework draws a PRNG key). The launch CLI
+# (`python -m paddle_tpu.distributed.launch`) sets these env vars; plain
+# single-process runs skip this entirely. Reference analog:
+# parallel.py:943 init_parallel_env over TCPStore — here the JAX
+# coordination service.
+_distributed_bootstrapped = False
+if "PADDLE_LOCAL_RANK" in _os.environ:
+    # PADDLE_LOCAL_RANK marks an actual WORKER process (the launch CLI
+    # sets it; set it manually when starting workers by hand). The guard
+    # keeps the launcher parent — and any tool that merely imports the
+    # package on a cluster with PADDLE_* pre-exported — from joining the
+    # coordination service and colliding with the real rank.
+    from ._bootstrap import bootstrap_distributed as _bd
+    _distributed_bootstrapped = _bd()
+
+from . import flags as _flags_mod
+from .flags import set_flags, get_flags  # noqa: F401
+
+from .framework import (  # noqa: F401
+    Tensor, Parameter, to_tensor, no_grad, enable_grad,
+    is_grad_enabled, set_grad_enabled, seed, get_rng_state, set_rng_state,
+    in_dynamic_mode, in_pir_mode, in_dynamic_or_pir_mode,
+)
+from .framework.dtype import (  # noqa: F401
+    dtype, float16, float32, float64, bfloat16,
+    int8, int16, int32, int64, uint8, bool_ as bool8,
+    complex64, complex128,
+    get_default_dtype, set_default_dtype, iinfo, finfo,
+)
+from .framework.dtype import bool_  # noqa: F401
+
+from .tensor import *  # noqa: F401,F403
+from . import tensor  # noqa: F401
+from .tensor import linalg  # noqa: F401  (paddle.linalg namespace)
+
+from .framework import autograd_engine as _engine
+grad = _engine.grad
+
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
+from . import fft  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from . import version  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from .hapi import Model  # noqa: F401,E402
+from .hapi.model_summary import summary, flops  # noqa: F401,E402
+from .hapi import hub  # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402  (paddle.callbacks)
+from . import sysconfig  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401,E402
+from .framework.io import save, load  # noqa: F401,E402
+
+__version__ = "0.1.0"
+
+
+def disable_static(place=None):
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dynamic-first; use paddle_tpu.jit.to_static for "
+        "trace-compilation (the XLA path).")
+
+
+def disable_signal_handler():
+    pass
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()

@@ -1,0 +1,39 @@
+"""Multi-host coordination-service bootstrap (single implementation).
+
+Called from ``paddle_tpu/__init__.py`` at import time (worker processes
+spawned by the launch CLI, marked by ``PADDLE_LOCAL_RANK``) and from
+``distributed.env.init_parallel_env`` (manual bootstrap before any jax
+call). Reference analog: `python/paddle/distributed/parallel.py:943`.
+"""
+
+from __future__ import annotations
+
+import os
+
+_done = False
+
+
+def bootstrap_distributed():
+    """jax.distributed.initialize from the PADDLE_* env. Returns True if
+    the coordination service was joined (idempotent)."""
+    global _done
+    if _done:
+        return True
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    master = os.environ.get("PADDLE_MASTER") \
+        or os.environ.get("PADDLE_CURRENT_ENDPOINT")
+    if n <= 1 or not master:
+        return False
+    import jax
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        # CPU multi-process (the test/simulation path) needs an explicit
+        # cross-process collectives backend; TPU uses the ICI/DCN runtime
+        jax.config.update(
+            "jax_cpu_collectives_implementation",
+            os.environ.get("PADDLE_CPU_COLLECTIVES", "gloo"))
+    jax.distributed.initialize(
+        coordinator_address=master,
+        num_processes=n,
+        process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _done = True
+    return True

@@ -1,0 +1,138 @@
+"""Automatic mixed precision.
+
+Reference: `python/paddle/amp/auto_cast.py:1` (``auto_cast``/``decorate``)
+and `grad_scaler.py:1` (``GradScaler``); op policy data from
+`amp_lists.py`. TPU-native defaults: dtype is **bfloat16** (the MXU's
+native input format — no loss scaling required) and the policy is applied
+at the single eager-dispatch seam (`framework/amp_state.py`) instead of
+being code-generated into every op.
+
+O1: white-list ops (matmul-class) run in bf16, black-list ops in fp32,
+the rest follow their inputs. O2: additionally ``decorate`` casts model
+parameters to bf16 (norm layers stay fp32) and turns on master weights in
+the optimizer (fp32 copies updated by the fp32 step, params re-quantized
+each step — the existing ``multi_precision`` machinery in
+`optimizer/optimizer.py`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..framework import amp_state
+from ..framework.dtype import convert_dtype
+from . import amp_lists
+from .amp_lists import WHITE_LIST, BLACK_LIST, white_list, black_list
+from .grad_scaler import GradScaler, AmpScaler
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate",
+           "GradScaler", "AmpScaler", "is_bfloat16_supported",
+           "is_float16_supported", "WHITE_LIST", "BLACK_LIST"]
+
+
+def is_bfloat16_supported(device=None):
+    return True  # bf16 is native on TPU and emulated losslessly on CPU
+
+
+def is_float16_supported(device=None):
+    import jax
+    return jax.default_backend() == "tpu"
+
+
+class auto_cast:
+    """Context manager (or decorator) enabling autocast inside the region.
+
+    ``auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+    level='O1', dtype='bfloat16')`` — the reference's signature
+    (`amp/auto_cast.py`) with the TPU-first default dtype. Nesting works;
+    ``enable=False`` disables AMP inside an enabled region.
+    """
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        if level not in ("O0", "O1", "O2"):
+            raise ValueError(f"level must be O0/O1/O2, got {level!r}")
+        self._enable = bool(enable) and level != "O0"
+        self._attrs = None
+        if self._enable:
+            dt = np.dtype(convert_dtype(dtype))
+            if dt.name not in ("float16", "bfloat16"):
+                raise ValueError(
+                    f"auto_cast dtype must be float16/bfloat16, got {dtype}")
+            self._attrs = amp_state.AmpAttrs(
+                dt, level,
+                white_list(custom_white_list, custom_black_list),
+                black_list(custom_white_list, custom_black_list))
+        else:
+            # explicit disable: a no-op state shadowing any outer one
+            self._attrs = amp_state.AmpAttrs(
+                np.dtype("float32"), "O0", frozenset(), frozenset())
+
+    def __enter__(self):
+        amp_state.push(self._attrs)
+        return self
+
+    def __exit__(self, *exc):
+        amp_state.pop()
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with self:
+                return fn(*args, **kwargs)
+        return wrapped
+
+
+amp_guard = auto_cast  # legacy alias (reference: base/dygraph/amp/auto_cast)
+
+
+def _norm_like(layer):
+    from ..nn.layer import norm as N
+    keep = (N.LayerNorm, N.RMSNorm, N._BatchNormBase, N.GroupNorm,
+            N._InstanceNormBase, N.LocalResponseNorm)
+    return isinstance(layer, keep)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, **kwargs):
+    """O2 model decoration: cast float params to ``dtype`` in place (norm
+    layers keep fp32 params) and enable optimizer master weights.
+
+    Reference: `python/paddle/amp/auto_cast.py` ``decorate``. Returns
+    (models, optimizers) in the same single-or-list structure it was given.
+    """
+    from ..nn import Layer
+
+    if level not in ("O1", "O2"):
+        raise ValueError(f"decorate level must be O1/O2, got {level!r}")
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    opt_list = () if optimizers is None else (
+        optimizers if isinstance(optimizers, (list, tuple)) else [optimizers])
+
+    if level == "O2":
+        dt = np.dtype(convert_dtype(dtype))
+        for m in model_list:
+            if not isinstance(m, Layer):
+                raise TypeError("decorate expects paddle_tpu.nn.Layer models")
+            for _, sub in m.named_sublayers(include_self=True):
+                if _norm_like(sub):
+                    continue
+                for p in sub._parameters.values():
+                    if p is not None and p.dtype.name == "float32":
+                        p._data = p._data.astype(dt)
+        for o in opt_list:
+            if master_weight is not False:
+                o._multi_precision = True
+
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+amp_decorate = decorate
+
+from . import debugging  # noqa: F401,E402

@@ -1,0 +1,147 @@
+"""AMP debugging tools (reference: `python/paddle/amp/debugging.py` —
+operator stats collection, tensor checking, accuracy comparison).
+
+- ``collect_operator_stats``: context manager counting op executions by
+  dtype through a ``run_op`` observer (the reference instruments the
+  generated eager ops), printed as the reference's four-column table.
+- ``enable_tensor_checker``/``disable_tensor_checker``: the
+  ``FLAGS_check_nan_inf`` switch (the reference's debug-mode checker).
+- ``check_numerics``: count nan/inf in one tensor.
+- ``compare_accuracy``: run a function under two dtypes and report
+  per-output max abs/rel error (the reference's excel workflow, as a
+  returned dict instead of a spreadsheet).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import flags
+from ..framework import tensor as _tensor_mod
+
+__all__ = ["collect_operator_stats", "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics", "compare_accuracy"]
+
+_op_stats = None
+
+
+def _observer(name, out):
+    if _op_stats is None:
+        return
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    for o in outs:
+        dt = str(getattr(o, "dtype", "other"))
+        if "float16" in dt and "b" not in dt:
+            col = "fp16"
+        elif "bfloat16" in dt:
+            col = "bf16"
+        elif "float32" in dt:
+            col = "fp32"
+        else:
+            col = "other"
+        _op_stats[name][col] += 1
+
+
+def enable_operator_stats_collection():
+    """Start counting op calls by output dtype (reference
+    `debugging.py:enable_operator_stats_collection`)."""
+    global _op_stats
+    _op_stats = collections.defaultdict(
+        lambda: {"fp16": 0, "bf16": 0, "fp32": 0, "other": 0})
+    _tensor_mod.op_observers.append(_observer)
+
+
+def disable_operator_stats_collection():
+    """Stop collecting and print the dtype table."""
+    global _op_stats
+    if _op_stats is None:
+        return {}
+    try:
+        _tensor_mod.op_observers.remove(_observer)
+    except ValueError:
+        pass
+    stats, _op_stats = dict(_op_stats), None
+    w = max([len(k) for k in stats] + [8])
+    print("<------------------------------ op list "
+          "------------------------------->")
+    print(f"{'op':<{w}}  {'fp16':>6} {'bf16':>6} {'fp32':>6} {'other':>6}")
+    for name in sorted(stats):
+        s = stats[name]
+        print(f"{name:<{w}}  {s['fp16']:>6} {s['bf16']:>6} {s['fp32']:>6} "
+              f"{s['other']:>6}")
+    print("<----------------------------------- end "
+          "---------------------------------->")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def enable_tensor_checker():
+    """nan/inf checking on every op output (reference debug mode —
+    here the FLAGS_check_nan_inf hook in run_op)."""
+    flags.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_name="", var_name=""):
+    """Returns (num_nan, num_inf) as int tensors-like values; prints a
+    reference-style line when anything is found."""
+    arr = np.asarray(getattr(tensor, "_data", tensor), np.float64)
+    n_nan = int(np.isnan(arr).sum())
+    n_inf = int(np.isinf(arr).sum())
+    if n_nan or n_inf:
+        print(f"[check_numerics] op={op_name} var={var_name} "
+              f"num_nan={n_nan} num_inf={n_inf}")
+    return n_nan, n_inf
+
+
+def compare_accuracy(fn, args, dtypes=("float32", "bfloat16"), atol=None):
+    """Run ``fn(*args)`` once per dtype (inputs cast) and report
+    per-output max-abs / max-rel deltas vs the first dtype."""
+    from ..framework.tensor import Tensor
+
+    def cast_all(dt):
+        out = []
+        for a in args:
+            if isinstance(a, Tensor) and jnp.issubdtype(
+                    a._data.dtype, jnp.floating):
+                out.append(a.astype(dt))
+            else:
+                out.append(a)
+        return out
+
+    results = {}
+    for dt in dtypes:
+        r = fn(*cast_all(dt))
+        results[dt] = [np.asarray(o._data, np.float64)
+                       for o in (r if isinstance(r, (tuple, list))
+                                 else (r,))]
+    base = results[dtypes[0]]
+    report = {}
+    for dt in dtypes[1:]:
+        per_out = []
+        for a, b in zip(base, results[dt]):
+            diff = np.abs(a - b)
+            per_out.append({
+                "max_abs_err": float(diff.max()) if diff.size else 0.0,
+                "max_rel_err": float(
+                    (diff / (np.abs(a) + 1e-12)).max()) if diff.size
+                else 0.0,
+            })
+        report[dt] = per_out
+    return report

@@ -1,0 +1,177 @@
+"""Dynamic loss scaling.
+
+Reference: `python/paddle/amp/grad_scaler.py:1` (``GradScaler``). bf16
+training (the TPU default) does not need loss scaling — construct with
+``enable=False`` or just skip the scaler; this class exists for float16
+parity and for the API surface (`scale`/`unscale_`/`step`/`update`/
+``minimize``).
+
+Trace-compilation note: under ``jit.to_static`` the overflow check is a
+traced value, so a Python ``if`` cannot skip the step. The traced path
+instead masks the update — gradients and the learning rate are multiplied
+by ``0`` on overflow, leaving parameters (and decoupled weight decay)
+bit-exact unchanged; only optimizer moments decay toward zero on the
+skipped step, a documented deviation from the reference's hard skip. The
+scaler's own state (scale, growth tracker) updates with ``jnp.where`` so
+it stays inside the compiled program (expose it to ``to_static`` state
+discovery via ``__state_tensors__``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["GradScaler", "AmpScaler"]
+
+
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = bool(enable)
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._incr_every_n_steps = int(incr_every_n_steps)
+        self._decr_every_n_nan_or_inf = int(decr_every_n_nan_or_inf)
+        self._use_dynamic = bool(use_dynamic_loss_scaling)
+        self._scale = Tensor(jnp.asarray(init_loss_scaling, jnp.float32))
+        self._growth = Tensor(jnp.asarray(0, jnp.int32))
+        self._bad = Tensor(jnp.asarray(0, jnp.int32))
+        self._found_inf = None        # set by unscale_
+        self._unscaled = set()        # optimizers already unscaled this step
+
+    # -- to_static integration ---------------------------------------------
+    def __state_tensors__(self):
+        return [self._scale, self._growth, self._bad]
+
+    # -- API ----------------------------------------------------------------
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._enable and self._use_dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        # in-place payload update: to_static state discovery holds these
+        # Tensor objects by identity, rebinding would silently fork state
+        self._scale._data = jnp.asarray(v, jnp.float32)
+
+    def scale(self, var):
+        """Multiply the loss by the current scale (recorded on the tape)."""
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        """Divide accumulated grads by the scale; record overflow status."""
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale._data
+        found = jnp.asarray(False)
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad._data * inv.astype(p.grad._data.dtype)
+            found = jnp.logical_or(found, ~jnp.isfinite(g).all())
+            p.grad._data = g
+        self._found_inf = found
+        self._unscaled.add(id(optimizer))
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if id(optimizer) not in self._unscaled:
+            self.unscale_(optimizer)
+        found = self._found_inf
+        if not _is_traced(found):
+            if not bool(found):
+                optimizer.step()
+            self._unscaled.discard(id(optimizer))
+            return
+        # traced: mask grads + lr so an overflow step leaves params intact.
+        # select-with-where, NOT multiply — inf * 0 is NaN and would poison
+        # the update the mask exists to suppress
+        ok = 1.0 - found.astype(jnp.float32)
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._data
+                p.grad._data = jnp.where(found, jnp.zeros_like(g), g)
+        prev = optimizer._lr_override
+        base = prev if prev is not None else optimizer.get_lr()
+        optimizer._lr_override = base * ok
+        try:
+            optimizer.step()
+        finally:
+            optimizer._lr_override = prev
+        self._unscaled.discard(id(optimizer))
+
+    def update(self):
+        """Dynamic loss-scale bookkeeping (traceable)."""
+        if not (self._enable and self._use_dynamic):
+            return
+        found = self._found_inf
+        if found is None:
+            return
+        found_i = jnp.asarray(found).astype(jnp.int32)
+        bad = self._bad._data + found_i
+        growth = jnp.where(found_i > 0, 0, self._growth._data + 1)
+        shrink = bad >= self._decr_every_n_nan_or_inf
+        grow = growth >= self._incr_every_n_steps
+        scale = self._scale._data
+        scale = jnp.where(shrink, scale * self._decr_ratio, scale)
+        scale = jnp.where(grow, scale * self._incr_ratio, scale)
+        self._scale._data = jnp.maximum(scale, 1.0)
+        self._growth._data = jnp.where(grow, 0, growth)
+        self._bad._data = jnp.where(shrink, 0, bad)
+        self._found_inf = None
+
+    def minimize(self, optimizer, scaled_loss=None):
+        """unscale -> (maybe) step -> update, the reference's one-shot."""
+        self.step(optimizer)
+        self.update()
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self):
+        if not self._enable:
+            return {}
+        return {
+            "scale": self._scale.numpy(),
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "growth": self._growth.numpy(),
+            "bad": self._bad.numpy(),
+            "use_dynamic_loss_scaling": self._use_dynamic,
+        }
+
+    def load_state_dict(self, state):
+        if not state:
+            return
+        # in-place: see set_init_loss_scaling
+        self._scale._data = jnp.asarray(state["scale"], jnp.float32)
+        self._growth._data = jnp.asarray(state.get("growth", 0), jnp.int32)
+        self._bad._data = jnp.asarray(state.get("bad", 0), jnp.int32)
+        self._incr_ratio = float(state.get("incr_ratio", self._incr_ratio))
+        self._decr_ratio = float(state.get("decr_ratio", self._decr_ratio))
+        self._incr_every_n_steps = int(
+            state.get("incr_every_n_steps", self._incr_every_n_steps))
+        self._decr_every_n_nan_or_inf = int(
+            state.get("decr_every_n_nan_or_inf",
+                      self._decr_every_n_nan_or_inf))
+        self._use_dynamic = bool(
+            state.get("use_dynamic_loss_scaling", self._use_dynamic))
+
+
+AmpScaler = GradScaler  # legacy alias (reference: base/dygraph/amp/loss_scaler)

@@ -1,0 +1,206 @@
+"""``paddle.audio`` — audio feature extraction.
+
+Reference: `python/paddle/audio/` (`functional/window.py`,
+`functional/functional.py` hz<->mel + filterbanks, `features/layers.py`
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC). TPU-native: the STFT
+is framing + window + ``rfft`` (XLA's real DFT); mel projection is one
+matmul riding the MXU. Everything is tape-recorded and differentiable.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..framework.tensor import Tensor, run_op
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "compute_fbank_matrix",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def get_window(window, win_length, fftbins=True):
+    """Reference functional/window.py get_window (dense set)."""
+    n = win_length
+    if window == "hann":
+        w = np.hanning(n + 1)[:-1] if fftbins else np.hanning(n)
+    elif window == "hamming":
+        w = np.hamming(n + 1)[:-1] if fftbins else np.hamming(n)
+    elif window == "blackman":
+        w = np.blackman(n + 1)[:-1] if fftbins else np.blackman(n)
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype("float32"))
+
+
+def hz_to_mel(freq, htk=False):
+    """Reference functional.py hz_to_mel (slaney default)."""
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, out)
+    return float(out) if np.isscalar(freq) else out
+
+
+def mel_to_hz(mel, htk=False):
+    m = np.asarray(mel, np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return float(out) if np.isscalar(mel) else out
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, n_fft//2 + 1] mel filterbank (reference functional.py)."""
+    f_max = f_max or sr / 2
+    n_bins = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2, n_bins)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_bins))
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return Tensor(fb.astype(dtype))
+
+
+class Spectrogram(nn.Layer):
+    """STFT power spectrogram (reference features/layers.py Spectrogram).
+    Input [B, T] -> [B, n_fft//2+1, frames]."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = get_window(window, self.win_length)._data
+        if self.win_length < n_fft:  # center-pad the window to n_fft
+            pad = n_fft - self.win_length
+            w = jnp.pad(w, (pad // 2, pad - pad // 2))
+        self.register_buffer("window", Tensor(w))
+
+    def forward(self, x):
+        n_fft, hop, center, pad_mode, power = (
+            self.n_fft, self.hop, self.center, self.pad_mode, self.power)
+
+        def fn(a, w):
+            if center:
+                a = jnp.pad(a, ((0, 0), (n_fft // 2, n_fft // 2)),
+                            mode=pad_mode)
+            n_frames = 1 + (a.shape[1] - n_fft) // hop
+            idx = (jnp.arange(n_frames)[:, None] * hop
+                   + jnp.arange(n_fft)[None, :])
+            frames = a[:, idx] * w                       # [B, F, n_fft]
+            spec = jnp.fft.rfft(frames, axis=-1)         # [B, F, bins]
+            mag = jnp.abs(spec)
+            if power is not None:
+                mag = mag ** power
+            return jnp.swapaxes(mag, 1, 2)                # [B, bins, F]
+
+        return run_op("spectrogram", fn, (x, self.window))
+
+
+class MelSpectrogram(nn.Layer):
+    """Spectrogram -> mel filterbank (reference MelSpectrogram)."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode)
+        self.register_buffer(
+            "fbank", compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                          htk, norm, dtype))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        return run_op("mel_project",
+                      lambda s, fb: jnp.einsum("mf,bft->bmt", fb, s),
+                      (spec, self.fbank))
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, center, pad_mode, n_mels,
+                                  f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+        amin, ref, top_db = self.amin, self.ref_value, self.top_db
+
+        def fn(a):
+            db = 10.0 * jnp.log10(jnp.maximum(a, amin))
+            db = db - 10.0 * math.log10(max(amin, ref))
+            if top_db is not None:
+                db = jnp.maximum(db, db.max() - top_db)
+            return db
+
+        return run_op("power_to_db", fn, (m,))
+
+
+class MFCC(nn.Layer):
+    """Log-mel -> DCT-II cepstral coefficients (reference MFCC)."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 n_mels=64, f_min=50.0, f_max=None, top_db=None,
+                 dtype="float32", **mel_kwargs):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, n_mels=n_mels,
+            f_min=f_min, f_max=f_max, top_db=top_db, **mel_kwargs)
+        # orthonormal DCT-II basis [n_mfcc, n_mels]
+        k = np.arange(n_mfcc)[:, None]
+        n = np.arange(n_mels)[None, :]
+        basis = np.cos(np.pi / n_mels * (n + 0.5) * k) \
+            * np.sqrt(2.0 / n_mels)
+        basis[0] *= 1.0 / np.sqrt(2.0)
+        self.register_buffer("dct", Tensor(basis.astype(dtype)))
+
+    def forward(self, x):
+        lm = self.log_mel(x)
+        return run_op("mfcc_dct",
+                      lambda a, d: jnp.einsum("km,bmt->bkt", d, a),
+                      (lm, self.dct))

@@ -1,0 +1,10 @@
+"""Autograd public API (reference: `python/paddle/autograd/`)."""
+
+from ..framework.autograd_engine import backward, grad  # noqa: F401
+from ..framework.tensor import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .functional import jacobian, hessian, jvp, vjp  # noqa: F401
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext", "jacobian",
+           "hessian", "jvp", "vjp"]

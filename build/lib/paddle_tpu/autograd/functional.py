@@ -1,0 +1,74 @@
+"""Functional differentiation API (reference: `python/paddle/autograd/autograd.py`
+— jacobian/hessian). Implemented directly on JAX transforms, the idiomatic
+TPU path (forward-over-reverse for hessians etc.)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["jacobian", "hessian", "jvp", "vjp"]
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap(x):
+    if isinstance(x, jax.Array):
+        return Tensor(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    return x
+
+
+def _functionalize(func):
+    def fn(*arrays):
+        tensors = [Tensor(a, stop_gradient=False) for a in arrays]
+        out = func(*tensors)
+        return _unwrap(out)
+    return fn
+
+
+def jacobian(ys_func, xs, batch_axis=None):
+    """``paddle.autograd.jacobian`` — here ``ys_func`` may be a callable over
+    Tensors, or a Tensor already computed (in which case the tape is used)."""
+    if callable(ys_func):
+        arrays = _unwrap(xs) if isinstance(xs, (list, tuple)) else (_unwrap(xs),)
+        jac = jax.jacrev(_functionalize(ys_func), argnums=tuple(range(len(arrays))))(*arrays)
+        return _wrap(jac if len(arrays) > 1 else jac[0])
+    raise TypeError("jacobian expects a callable as first argument")
+
+
+def hessian(func, xs, batch_axis=None):
+    arrays = _unwrap(xs) if isinstance(xs, (list, tuple)) else (_unwrap(xs),)
+    hess = jax.hessian(_functionalize(func), argnums=tuple(range(len(arrays))))(*arrays)
+    return _wrap(hess if len(arrays) > 1 else hess[0][0] if isinstance(hess[0], tuple) else hess[0])
+
+
+def jvp(func, xs, v=None):
+    arrays = tuple(_unwrap(xs)) if isinstance(xs, (list, tuple)) else (_unwrap(xs),)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        tangents = tuple(_unwrap(v)) if isinstance(v, (list, tuple)) else (_unwrap(v),)
+    out, tangent_out = jax.jvp(_functionalize(func), arrays, tangents)
+    return _wrap(out), _wrap(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    arrays = tuple(_unwrap(xs)) if isinstance(xs, (list, tuple)) else (_unwrap(xs),)
+    out, vjp_fn = jax.vjp(_functionalize(func), *arrays)
+    if v is None:
+        cot = jnp.ones_like(out) if not isinstance(out, (tuple, list)) \
+            else tuple(jnp.ones_like(o) for o in out)
+    else:
+        cot = _unwrap(v)
+    grads = vjp_fn(cot)
+    return _wrap(out), _wrap(grads if len(arrays) > 1 else grads[0])

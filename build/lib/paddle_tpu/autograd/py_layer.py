@@ -1,0 +1,113 @@
+"""PyLayer: user-defined autograd ops (reference:
+`python/paddle/autograd/py_layer.py`, C++ side `fluid/eager/pylayer/`).
+
+The custom backward plugs straight into the GradNode tape as a node whose
+vjp is the user's ``backward`` static method.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, GradNode, is_grad_enabled, no_grad
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = args
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)] + \
+                        [v for v in kwargs.values() if isinstance(v, Tensor)]
+        need_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        if not need_grad:
+            return outputs
+
+        is_multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if is_multi else [outputs]
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient
+                       and jnp.issubdtype(t.dtype, jnp.inexact)]
+        out_avals = [(tuple(o.shape), o.dtype) for o in outs]
+
+        def _align(grads, wrap, zeros):
+            """Align user-backward grads with *all* tensor inputs, then select
+            the differentiable ones (paddle: backward returns one grad per
+            input)."""
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            grad_map = {}
+            gi = 0
+            for t in tensor_inputs:
+                if gi < len(grads):
+                    grad_map[id(t)] = grads[gi]
+                    gi += 1
+            return tuple(
+                zeros(t) if grad_map.get(id(t)) is None
+                else wrap(grad_map[id(t)])
+                for t in diff_inputs)
+
+        def vjp_fn(cotangents):
+            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            ct_tensors = [Tensor(c, stop_gradient=True) for c in cts]
+            grads = cls.backward(ctx, *ct_tensors)
+            return _align(
+                grads,
+                wrap=lambda g: g._data if isinstance(g, Tensor) else jnp.asarray(g),
+                zeros=lambda t: jnp.zeros(tuple(t.shape), t.dtype))
+
+        def replay_fn(ct_tensors):
+            """Tensor-level backward for create_graph: runs the user's
+            backward on live Tensors so its ops record their own tape."""
+            grads = cls.backward(ctx, *ct_tensors)
+            return _align(
+                grads,
+                wrap=lambda g: g if isinstance(g, Tensor) else Tensor(g),
+                zeros=lambda t: Tensor(jnp.zeros(tuple(t.shape), t.dtype)))
+
+        node = GradNode(cls.__name__, vjp_fn, diff_inputs, len(outs), out_avals,
+                        replay_fn=replay_fn)
+        for i, o in enumerate(outs):
+            if isinstance(o, Tensor) and jnp.issubdtype(o.dtype, jnp.inexact):
+                o.stop_gradient = False
+                o._node = node
+                o._out_index = i
+        return outputs

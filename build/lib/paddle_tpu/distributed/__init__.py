@@ -1,0 +1,59 @@
+"""``paddle_tpu.distributed`` — mesh-sharded (GSPMD) parallelism.
+
+Reference surface: `python/paddle/distributed/__init__.py` (shard_tensor /
+reshard / collective API / fleet hybrid parallel). TPU-native design: a
+``ProcessMesh`` wraps ``jax.sharding.Mesh``; placements map to
+``PartitionSpec``; collectives are XLA collectives over ICI/DCN; pipeline
+p2p is collective-permute.
+"""
+
+from .process_mesh import ProcessMesh, get_mesh, set_mesh, init_mesh  # noqa: F401
+from .placement import Placement, Shard, Replicate, Partial  # noqa: F401
+from .api import (  # noqa: F401
+    shard_tensor, dtensor_from_fn, reshard, shard_layer, shard_optimizer,
+    unshard_dtensor, to_partition_spec,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    all_gather_object, reduce_scatter, alltoall, broadcast, reduce,
+    scatter, barrier, send, recv, isend, irecv, wait,
+)
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, is_initialized,
+)
+from .mp_layers import (  # noqa: F401
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from . import p2p  # noqa: F401
+from . import pipeline  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
+from .recompute import recompute  # noqa: F401
+from . import fleet  # noqa: F401
+from .parallel import DataParallel, shard_dataloader, ShardDataloader  # noqa: F401
+from . import auto_tuner  # noqa: F401
+from .watchdog import StepWatchdog, ElasticManager, FileStore  # noqa: F401
+from .pipeline import pipeline_spmd  # noqa: F401
+from . import collective  # noqa: F401
+from ..native import TCPStore  # noqa: F401  (C++ rendezvous store)
+from . import ps  # noqa: F401  (sparse parameter-server seam)
+from . import rpc  # noqa: F401  (control-plane RPC over TCPStore)
+
+__all__ = [
+    "TCPStore",
+    "ProcessMesh", "get_mesh", "set_mesh", "init_mesh",
+    "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+    "shard_optimizer", "unshard_dtensor", "to_partition_spec",
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+    "all_gather", "all_gather_object", "reduce_scatter", "alltoall",
+    "broadcast", "reduce", "scatter", "barrier", "send", "recv",
+    "isend", "irecv", "wait",
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "is_initialized",
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "p2p",
+]

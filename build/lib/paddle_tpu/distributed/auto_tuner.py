@@ -1,0 +1,143 @@
+"""Parallel-config auto-tuner.
+
+Reference: `python/paddle/distributed/auto_tuner/tuner.py:21` (grid
+search over dp/mp/pp/sharding/micro-batch configs, pruned by a memory
+cost model `memory_cost_model.py`, trial jobs measured and ranked).
+
+TPU-native shape: candidates are mesh factorizations of the chip count;
+the memory model estimates per-chip HBM for params/grads/optimizer
+state/activations under the candidate's sharding; surviving candidates
+are measured by a user-supplied ``trial_fn(config) -> seconds`` (e.g.
+timing a few steps of the real compiled train step) and the fastest
+wins.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+__all__ = ["TuningConfig", "MemoryCostModel", "AutoTuner", "tune"]
+
+
+class TuningConfig:
+    """One candidate parallel configuration."""
+
+    def __init__(self, dp=1, mp=1, pp=1, sharding=1, micro_batch=None):
+        self.dp = dp
+        self.mp = mp
+        self.pp = pp
+        self.sharding = sharding
+        self.micro_batch = micro_batch
+
+    @property
+    def world(self):
+        return self.dp * self.mp * self.pp * self.sharding
+
+    def mesh_shape(self):
+        names, shape = [], []
+        for n, d in (("pp", self.pp), ("mp", self.mp),
+                     ("sharding", self.sharding), ("dp", self.dp)):
+            if d > 1:
+                names.append(n)
+                shape.append(d)
+        return names or ["dp"], shape or [1]
+
+    def __repr__(self):
+        return (f"TuningConfig(dp={self.dp}, mp={self.mp}, pp={self.pp}, "
+                f"sharding={self.sharding}, mbs={self.micro_batch})")
+
+
+class MemoryCostModel:
+    """Per-chip HBM estimate (reference memory_cost_model.py).
+
+    params: total parameter count; hidden/layers/seq/batch describe the
+    activation footprint; dtype_bytes: training compute dtype.
+    """
+
+    def __init__(self, n_params, hidden_size, num_layers, seq_len,
+                 global_batch, dtype_bytes=2, optimizer_factor=12,
+                 activation_factor=22):
+        self.n_params = n_params
+        self.hidden = hidden_size
+        self.layers = num_layers
+        self.seq = seq_len
+        self.batch = global_batch
+        self.dtype_bytes = dtype_bytes
+        # param + grad + fp32 master + 2 moments (bytes per param)
+        self.state_bytes = dtype_bytes * 2 + optimizer_factor
+        self.act_factor = activation_factor
+
+    def bytes_per_chip(self, cfg: TuningConfig):
+        shard = cfg.mp * cfg.pp * cfg.sharding   # param/state partitioning
+        state = self.n_params * self.state_bytes / max(1, shard)
+        mbs = cfg.micro_batch or max(1, self.batch // max(1, cfg.dp))
+        acts = (self.act_factor * mbs * self.seq * self.hidden
+                * self.layers * self.dtype_bytes) / max(1, cfg.mp * cfg.pp)
+        return state + acts
+
+    def fits(self, cfg, hbm_bytes):
+        return self.bytes_per_chip(cfg) <= hbm_bytes
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    """Reference tuner.py:21. ``search()`` enumerates, prunes by memory,
+    measures with ``trial_fn`` and returns (best, history)."""
+
+    def __init__(self, num_devices, memory_model=None, hbm_bytes=None,
+                 max_mp=None, max_pp=None, constraints=None):
+        self.n = num_devices
+        self.memory_model = memory_model
+        self.hbm = hbm_bytes
+        self.max_mp = max_mp or num_devices
+        self.max_pp = max_pp or num_devices
+        self.constraints = constraints or (lambda cfg: True)
+
+    def candidates(self):
+        out = []
+        for mp, pp in itertools.product(_divisors(self.n),
+                                        _divisors(self.n)):
+            if mp > self.max_mp or pp > self.max_pp:
+                continue
+            if mp * pp > self.n or self.n % (mp * pp):
+                continue
+            for sharding in _divisors(self.n // (mp * pp)):
+                dp = self.n // (mp * pp * sharding)
+                cfg = TuningConfig(dp=dp, mp=mp, pp=pp, sharding=sharding)
+                if self.constraints(cfg):
+                    out.append(cfg)
+        return out
+
+    def prune(self, cfgs):
+        if self.memory_model is None or self.hbm is None:
+            return list(cfgs)
+        kept = [c for c in cfgs if self.memory_model.fits(c, self.hbm)]
+        return kept
+
+    def search(self, trial_fn, max_trials=None):
+        """trial_fn(cfg) -> step seconds (raise/inf = infeasible)."""
+        cands = self.prune(self.candidates())
+        if max_trials:
+            cands = cands[:max_trials]
+        history = []
+        best, best_t = None, float("inf")
+        for cfg in cands:
+            try:
+                t = float(trial_fn(cfg))
+            except Exception:
+                t = float("inf")
+            history.append((cfg, t))
+            if t < best_t:
+                best, best_t = cfg, t
+        return best, history
+
+
+def tune(num_devices, trial_fn, memory_model=None, hbm_bytes=None,
+         **kwargs):
+    """One-call convenience wrapper."""
+    tuner = AutoTuner(num_devices, memory_model, hbm_bytes, **kwargs)
+    return tuner.search(trial_fn)

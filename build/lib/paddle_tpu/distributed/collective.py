@@ -1,0 +1,222 @@
+"""Collective communication API.
+
+Reference: `python/paddle/distributed/communication/` (all_reduce.py:20 et
+al. over pybind ProcessGroup). TPU-native semantics: inside a traced SPMD
+region (``shard_map`` over a mesh axis) these lower to XLA collectives on
+ICI (`jax.lax.psum`/`all_gather`/`psum_scatter`/`all_to_all`/`ppermute`);
+in the eager single-controller world every visible chip already
+participates in GSPMD ops, so process-level collectives are identities
+within one process and the multi-host boundary is handled by
+``jax.distributed`` + GSPMD over DCN.
+
+A ``group`` here is a mesh axis handle, not a communicator: collectives
+name the mesh dimension they ride over, mirroring how the reference names
+a HybridCommunicateGroup axis ("dp"/"mp"/"pp"/"sep"/"sharding").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, run_op
+
+__all__ = ["ReduceOp", "Group", "new_group", "all_reduce", "all_gather",
+           "all_gather_object", "reduce_scatter", "alltoall", "broadcast",
+           "reduce", "scatter", "barrier", "send", "recv", "isend", "irecv",
+           "wait", "get_group"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A mesh-axis communication scope (reference: communication/group.py)."""
+
+    def __init__(self, axis_name=None, ranks=None, id=0):
+        self.axis_name = axis_name
+        self.ranks = ranks or []
+        self.id = id
+
+    @property
+    def nranks(self):
+        return len(self.ranks) if self.ranks else 1
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, ranks={self.ranks})"
+
+
+_groups = {0: Group(axis_name=None, ranks=[0], id=0)}
+_next_gid = 1
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    global _next_gid
+    g = Group(axis_name=axis_name, ranks=ranks or [], id=_next_gid)
+    _groups[_next_gid] = g
+    _next_gid += 1
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid)
+
+
+def _axis(group):
+    return group.axis_name if isinstance(group, Group) else group
+
+
+def _is_traced(t):
+    return isinstance(t._data if isinstance(t, Tensor) else t,
+                      jax.core.Tracer)
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+    ReduceOp.AVG: jax.lax.pmean,
+}
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In an SPMD region: reduce over the group's mesh axis; eager
+    single-process: identity (GSPMD already holds the global value)."""
+    axis = _axis(group)
+    if axis is not None and _is_traced(tensor):
+        red = _REDUCERS[op]
+        out = run_op("all_reduce", lambda x: red(x, axis), (tensor,))
+        tensor._data = out._data
+        return out
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    axis = _axis(group)
+    if axis is not None and _is_traced(tensor):
+        out = run_op(
+            "all_gather",
+            lambda x: jax.lax.all_gather(x, axis, tiled=False), (tensor,))
+        n = out.shape[0]
+        tensor_list.extend(out[i] for i in range(n))
+        return out
+    tensor_list.append(tensor)
+    return tensor
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+
+
+def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    axis = _axis(group)
+    src = tensor_list_or_input
+    if isinstance(src, (list, tuple)):
+        from ..tensor.manipulation import concat
+        src = concat(list(src), axis=0)
+    if axis is not None and _is_traced(src):
+        out = run_op(
+            "reduce_scatter",
+            lambda x: jax.lax.psum_scatter(x, axis, tiled=True), (src,))
+        tensor._data = out._data
+        return out
+    tensor._data = src._data if isinstance(src, Tensor) else jnp.asarray(src)
+    return tensor
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    axis = _axis(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        from ..tensor.manipulation import stack
+        stacked = stack(list(in_tensor_list), axis=0)
+    else:
+        stacked = in_tensor_list
+    if axis is not None and _is_traced(stacked):
+        out = run_op(
+            "alltoall",
+            lambda x: jax.lax.all_to_all(x, axis, split_axis=0,
+                                         concat_axis=0, tiled=False),
+            (stacked,))
+        out_tensor_list.extend(out[i] for i in range(out.shape[0]))
+        return out
+    out_tensor_list.extend(
+        in_tensor_list if isinstance(in_tensor_list, (list, tuple))
+        else [in_tensor_list])
+    return stacked
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """In an SPMD region: every rank takes rank ``src``'s value (an
+    all-gather + static index, which XLA simplifies to the broadcast
+    collective). Eager single-controller: identity — GSPMD arrays are
+    already globally consistent."""
+    axis = _axis(group)
+    if axis is not None and _is_traced(tensor):
+        out = run_op(
+            "broadcast",
+            lambda x: jax.lax.all_gather(x, axis, tiled=False)[src],
+            (tensor,))
+        tensor._data = out._data
+        return out
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """In an SPMD region: rank i takes slice i of ``src``'s stacked input.
+    (all_gather + dynamic index on axis_index; XLA folds the redundancy.)"""
+    axis = _axis(group)
+    if not tensor_list:
+        return tensor
+    from ..tensor.manipulation import stack
+    stacked = stack(list(tensor_list), axis=0)
+    if axis is not None and _is_traced(stacked):
+        n = jax.lax.psum(1, axis)  # static: mesh axis size
+        if len(tensor_list) != n:
+            raise ValueError(
+                f"scatter got {len(tensor_list)} tensors for a {n}-wide "
+                f"axis {axis!r}; one slice per rank is required")
+        def _scatter(x):
+            full = jax.lax.all_gather(x, axis, tiled=False)[src]
+            return full[jax.lax.axis_index(axis)]
+        out = run_op("scatter", _scatter, (stacked,))
+        tensor._data = out._data
+        return out
+    tensor._data = (tensor_list[0]._data
+                    if isinstance(tensor_list[0], Tensor)
+                    else jnp.asarray(tensor_list[0]))
+    return tensor
+
+
+def barrier(group=None):
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point on TPU is collective-permute on a mesh axis. Inside an
+    SPMD region use :mod:`paddle_tpu.distributed.p2p` (``shift`` /
+    ``send_forward`` / ``send_backward``), which every rank calls
+    collectively; a one-sided eager ``send`` has no TPU equivalent."""
+    raise NotImplementedError(
+        "one-sided send/recv has no TPU equivalent — p2p is collective "
+        "(both sides participate): inside shard_map use "
+        "paddle_tpu.distributed.p2p.shift / send_forward / send_backward / "
+        "ppermute from every rank of the axis")
+
+
+recv = isend = irecv = send
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and not _is_traced(tensor):
+        tensor._data.block_until_ready()
+    return tensor

@@ -1,0 +1,77 @@
+"""Distributed process environment.
+
+Reference: `python/paddle/distributed/parallel.py:943` (init_parallel_env,
+env-var bootstrap over TCPStore). TPU-native: multi-host bootstrap is
+``jax.distributed.initialize`` (coordination service over DCN — the
+TCPStore analog); intra-host chips need no process group at all because
+GSPMD compiles collectives over ICI.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+           "is_initialized"]
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Bootstrap multi-host execution.
+
+    Single-process (the common TPU pattern: one process per host, all local
+    chips visible) needs no setup. Multi-host reads the reference-shaped env
+    vars (``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM`` /
+    ``PADDLE_MASTER``) or the JAX-native ones, then starts the coordination
+    service.
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    # same helper the import-time worker bootstrap uses (one
+    # implementation: gloo-on-cpu config + coordinator join, idempotent).
+    # This late path only works if nothing initialized the XLA backend
+    # yet — prefer launching via the CLI, which bootstraps at import.
+    from .._bootstrap import bootstrap_distributed
+    bootstrap_distributed()
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    return jax.process_count()
+
+
+class ParallelEnv:
+    """Reference: `python/paddle/distributed/parallel.py` ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

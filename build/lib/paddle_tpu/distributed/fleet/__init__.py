@@ -1,0 +1,276 @@
+"""``paddle.distributed.fleet`` — the hybrid-parallel orchestration API.
+
+Reference: `python/paddle/distributed/fleet/fleet.py:100` (``Fleet`` with
+init/distributed_model/distributed_optimizer), `base/topology.py:178`
+(``HybridCommunicateGroup`` carving the world into
+data/pipe/sharding/sep/model axes) and
+`base/distributed_strategy.py` (``DistributedStrategy`` knobs).
+
+TPU-native re-design: the N-D rank topology IS a ``ProcessMesh`` — there
+are no per-axis NCCL communicator groups to create; GSPMD materializes
+each axis's collectives from shardings. ``fleet.init`` bootstraps the
+(possibly multi-host) runtime and builds the mesh from the strategy's
+parallel degrees; ``distributed_model``/``distributed_optimizer`` apply
+the placement recipes (DataParallel input sharding, shard_optimizer
+state inheritance).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..process_mesh import ProcessMesh, set_mesh, get_mesh
+from ..env import init_parallel_env, get_rank, get_world_size
+from .. import api as _api
+
+__all__ = ["DistributedStrategy", "HybridCommunicateGroup", "Fleet",
+           "init", "fleet", "build_topology", "utils", "recompute"]
+
+from ..recompute import recompute as _recompute_fn
+
+
+class utils:
+    """fleet.utils namespace (reference fleet/utils) — recompute lives
+    here in the reference's public API."""
+    recompute = staticmethod(_recompute_fn)
+
+
+recompute = _recompute_fn
+
+
+class DistributedStrategy:
+    """Parallelism knobs (reference base/distributed_strategy.py, the
+    protobuf-backed strategy). Only the fields that mean something on TPU
+    carry behavior; the rest are accepted for API parity."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 0,   # 0 = infer from world size / other degrees
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.pipeline = False
+        self.find_unused_parameters = False
+
+
+def build_topology(strategy=None, world_size=None):
+    """Strategy degrees -> ProcessMesh with the reference's axis order
+    (pp, mp, sep, sharding, dp — `topology.py:290`), dropping size-1
+    axes. Unset degrees absorb the remaining world into dp."""
+    if world_size is not None:
+        world = world_size
+    else:
+        # the topology spans DEVICES, not processes: one TPU process
+        # drives every local chip (global view across all hosts)
+        import jax
+        world = len(jax.devices())
+    cfg = (strategy or DistributedStrategy()).hybrid_configs
+    degrees = [("pp", cfg.get("pp_degree", 1)),
+               ("mp", cfg.get("mp_degree", 1)),
+               ("sep", cfg.get("sep_degree", 1)),
+               ("sharding", cfg.get("sharding_degree", 1)),
+               ("dp", cfg.get("dp_degree", 0) or 0)]
+    known = 1
+    for name, d in degrees[:-1]:
+        known *= max(1, d)
+    dp = degrees[-1][1]
+    if not dp:
+        if world % known:
+            raise ValueError(
+                f"world size {world} not divisible by configured degrees "
+                f"(product {known})")
+        dp = world // known
+    total = known * dp
+    if total != world:
+        raise ValueError(
+            f"degrees multiply to {total} but world size is {world}")
+    names, shape = [], []
+    for name, d in degrees[:-1] + [("dp", dp)]:
+        d = max(1, d)
+        if d > 1:
+            names.append(name)
+            shape.append(d)
+    if not names:
+        names, shape = ["dp"], [1]
+    mesh = ProcessMesh(np.arange(world).reshape(shape), dim_names=names)
+    return mesh
+
+
+class HybridCommunicateGroup:
+    """Axis-rank bookkeeping over the mesh (reference topology.py:178).
+    On TPU it answers "where am I on each axis" — there are no
+    communicator groups to hand out."""
+
+    def __init__(self, mesh: ProcessMesh):
+        self._mesh = mesh
+
+    @property
+    def topology(self):
+        return self._mesh
+
+    def _axis_rank(self, axis):
+        if axis not in self._mesh.dim_names:
+            return 0
+        # the mesh holds global DEVICE ids; locate this process by its
+        # first local device (process_index would misplace multi-host)
+        import jax
+        did = jax.local_devices()[0].id
+        rank = self._mesh.get_rank_by_dim_and_process_id(axis, did)
+        return max(0, int(rank))
+
+    def _axis_size(self, axis):
+        if axis not in self._mesh.dim_names:
+            return 1
+        return self._mesh.get_dim_size(axis)
+
+    def get_data_parallel_rank(self):
+        return self._axis_rank("dp")
+
+    def get_data_parallel_world_size(self):
+        return self._axis_size("dp")
+
+    def get_model_parallel_rank(self):
+        return self._axis_rank("mp")
+
+    def get_model_parallel_world_size(self):
+        return self._axis_size("mp")
+
+    def get_stage_id(self):
+        return self._axis_rank("pp")
+
+    def get_pipe_parallel_world_size(self):
+        return self._axis_size("pp")
+
+    def get_sharding_parallel_rank(self):
+        return self._axis_rank("sharding")
+
+    def get_sharding_parallel_world_size(self):
+        return self._axis_size("sharding")
+
+
+class Fleet:
+    """Reference fleet.py:100."""
+
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._mesh = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        self._mesh = build_topology(self._strategy)
+        set_mesh(self._mesh)
+        self._hcg = HybridCommunicateGroup(self._mesh)
+        return self
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def mesh(self):
+        return self._mesh
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def distributed_model(self, model):
+        """DP wrapper: with a dp axis in the topology, inputs shard over
+        it (reference: paddle.DataParallel + EagerReducer — grad
+        all-reduce is GSPMD's job here)."""
+        from ..parallel import DataParallel
+        if self._mesh is not None and "dp" in self._mesh.dim_names \
+                and self._mesh.get_dim_size("dp") > 1:
+            return DataParallel(model, mesh=self._mesh, dp_axis="dp")
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from ..api import shard_optimizer
+        return shard_optimizer(optimizer)
+
+    def is_worker(self):
+        """Collective mode has no PS roles: every process is a worker."""
+        return True
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+# module-level delegators over the singleton — the reference's usage
+# surface (`fleet.distributed_model(model)` etc., fleet/fleet.py:100)
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group():
+    return fleet.get_hybrid_communicate_group()
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def is_first_worker():
+    return fleet.is_first_worker()
+
+
+def is_worker():
+    return fleet.is_worker()
+
+
+def barrier_worker():
+    return fleet.barrier_worker()
+
+
+class PaddleCloudRoleMaker:
+    """Role shim (reference `fleet/base/role_maker.py`): collective mode
+    reads ranks from the env/runtime, so the role maker is an inert
+    marker object accepted by ``fleet.init`` for API parity."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self.is_collective = is_collective
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective)
+
+
+__all__ += ["distributed_model", "distributed_optimizer",
+            "get_hybrid_communicate_group", "worker_index", "worker_num",
+            "is_first_worker", "is_worker", "barrier_worker",
+            "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]

@@ -1,0 +1,111 @@
+"""``python -m paddle_tpu.distributed.launch`` — the process launcher.
+
+Reference: `python/paddle/distributed/launch/main.py` +
+`launch/controllers/collective.py:22` (``CollectiveController`` spawning
+one process per device with ``PADDLE_*`` env, master rendezvous in
+`controllers/master.py:73`).
+
+TPU-native shape: ONE process per host (each process drives all its
+local chips; intra-host needs no process group — GSPMD compiles the
+collectives), so ``--nproc_per_node`` defaults to 1 and exists for
+CPU-simulation runs. The launcher:
+
+- assigns ranks ``node_rank * nproc + local``,
+- exports the reference-shaped env (``PADDLE_TRAINER_ID``,
+  ``PADDLE_TRAINERS_NUM``, ``PADDLE_MASTER``) that
+  ``init_parallel_env`` turns into ``jax.distributed.initialize``,
+- tees each worker's output to ``<log_dir>/workerlog.<rank>``,
+- waits on all workers, kills the rest when any fails, and exits with
+  the first failure code (the reference's watcher behavior,
+  `launch/controllers/watcher.py`).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def launch(script_args, nnodes=1, node_rank=0, nproc_per_node=1,
+           master=None, log_dir="log", env_extra=None):
+    """Spawn workers for ``script_args`` (list: script + its argv)."""
+    world = nnodes * nproc_per_node
+    if nnodes > 1 and master is None:
+        raise ValueError(
+            "--master host:port is required for multi-node launches "
+            "(a localhost default would leave non-zero nodes waiting on "
+            "a coordinator that does not exist)")
+    if world > 1 and master is None:
+        master = "127.0.0.1:23456"
+    os.makedirs(log_dir, exist_ok=True)
+    procs, logs = [], []
+    try:
+        for local in range(nproc_per_node):
+            rank = node_rank * nproc_per_node + local
+            env = dict(os.environ)
+            env.update(env_extra or {})
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local),
+                "PADDLE_NNODES": str(nnodes),
+                "FLAGS_selected_devices": str(local),
+            })
+            if master:
+                env["PADDLE_MASTER"] = master
+            log = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable] + list(script_args),
+                env=env, stdout=log, stderr=subprocess.STDOUT))
+        # wait; on any failure kill the rest (reference watcher behavior)
+        exit_code = 0
+        pending = set(range(len(procs)))
+        while pending:
+            for i in sorted(pending):
+                ret = procs[i].poll()
+                if ret is None:
+                    continue
+                pending.discard(i)
+                if ret != 0 and exit_code == 0:
+                    exit_code = ret
+                    for j in pending:
+                        procs[j].send_signal(signal.SIGTERM)
+            time.sleep(0.2)
+        return exit_code
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="launch multi-host paddle_tpu training")
+    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--node_rank", type=int,
+                    default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    ap.add_argument("--nproc_per_node", type=int, default=1,
+                    help="processes on this host (1 = all local chips in "
+                         "one process, the TPU default)")
+    ap.add_argument("--master", default=os.environ.get("PADDLE_MASTER"))
+    ap.add_argument("--log_dir", default="log")
+    ap.add_argument("script", nargs=argparse.REMAINDER,
+                    help="training script and its arguments")
+    args = ap.parse_args(argv)
+    if not args.script:
+        ap.error("no training script given")
+    code = launch(args.script, nnodes=args.nnodes,
+                  node_rank=args.node_rank,
+                  nproc_per_node=args.nproc_per_node, master=args.master,
+                  log_dir=args.log_dir)
+    sys.exit(code)

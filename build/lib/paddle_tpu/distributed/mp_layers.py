@@ -1,0 +1,189 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Reference: `python/paddle/distributed/fleet/layers/mpu/mp_layers.py`
+(`VocabParallelEmbedding:47`, `ColumnParallelLinear:334`,
+`RowParallelLinear:541`). TPU-native: instead of manual c_identity /
+mp_allreduce PyLayers around per-rank matmuls, the layer *annotates its
+weight with a sharding* over the mesh's model-parallel axis and lets GSPMD
+insert the all-gather/reduce-scatter where the propagation needs it —
+the compiler reproduces exactly the Megatron comm pattern (column: free;
+row: psum on output) but can also overlap it with compute.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from .api import shard_tensor
+from .placement import Shard, Replicate
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy"]
+
+
+def _mp_axis_index(mesh, axis_name):
+    if axis_name not in mesh.dim_names:
+        raise ValueError(
+            f"mesh {mesh} has no axis {axis_name!r}")
+    return mesh.dim_names.index(axis_name)
+
+
+def _placements(mesh, mesh_dim, shard_tensor_dim):
+    out = [Replicate()] * mesh.ndim
+    out[mesh_dim] = Shard(shard_tensor_dim)
+    return out
+
+
+class ColumnParallelLinear(nn.Layer):
+    """Weight [in, out] sharded along out (reference mp_layers.py:334).
+
+    With ``gather_output=False`` the activation stays sharded on its last
+    dim — feed it to a RowParallelLinear, GSPMD keeps everything local
+    until the row matmul's psum, the Megatron fusion.
+    """
+
+    def __init__(self, in_features, out_features, mesh, axis_name="mp",
+                 weight_attr=None, has_bias=True, gather_output=True,
+                 name=None):
+        super().__init__()
+        self.linear = nn.Linear(in_features, out_features,
+                                weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        self.mesh = mesh
+        self.gather_output = gather_output
+        md = _mp_axis_index(mesh, axis_name)
+        self.linear.weight = shard_tensor(
+            self.linear.weight, mesh, _placements(mesh, md, 1))
+        if has_bias:
+            self.linear.bias = shard_tensor(
+                self.linear.bias, mesh, _placements(mesh, md, 0))
+
+    def forward(self, x):
+        return self.linear(x)
+
+
+class RowParallelLinear(nn.Layer):
+    """Weight [in, out] sharded along in (reference mp_layers.py:541); the
+    matmul's contraction over the sharded dim makes GSPMD emit the
+    all-reduce the reference codes by hand."""
+
+    def __init__(self, in_features, out_features, mesh, axis_name="mp",
+                 weight_attr=None, has_bias=True, input_is_parallel=False,
+                 name=None):
+        super().__init__()
+        self.linear = nn.Linear(in_features, out_features,
+                                weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        self.mesh = mesh
+        md = _mp_axis_index(mesh, axis_name)
+        self.linear.weight = shard_tensor(
+            self.linear.weight, mesh, _placements(mesh, md, 0))
+        if has_bias:
+            self.linear.bias = shard_tensor(
+                self.linear.bias, mesh, [Replicate()] * mesh.ndim)
+
+    def forward(self, x):
+        return self.linear(x)
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Embedding table sharded along vocab (reference mp_layers.py:47)."""
+
+    def __init__(self, num_embeddings, embedding_dim, mesh, axis_name="mp",
+                 weight_attr=None, name=None):
+        super().__init__()
+        self.embedding = nn.Embedding(num_embeddings, embedding_dim,
+                                      weight_attr=weight_attr)
+        md = _mp_axis_index(mesh, axis_name)
+        self.embedding.weight = shard_tensor(
+            self.embedding.weight, mesh, _placements(mesh, md, 0))
+
+    def forward(self, x):
+        return self.embedding(x)
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Reference mp_layers.py:742: cross entropy over vocab-sharded logits.
+    GSPMD handles the sharded logsumexp reduction; the layer only needs the
+    numerically-stable composition."""
+
+    def __init__(self, mesh=None, axis_name="mp", ignore_index=-100,
+                 name=None):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        import paddle_tpu.nn.functional as F
+        return F.cross_entropy(logits, labels, reduction="none")
+
+
+def _constrain(t, mesh, spec_dims):
+    """Tape-recorded sharding constraint (the TPU analog of the
+    reference's ScatterOp/AllGatherOp markers in
+    `fleet/utils/sequence_parallel_utils.py:85,111`)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from ..framework.tensor import run_op
+
+    ns = NamedSharding(mesh.to_jax_mesh(), PartitionSpec(*spec_dims))
+    return run_op("sharding_constraint",
+                  lambda a: jax.lax.with_sharding_constraint(a, ns), (t,))
+
+
+def _sp_spec(ndim, axis, kind):
+    """PartitionSpec dims for sequence-/head-sharded activations: 3-D
+    batch-major [B, S, H] or 2-D flattened [S(*B), H] (the layout the
+    reference's SP region uses)."""
+    if ndim == 3:
+        return (None, axis, None) if kind == "seq" else (None, None, axis)
+    if ndim == 2:
+        return (axis, None) if kind == "seq" else (None, axis)
+    raise ValueError(
+        f"sequence-parallel linear expects 2-D or 3-D activations, "
+        f"got rank {ndim}")
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Megatron-SP column linear (reference
+    `sequence_parallel_utils.py:395`): the incoming activation is
+    SEQUENCE-sharded over the mp axis; the matmul needs the full
+    sequence, so GSPMD inserts the all-gather the reference codes as
+    ``AllGatherOp`` — and the output leaves head-sharded for the paired
+    row layer."""
+
+    def __init__(self, in_features, out_features, mesh, axis_name="mp",
+                 weight_attr=None, has_bias=True, gather_output=False,
+                 name=None):
+        super().__init__(in_features, out_features, mesh, axis_name,
+                         weight_attr, has_bias, gather_output, name)
+        self._axis = axis_name
+
+    def forward(self, x):
+        x = _constrain(x, self.mesh, _sp_spec(x.ndim, self._axis, "seq"))
+        y = self.linear(x)
+        return _constrain(y, self.mesh,
+                          _sp_spec(y.ndim, self._axis, "head"))
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Megatron-SP row linear (reference
+    `sequence_parallel_utils.py:528`): input arrives head-sharded, the
+    contraction psum fuses with a scatter back to sequence-sharded
+    output — the reference's ``ReduceScatterOp``, emitted by GSPMD as
+    one reduce-scatter."""
+
+    def __init__(self, in_features, out_features, mesh, axis_name="mp",
+                 weight_attr=None, has_bias=True, input_is_parallel=True,
+                 name=None):
+        super().__init__(in_features, out_features, mesh, axis_name,
+                         weight_attr, has_bias, input_is_parallel, name)
+        self._axis = axis_name
+
+    def forward(self, x):
+        y = self.linear(x)
+        return _constrain(y, self.mesh,
+                          _sp_spec(y.ndim, self._axis, "seq"))
+
+
+__all__ += ["ColumnSequenceParallelLinear", "RowSequenceParallelLinear"]

@@ -1,0 +1,70 @@
+"""Point-to-point communication over a mesh axis.
+
+Reference: `python/paddle/distributed/fleet/meta_parallel/pp_utils/
+p2p_communication.py` (send/recv between pipeline stages over NCCL) and
+`fluid/distributed/collective/process_group.h:47` (send/recv tasks).
+
+TPU-native mechanics: there are no per-rank NCCL endpoints — point-to-point
+transfers between neighbouring pipeline stages are ``lax.ppermute`` on the
+mesh axis, which XLA lowers to a collective-permute riding the ICI ring.
+These helpers are only meaningful *inside* an SPMD region (``shard_map``
+over the pipeline axis); the schedule library (`distributed.pipeline`)
+calls them from its per-stage step functions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, run_op
+
+__all__ = ["shift", "send_forward", "send_backward", "ppermute",
+           "axis_rank", "axis_size"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def ppermute(x, axis_name, perm):
+    """Raw collective-permute: ``perm`` is a list of (src, dst) pairs.
+    Ranks not named as a dst receive zeros (XLA collective-permute
+    semantics, matching the reference's recv-into-empty-buffer)."""
+    if isinstance(x, Tensor):
+        return run_op("ppermute",
+                      lambda a: jax.lax.ppermute(a, axis_name, perm), (x,))
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def shift(x, axis_name, offset=1, wrap=False):
+    """Every rank i sends ``x`` to rank i+offset (receives from i-offset).
+
+    ``wrap=False`` (pipeline semantics): edge ranks receive zeros.
+    ``wrap=True`` (ring semantics, for ring attention): indices mod n.
+    """
+    n = jax.lax.psum(1, axis_name)
+    if wrap:
+        perm = [(i, (i + offset) % n) for i in range(n)]
+    else:
+        perm = [(i, i + offset) for i in range(n)
+                if 0 <= i + offset < n]
+    return ppermute(x, axis_name, perm)
+
+
+def send_forward(x, axis_name):
+    """Stage i -> stage i+1 (activation flow in 1F1B forward)."""
+    return shift(x, axis_name, offset=1, wrap=False)
+
+
+def send_backward(x, axis_name):
+    """Stage i -> stage i-1 (gradient flow in 1F1B backward)."""
+    return shift(x, axis_name, offset=-1, wrap=False)
+
+
+def axis_rank(axis_name):
+    return jax.lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return jax.lax.psum(1, axis_name)

@@ -1,0 +1,83 @@
+"""Placement types: Shard / Replicate / Partial.
+
+Reference: `paddle/phi/core/distributed/auto_parallel/placement_types.h`
+(via `python/paddle/distributed/__init__.py`). A placements list has one
+entry per *mesh* dimension describing how the tensor relates to that mesh
+axis; the conversion to ``jax.sharding.PartitionSpec`` (one entry per
+*tensor* dimension naming mesh axes) lives in ``api._to_partition_spec``.
+"""
+
+from __future__ import annotations
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Tensor dimension ``dim`` is split across this mesh axis."""
+
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Pending reduction along this mesh axis (reference: partial_status).
+
+    Materializes only inside ``shard_map`` regions — resharding a Partial
+    tensor to Replicate inserts the ``psum`` over the axis.
+    """
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"

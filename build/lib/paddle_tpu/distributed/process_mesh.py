@@ -1,0 +1,119 @@
+"""ProcessMesh over ``jax.sharding.Mesh``.
+
+Reference: `python/paddle/distributed/auto_parallel/process_mesh.py`
+(``ProcessMesh(mesh, dim_names)``). TPU-native: the mesh IS the JAX device
+mesh; axis names ('dp','fsdp','sep','tp','pp','ep') drive GSPMD
+sharding propagation instead of per-axis NCCL communicator groups.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh", "init_mesh"]
+
+_global_mesh = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if arr.dtype.kind not in "iu":
+            raise TypeError("mesh must be an integer array of process ids")
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh rank {arr.ndim}")
+        self._ids = arr
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+        self._jax_mesh_key = None
+
+    # -- reference API surface ---------------------------------------------
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_dim_size(self, name):
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim, process_id):
+        axis = self._dim_names.index(dim) if isinstance(dim, str) else dim
+        pos = np.argwhere(self._ids == process_id)
+        return int(pos[0][axis]) if len(pos) else -1
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            np.array_equal(self._ids, other._ids) and \
+            self._dim_names == other._dim_names
+
+    def __hash__(self):
+        return hash((self._ids.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+    # -- JAX bridge ---------------------------------------------------------
+    def to_jax_mesh(self):
+        """Materialize as ``jax.sharding.Mesh`` over the visible devices.
+
+        The cache is keyed on the visible device list so a mesh built
+        before ``jax.distributed.initialize`` (or a backend switch) is
+        rebuilt rather than silently reusing stale devices."""
+        devices = jax.devices()
+        key = tuple(id(d) for d in devices)
+        if self._jax_mesh is None or self._jax_mesh_key != key:
+            dev_np = np.asarray(devices)
+            flat = self._ids.reshape(-1)
+            if flat.max() >= len(dev_np):
+                raise RuntimeError(
+                    f"mesh references process id {int(flat.max())} but only "
+                    f"{len(dev_np)} devices are visible")
+            dev_arr = dev_np[flat].reshape(self._ids.shape)
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+            self._jax_mesh_key = key
+        return self._jax_mesh
+
+    def __enter__(self):
+        self.to_jax_mesh().__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._jax_mesh.__exit__(*exc)
+
+
+def init_mesh(shape, dim_names):
+    """Build a ProcessMesh spanning all visible devices (helper, analog of
+    `fleet.base.topology.CommunicateTopology` construction)."""
+    n = int(np.prod(shape))
+    ids = np.arange(n).reshape(shape)
+    return ProcessMesh(ids, dim_names)
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh():
+    return _global_mesh

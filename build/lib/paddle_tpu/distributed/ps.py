@@ -1,0 +1,201 @@
+"""Parameter-server seam: sparse tables with pull/push over TCPStore.
+
+Reference capability: the brpc parameter server
+(`paddle/fluid/distributed/ps/` — `brpc_ps_server.cc`, sparse tables
+`ps/table/memory_sparse_table.cc`, Python `ps/the_one_ps.py`). SURVEY §7
+descopes full PS mode ("design seam for sparse tables later"); this
+module is that seam made concrete: a working PS with the reference's
+core semantics — server-resident sparse embedding tables with lazy row
+init, workers pulling rows by id and pushing gradients, server-side
+SGD/Adagrad — over the native C++ TCPStore (`paddle_tpu/native`) as the
+rendezvous + transport, so it runs multi-process today and the table/
+optimizer layer is transport-agnostic for a future brpc-class backend.
+
+The dense path never goes through the PS (GSPMD collectives own it);
+only the sparse-recommendation path does, like the reference's
+heterogeneous PS mode.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+
+import numpy as np
+
+__all__ = ["SparseTable", "PSServer", "PSClient"]
+
+
+def _dumps(arr):
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _loads(data):
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class SparseTable:
+    """Server-side sparse embedding table (reference
+    `memory_sparse_table.cc`): rows materialize on first touch via the
+    initializer; push applies the configured rule server-side."""
+
+    def __init__(self, dim, initializer=None, optimizer="sgd", lr=0.1,
+                 seed=0):
+        self.dim = dim
+        self.lr = lr
+        self.optimizer = optimizer
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"unsupported table optimizer {optimizer!r}")
+        self._rows: dict[int, np.ndarray] = {}
+        self._accum: dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(seed)
+        self._init = initializer or (
+            lambda rng, dim: (rng.rand(dim).astype(np.float32) - 0.5) * 0.2)
+        self._lock = threading.Lock()
+
+    def _row(self, rid):
+        r = self._rows.get(rid)
+        if r is None:
+            r = self._init(self._rng, self.dim)
+            self._rows[rid] = r
+        return r
+
+    def pull(self, ids):
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids, grads):
+        """Apply gradients; duplicate ids accumulate (the reference's
+        merge-by-key before update)."""
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            merged: dict[int, np.ndarray] = {}
+            for i, g in zip(ids, grads):
+                i = int(i)
+                merged[i] = merged.get(i, 0) + g
+            for i, g in merged.items():
+                row = self._row(i)
+                if self.optimizer == "sgd":
+                    row -= self.lr * g
+                else:  # adagrad
+                    acc = self._accum.setdefault(
+                        i, np.zeros(self.dim, np.float32))
+                    acc += g * g
+                    row -= self.lr * g / (np.sqrt(acc) + 1e-10)
+
+    def num_rows(self):
+        with self._lock:
+            return len(self._rows)
+
+    def state_dict(self):
+        with self._lock:
+            return {"rows": dict(self._rows), "accum": dict(self._accum)}
+
+
+class PSServer:
+    """Serves tables over a TCPStore: request keys
+    ``ps/req/<seq>`` hold ``(op, table, payload)``; replies land in
+    ``ps/rsp/<seq>``. One dispatcher thread; table ops are locked, so
+    concurrent workers are safe. (Transport is a KV rendezvous store, not
+    brpc — adequate for the sparse path's pull/push batching.)"""
+
+    def __init__(self, tables, store=None, port=0):
+        from ..native import TCPStore
+        self.tables = dict(tables)
+        self.store = store or TCPStore(port=port, is_master=True)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self):
+        return self.store.port
+
+    def _serve(self):
+        seq = 0
+        misses = 0
+        while not self._stop.is_set():
+            key = f"ps/req/{seq}"
+            try:
+                payload = self.store.get(key, timeout=0.25)
+            except TimeoutError:
+                # a claimed-but-never-written seq (crashed worker) must
+                # not wedge the in-order dispatcher: skip after ~10 s,
+                # unless no request was ever claimed this far
+                claimed = self.store.add("ps/seq", 0)
+                if seq < claimed:
+                    misses += 1
+                    if misses > 40:
+                        misses = 0
+                        seq += 1
+                continue
+            misses = 0
+            self.store.delete_key(key)
+            try:
+                head, body = payload.split(b"\n", 1)
+                op, tname = head.decode().split(":")
+                table = self.tables[tname]
+                if op == "pull":
+                    ids = _loads(body)
+                    self.store.set(f"ps/rsp/{seq}", _dumps(table.pull(ids)))
+                elif op == "push":
+                    blob = _loads(body)
+                    ids, grads = blob[:, 0].astype(np.int64), blob[:, 1:]
+                    table.push(ids, grads)
+                    self.store.set(f"ps/rsp/{seq}", b"ok")
+                elif op == "nrows":
+                    self.store.set(f"ps/rsp/{seq}",
+                                   str(table.num_rows()).encode())
+                else:
+                    self.store.set(f"ps/rsp/{seq}",
+                                   b"err:unknown op " + op.encode())
+            except Exception as e:  # report instead of wedging the loop
+                self.store.set(f"ps/rsp/{seq}", b"err:" + repr(e).encode())
+            seq += 1
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.store.close()
+
+
+class PSClient:
+    """Worker-side handle. Requests are globally ordered via the store's
+    atomic ``add`` on the sequence counter, so any number of workers can
+    interleave pulls and pushes."""
+
+    def __init__(self, host="127.0.0.1", port=0, store=None, timeout=30.0):
+        from ..native import TCPStore
+        self.store = store or TCPStore(host=host, port=port,
+                                       timeout=timeout)
+        self.timeout = timeout
+
+    def _request(self, op, table, body):
+        seq = self.store.add("ps/seq", 1) - 1
+        self.store.set(f"ps/req/{seq}", f"{op}:{table}".encode()
+                       + b"\n" + body)
+        rsp = self.store.get(f"ps/rsp/{seq}", timeout=self.timeout)
+        self.store.delete_key(f"ps/rsp/{seq}")
+        if rsp.startswith(b"err:"):
+            raise RuntimeError(f"PS server error: {rsp[4:].decode()}")
+        return rsp
+
+    def pull(self, table, ids):
+        """Fetch rows for ``ids`` -> float32 [len(ids), dim]."""
+        return _loads(self._request(
+            "pull", table, _dumps(np.asarray(ids, np.int64))))
+
+    def push(self, table, ids, grads):
+        """Send gradients for ``ids``; server applies its update rule."""
+        ids = np.asarray(ids, np.float32).reshape(-1, 1)
+        grads = np.asarray(grads, np.float32)
+        self._request("push", table, _dumps(
+            np.concatenate([ids, grads], axis=1)))
+
+    def num_rows(self, table):
+        return int(self._request("nrows", table, b""))
+
+    def close(self):
+        self.store.close()

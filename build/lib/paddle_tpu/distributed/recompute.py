@@ -1,0 +1,66 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference: `python/paddle/distributed/fleet/recompute/recompute.py`
+(re-runs the forward segment in backward instead of storing its
+activations, with RNG-state replay). TPU-native mechanics: the segment's
+pure function is wrapped in ``jax.checkpoint`` before the tape records it
+— ``jax.vjp`` then saves only the segment INPUTS and re-derives the
+intermediate activations during the backward sweep. RNG draws made while
+tracing the segment are baked into the jaxpr, so the recomputed forward
+replays the exact same randomness (the reference's
+``preserve_rng_state=True`` behavior, by construction).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..framework.tensor import Tensor, run_op
+
+__all__ = ["recompute"]
+
+
+def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
+              **kwargs):
+    """Run ``function(*args, **kwargs)`` with activation checkpointing.
+
+    ``function`` may be an ``nn.Layer`` (its parameters keep gradient
+    flow) or any Tensor-level callable. Tensor ``args`` are the
+    checkpoint boundary: only they (plus parameters) are saved for
+    backward.
+    """
+    from ..nn import Layer
+
+    if isinstance(function, Layer):
+        params = list(function.parameters())
+    else:
+        # a bound method of a Layer (e.g. ``layer.forward``) must thread
+        # its owner's parameters too — otherwise they bake into the
+        # checkpointed jaxpr as constants and silently stop training
+        owner = getattr(function, "__self__", None)
+        params = list(owner.parameters()) if isinstance(owner, Layer) \
+            else []
+    tensor_args = list(args)
+    n_args = len(tensor_args)
+
+    def pure(*arrays):
+        arg_arrays = arrays[:n_args]
+        param_arrays = arrays[n_args:]
+        saved = [(p._data, p._node) for p in params]
+        try:
+            for p, a in zip(params, param_arrays):
+                p._data = a
+                p._node = None
+            ins = [Tensor(a) if not isinstance(a, Tensor)
+                   and hasattr(a, "dtype") else a for a in arg_arrays]
+            out = function(*ins, **kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._data if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._data if isinstance(out, Tensor) else out
+        finally:
+            for p, (d, nd) in zip(params, saved):
+                p._data, p._node = d, nd
+
+    ckpt = jax.checkpoint(pure)
+    return run_op("recompute", ckpt, tuple(tensor_args) + tuple(params))

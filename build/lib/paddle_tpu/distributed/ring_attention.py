@@ -1,0 +1,177 @@
+"""Context parallelism: ring attention + Ulysses all-to-all attention.
+
+Reference (absence): the reference's longest-context mechanisms are
+Megatron-SP (`fleet/utils/sequence_parallel_utils.py:395,528`) and the
+"sep" axis alltoall redistribution (`meta_parallel/segment_parallel.py:26`)
+— it has **no ring attention / blockwise CP in-tree** (SURVEY §5). This
+module goes beyond it, per the build plan:
+
+- :func:`ring_attention` — blockwise-softmax attention with K/V chunks
+  rotating around the ``cp`` ring via ``lax.ppermute`` (collective-permute
+  on the ICI ring). The last rotation is peeled off (no wasted transfer),
+  each block update is rematerialized (``jax.checkpoint``) so backward
+  memory stays O((S/P)^2) per in-flight block, and with ``causal=True``
+  fully-masked future blocks skip their einsums via ``lax.cond``.
+  Known limitation: contiguous chunking leaves the causal ring
+  load-imbalanced (device 0 has the least work); zigzag/striped sharding
+  is the standard follow-up optimization.
+- :func:`ulysses_attention` — the alltoall mode (DeepSpeed-Ulysses /
+  the reference's "sep" axis): ``lax.all_to_all`` swaps the sharded dim
+  from sequence to heads inside ``shard_map``, full-sequence attention
+  runs on the local heads (through the Pallas flash kernel when shapes
+  allow, the XLA path otherwise), and a second all-to-all swaps back.
+
+Both take ``[B, S, H, D]`` Tensors whose sequence dim is sharded over
+``axis``, return outputs with the same sharding, and differentiate
+through (``jax.vjp`` through scan/ppermute/all_to_all).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ..framework.tensor import run_op
+from .process_mesh import ProcessMesh
+from .pipeline import shard_map
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+_NEG = -1e30
+
+
+@functools.lru_cache(maxsize=64)
+def _build_ring(jmesh, axis, causal, scale):
+    P = jmesh.shape[axis]
+    perm = [(r, (r + 1) % P) for r in range(P)]
+
+    def per_device(q, k, v):
+        # local chunks [B, S/P, H(q)/Hk, D]
+        i = jax.lax.axis_index(axis)
+        b, s_loc, h, d = q.shape
+        hk = k.shape[2]
+        group = h // hk
+        qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32)      # [B, H, Sl, D]
+        qpos = i * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+
+        @jax.checkpoint
+        def block(carry, kc, vc, j):
+            """Online-softmax update of (acc, m, l) against chunk j."""
+            acc, m, l = carry
+            kf = jnp.swapaxes(kc, 1, 2).astype(jnp.float32)
+            vf = jnp.swapaxes(vc, 1, 2).astype(jnp.float32)
+            if group > 1:
+                kf = jnp.repeat(kf, group, axis=1)
+                vf = jnp.repeat(vf, group, axis=1)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+            if causal:
+                kpos = j * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+                mask = qpos[:, None] >= kpos[None, :]
+                s = jnp.where(mask[None, None], s, _NEG)
+            m_cur = jnp.max(s, axis=-1)                     # [B, H, Sl]
+            m_new = jnp.maximum(m, m_cur)
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[..., None] + \
+                jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+            return acc_new, m_new, l_new
+
+        def update(carry, kc, vc, j):
+            if not causal:
+                return block(carry, kc, vc, j)
+            # a block whose chunk lies entirely in the future is all-masked
+            # — skip its einsums (saves ~half the ring's flops)
+            return jax.lax.cond(j <= i, lambda c: block(c, kc, vc, j),
+                                lambda c: c, carry)
+
+        def step(carry, t):
+            kc, vc, state = carry
+            state = update(state, kc, vc, (i - t) % P)
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            return (kc, vc, state), None
+
+        state = (jnp.zeros((b, h, s_loc, d), jnp.float32),
+                 jnp.full((b, h, s_loc), _NEG, jnp.float32),
+                 jnp.zeros((b, h, s_loc), jnp.float32))
+        # peel the final block: its rotation result would be discarded
+        (kc, vc, state), _ = jax.lax.scan(step, (k, v, state),
+                                          jnp.arange(P - 1))
+        acc, m, l = update(state, kc, vc, (i - (P - 1)) % P)
+        out = acc / l[..., None]
+        return jnp.swapaxes(out, 1, 2).astype(q.dtype)      # [B, Sl, H, D]
+
+    seq_spec = PartitionSpec(None, axis, None, None)
+    inner = shard_map(per_device, mesh=jmesh,
+                      in_specs=(seq_spec, seq_spec, seq_spec),
+                      out_specs=seq_spec, check_rep=False)
+    return jax.jit(inner)
+
+
+def ring_attention(q, k, v, mesh, axis="sep", causal=True, scale=None):
+    """Blockwise ring attention over the ``axis`` ring. q ``[B, S, H, D]``,
+    k/v ``[B, S, Hk, D]`` (GQA native), sequence sharded over ``axis``;
+    S must divide by the axis size."""
+    jmesh = mesh.to_jax_mesh() if isinstance(mesh, ProcessMesh) else mesh
+    P = jmesh.shape[axis]
+    qs = q.shape if not hasattr(q, "_data") else q._data.shape
+    if qs[1] % P:
+        raise ValueError(f"seq {qs[1]} not divisible by ring size {P}")
+    d = qs[-1]
+    s = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+    fn = _build_ring(jmesh, axis, bool(causal), s)
+    return run_op("ring_attention", fn, (q, k, v))
+
+
+@functools.lru_cache(maxsize=64)
+def _build_ulysses(jmesh, axis, causal, scale, use_flash):
+    from ..nn.functional.attention import _naive_attention
+    from ..ops import flash_attention as FA
+
+    def per_device(q, k, v):
+        # [B, S/P, H, D] local -> all-to-all -> [B, S, H/P, D] local
+        q2 = jax.lax.all_to_all(q, axis, split_axis=2, concat_axis=1,
+                                tiled=True)
+        k2 = jax.lax.all_to_all(k, axis, split_axis=2, concat_axis=1,
+                                tiled=True)
+        v2 = jax.lax.all_to_all(v, axis, split_axis=2, concat_axis=1,
+                                tiled=True)
+        if use_flash and FA.supported(q2, k2, v2, None, causal):
+            h, hk = q2.shape[2], k2.shape[2]
+            out = FA._make_flash(scale, causal, h // hk)(q2, k2, v2)
+        else:
+            out = _naive_attention(q2, k2, v2, None, 0.0, causal, None,
+                                   scale=scale)
+        # heads-sharded -> seq-sharded for the surrounding SP region
+        return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    seq_spec = PartitionSpec(None, axis, None, None)
+    inner = shard_map(per_device, mesh=jmesh,
+                      in_specs=(seq_spec, seq_spec, seq_spec),
+                      out_specs=seq_spec, check_rep=False)
+    return jax.jit(inner)
+
+
+def ulysses_attention(q, k, v, mesh, axis="sep", causal=True, scale=None):
+    """All-to-all (Ulysses / reference "sep") context parallelism: swap the
+    sharded dim from sequence to heads, attend over the full sequence
+    locally (flash kernel when eligible), swap back. Requires num (kv)
+    heads divisible by the axis size."""
+    jmesh = mesh.to_jax_mesh() if isinstance(mesh, ProcessMesh) else mesh
+    P = jmesh.shape[axis]
+    ks = k.shape if not hasattr(k, "_data") else k._data.shape
+    if ks[2] % P:
+        raise ValueError(
+            f"kv heads {ks[2]} not divisible by sep axis size {P}")
+    d = ks[-1]
+    s = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+    from .. import flags
+    fn = _build_ulysses(jmesh, axis, bool(causal), s,
+                        bool(flags.flag("use_pallas_kernels")))
+    return run_op("ulysses_attention", fn, (q, k, v))

@@ -1,0 +1,212 @@
+"""``paddle.distributed.rpc`` (reference:
+`python/paddle/distributed/rpc/rpc.py` — brpc-backed init_rpc /
+rpc_sync / rpc_async / shutdown between named workers).
+
+TPU-native transport: the native C++ TCPStore (the control plane's
+rendezvous store) instead of brpc — each worker runs a dispatcher
+thread that serves requests addressed to its name; calls are pickled
+``(fn, args, kwargs)`` like the reference. The data plane never touches
+this path (collectives ride ICI/DCN inside compiled programs); RPC is
+for control messages, metrics, and orchestration — latency budgets
+where a KV-store transport is fine.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_current_worker_info", "get_worker_info",
+           "get_all_worker_infos", "WorkerInfo"]
+
+
+class WorkerInfo:
+    def __init__(self, name, rank):
+        self.name = name
+        self.rank = rank
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name!r}, rank={self.rank})"
+
+
+class _FutureReply:
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def _set(self, value, error):
+        self._value, self._error = value, error
+        self._event.set()
+
+    def wait(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("rpc reply timed out")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _RpcAgent:
+    def __init__(self, name, rank, world_size, store):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self._stop = threading.Event()
+        self._req_seq = 0
+        store.set(f"rpc/worker/{rank}", name.encode())
+        # DEDICATED connection for the dispatcher: a TCPStore client
+        # serializes requests on its single socket, so a blocking
+        # reply-wait elsewhere must never share the dispatcher's
+        # connection — two agents each starving their own dispatcher
+        # while waiting on the other is a distributed deadlock
+        self._dispatch_store = self._connect()
+        self._dispatcher = threading.Thread(target=self._serve, daemon=True)
+        self._dispatcher.start()
+        # barrier: everyone registered before calls start flying
+        store.barrier(world_size, tag="rpc_init")
+        self.workers = {}
+        for r in range(world_size):
+            wname = store.get(f"rpc/worker/{r}", timeout=30).decode()
+            self.workers[wname] = WorkerInfo(wname, r)
+
+    def _connect(self):
+        from ..native import TCPStore
+
+        return TCPStore(host=self.store.host, port=self.store.port,
+                        timeout=self.store.timeout)
+
+    def _serve(self):
+        seq = 0
+        st = self._dispatch_store
+        while not self._stop.is_set():
+            key = f"rpc/to/{self.name}/{seq}"
+            try:
+                payload = st.get(key, timeout=0.25)
+            except TimeoutError:
+                continue
+            st.delete_key(key)
+            reply_key = f"rpc/reply/{self.name}/{seq}"
+            try:
+                fn, args, kwargs = pickle.loads(payload)
+                reply = b"ok:" + pickle.dumps(fn(*args, **kwargs))
+            except Exception as e:
+                reply = b"er:" + pickle.dumps(e)
+            # Tombstone protocol: a timed-out caller plants
+            # rpc/dead/{name}/{seq}; consuming it means "don't publish,
+            # nobody is waiting" — otherwise a late reply would leak in
+            # the master store forever. Re-check after publishing to
+            # close the set-between-check-and-publish race (the waiter
+            # symmetrically deletes the reply if it was already out).
+            tomb_key = f"rpc/dead/{self.name}/{seq}"
+            if not st.delete_key(tomb_key):
+                st.set(reply_key, reply)
+                if st.delete_key(tomb_key):
+                    st.delete_key(reply_key)
+            seq += 1
+
+    def call(self, to, fn, args, kwargs, timeout):
+        seq = self.store.add(f"rpc/seq/{to}", 1) - 1
+        self.store.set(f"rpc/to/{to}/{seq}",
+                       pickle.dumps((fn, args or (), kwargs or {})))
+        fut = _FutureReply()
+
+        def waiter():
+            # per-call connection: the blocking reply-get must not pin
+            # the shared client (see _dispatch_store note)
+            conn = None
+            try:
+                conn = self._connect()
+                rsp = conn.get(f"rpc/reply/{to}/{seq}", timeout=timeout)
+                conn.delete_key(f"rpc/reply/{to}/{seq}")
+                if rsp[:3] == b"er:":
+                    fut._set(None, pickle.loads(rsp[3:]))
+                else:
+                    fut._set(pickle.loads(rsp[3:]), None)
+            except Exception as e:
+                fut._set(None, e)
+                # Plant a tombstone so the (probably still running)
+                # handler skips publishing its reply; if the reply beat
+                # the tombstone, reap both keys ourselves.
+                if conn is not None:
+                    try:
+                        conn.set(f"rpc/dead/{to}/{seq}", b"1")
+                        if conn.delete_key(f"rpc/reply/{to}/{seq}"):
+                            conn.delete_key(f"rpc/dead/{to}/{seq}")
+                    except Exception:
+                        pass
+            finally:
+                if conn is not None:
+                    conn.close()
+
+        threading.Thread(target=waiter, daemon=True).start()
+        return fut
+
+    def stop(self):
+        self._stop.set()
+        self._dispatcher.join(timeout=5)
+        self._dispatch_store.close()
+
+
+_agent: _RpcAgent | None = None
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Join the RPC mesh (reference `rpc.py:init_rpc`). Rank 0 hosts the
+    store; ``master_endpoint`` is ``"host:port"``."""
+    global _agent
+    if _agent is not None:
+        raise RuntimeError("rpc already initialized")
+    import os
+
+    from ..native import TCPStore
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    endpoint = master_endpoint \
+        or os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+    host, port = endpoint.rsplit(":", 1)
+    store = TCPStore(host=host, port=int(port), is_master=(rank == 0),
+                     timeout=60)
+    _agent = _RpcAgent(name, rank, world_size, store)
+    return _agent.store.port
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=30.0):
+    """Blocking call of ``fn(*args, **kwargs)`` on worker ``to``."""
+    return rpc_async(to, fn, args, kwargs, timeout).wait(timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=30.0):
+    """Returns a future with ``.wait()`` (reference returns FutureWrapper)."""
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent.call(to, fn, args, kwargs, timeout)
+
+
+def get_current_worker_info():
+    return _agent.workers[_agent.name]
+
+
+def get_worker_info(name):
+    return _agent.workers[name]
+
+
+def get_all_worker_infos():
+    return sorted(_agent.workers.values(), key=lambda w: w.rank)
+
+
+def shutdown():
+    """Stop serving (reference `rpc.py:shutdown` barriers first so no
+    in-flight call is dropped)."""
+    global _agent
+    if _agent is None:
+        return
+    _agent.store.barrier(_agent.world_size, tag="rpc_shutdown")
+    _agent.stop()
+    _agent.store.close()
+    _agent = None

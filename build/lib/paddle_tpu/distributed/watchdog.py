@@ -1,0 +1,150 @@
+"""Failure detection: step watchdog + elastic membership manager.
+
+Reference: the NCCL comm watchdog (`phi/core/distributed/
+comm_task_manager.h:37`, timeout detection `comm_task.h:127` — a
+background loop that flags hung collectives) and elastic training
+(`fleet/elastic/manager.py:124`, watch-loop `:594` — membership
+tracking with scale-up/down detection and relaunch).
+
+TPU-native shape: collectives are compiled into the XLA program, so a
+hang surfaces as a step that never completes — the watchdog therefore
+monitors STEP HEARTBEATS from the host side (the granularity that
+exists on TPU), firing a callback / logging / aborting when the gap
+exceeds the timeout. ElasticManager tracks expected vs live hosts via a
+pluggable store (dict / file-based for tests; etcd-shaped interface)
+and reports scale events so a supervisor can checkpoint + relaunch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["StepWatchdog", "ElasticManager", "FileStore"]
+
+
+class StepWatchdog:
+    """Host-side hang detector. ``beat()`` after every step; if no beat
+    arrives within ``timeout`` seconds, ``on_timeout(gap)`` fires (once
+    per stall). Reference analog: CommTaskManager's timeout loop."""
+
+    def __init__(self, timeout=300.0, on_timeout=None, poll=None,
+                 abort=False):
+        self.timeout = float(timeout)
+        self.on_timeout = on_timeout
+        self.abort = abort
+        self._poll = poll or min(1.0, self.timeout / 4)
+        self._last = None
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread = None
+        self.timeouts = 0
+
+    def start(self):
+        self._last = time.monotonic()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self):
+        self._last = time.monotonic()
+        self._fired = False
+
+    def _loop(self):
+        while not self._stop.wait(self._poll):
+            if self._last is None or self._fired:
+                continue
+            gap = time.monotonic() - self._last
+            if gap > self.timeout:
+                self._fired = True
+                self.timeouts += 1
+                if self.on_timeout is not None:
+                    self.on_timeout(gap)
+                if self.abort:
+                    os._exit(124)   # the reference aborts hung workers
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class FileStore:
+    """Shared-filesystem membership store (the test/simple deployment
+    analog of the reference's ETCD registry)."""
+
+    def __init__(self, path):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def register(self, host_id):
+        with open(os.path.join(self.path, str(host_id)), "w") as f:
+            f.write(str(time.time()))
+
+    def deregister(self, host_id):
+        try:
+            os.remove(os.path.join(self.path, str(host_id)))
+        except FileNotFoundError:
+            pass
+
+    def hosts(self):
+        return sorted(os.listdir(self.path))
+
+
+class ElasticManager:
+    """Membership watch-loop (reference elastic/manager.py:124).
+
+    ``watch_once()`` compares live membership against the expected world
+    and returns one of "normal" / "scale_down" / "scale_up"; ``watch``
+    loops until a scale event or stop. A supervisor reacts by
+    checkpointing (distributed.checkpoint) and relaunching with the new
+    world size — the reference's recovery model.
+    """
+
+    def __init__(self, store, host_id, expected_hosts,
+                 on_scale_event=None):
+        self.store = store
+        self.host_id = str(host_id)
+        self.expected = int(expected_hosts)
+        self.on_scale_event = on_scale_event
+        self._stop = threading.Event()
+
+    def register(self):
+        self.store.register(self.host_id)
+        return self
+
+    def deregister(self):
+        self.store.deregister(self.host_id)
+
+    def watch_once(self):
+        live = self.store.hosts()
+        if len(live) < self.expected:
+            return "scale_down"
+        if len(live) > self.expected:
+            return "scale_up"
+        return "normal"
+
+    def watch(self, interval=1.0, max_iters=None):
+        i = 0
+        while not self._stop.is_set():
+            state = self.watch_once()
+            if state != "normal":
+                if self.on_scale_event is not None:
+                    self.on_scale_event(state, self.store.hosts())
+                return state
+            i += 1
+            if max_iters is not None and i >= max_iters:
+                return "normal"
+            time.sleep(interval)
+        return "stopped"
+
+    def stop(self):
+        self._stop.set()

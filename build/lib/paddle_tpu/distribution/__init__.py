@@ -1,0 +1,1138 @@
+"""``paddle.distribution`` — probability distributions.
+
+Reference: `python/paddle/distribution/` (Distribution base
+`distribution.py`, ~25 concrete families, `kl.py` registered
+kl_divergence pairs, `transform.py` bijectors +
+`transformed_distribution.py`). TPU-native mechanics: sampling draws
+typed jax.random primitives keyed from the framework generator (so
+``paddle.seed`` governs sampling, and under ``jit.to_static`` the key is
+an input of the compiled program); densities are pure jnp math recorded
+on the autograd tape, so ``log_prob`` is differentiable in the
+distribution parameters (rsample via reparameterization where it exists).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..framework.tensor import Tensor, run_op
+from ..framework import random as frandom
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Beta", "Gamma", "Exponential", "Laplace", "LogNormal",
+           "Gumbel", "Geometric", "Poisson", "Cauchy", "Multinomial",
+           "Dirichlet", "kl_divergence", "register_kl",
+           "TransformedDistribution", "Transform", "AffineTransform",
+           "ExpTransform", "SigmoidTransform", "TanhTransform"]
+
+
+def _t(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype))
+
+
+def _shape(sample_shape, batch_shape):
+    return tuple(int(s) for s in sample_shape) + tuple(batch_shape)
+
+
+class Distribution:
+    """Base (reference distribution.py Distribution)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        from ..framework.tensor import no_grad
+        with no_grad():
+            return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no reparameterized sampler")
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return self.log_prob(value).exp()
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+# ---------------------------------------------------------------------------
+# continuous, reparameterizable
+# ---------------------------------------------------------------------------
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def rsample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(loc, scale):
+            eps = jax.random.normal(key, out_shape, jnp.float32)
+            return loc + scale * eps
+
+        return run_op("normal_rsample", fn, (self.loc, self.scale))
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, loc, scale):
+            var = scale ** 2
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) \
+                - 0.5 * math.log(2 * math.pi)
+
+        return run_op("normal_log_prob", fn, (value, self.loc, self.scale))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + self.scale.log()
+
+    def cdf(self, value):
+        value = _t(value)
+        return run_op("normal_cdf",
+                      lambda v, l, s: 0.5 * (1 + jsp.erf(
+                          (v - l) / (s * math.sqrt(2)))),
+                      (value, self.loc, self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return (self.loc + 0.5 * self.scale * self.scale).exp()
+
+    @property
+    def variance(self):
+        s2 = self.scale * self.scale
+        return ((s2).exp() - 1.0) * (2.0 * self.loc + s2).exp()
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape).exp()
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._base.log_prob(value.log()) - value.log()
+
+    def entropy(self):
+        return self._base.entropy() + self.loc
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(jnp.broadcast_shapes(self.low._data.shape,
+                                              self.high._data.shape))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        d = self.high - self.low
+        return d * d / 12
+
+    def rsample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(low, high):
+            u = jax.random.uniform(key, out_shape, jnp.float32)
+            return low + (high - low) * u
+
+        return run_op("uniform_rsample", fn, (self.low, self.high))
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, low, high):
+            inside = (v >= low) & (v < high)
+            return jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+
+        return run_op("uniform_log_prob", fn, (value, self.low, self.high))
+
+    def entropy(self):
+        return (self.high - self.low).log()
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate._data.shape)
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / (self.rate * self.rate)
+
+    def rsample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(rate):
+            return jax.random.exponential(key, out_shape,
+                                          jnp.float32) / rate
+
+        return run_op("exponential_rsample", fn, (self.rate,))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run_op(
+            "exponential_log_prob",
+            lambda v, r: jnp.where(v >= 0, jnp.log(r) - r * v, -jnp.inf),
+            (value, self.rate))
+
+    def entropy(self):
+        return 1.0 - self.rate.log()
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2.0 * self.scale * self.scale
+
+    def rsample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(loc, scale):
+            return loc + scale * jax.random.laplace(key, out_shape,
+                                                    jnp.float32)
+
+        return run_op("laplace_rsample", fn, (self.loc, self.scale))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run_op(
+            "laplace_log_prob",
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            (value, self.loc, self.scale))
+
+    def entropy(self):
+        return 1.0 + (2.0 * self.scale).log()
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    @property
+    def mean(self):
+        return self.loc + jnp.euler_gamma * self.scale
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6.0) * self.scale * self.scale
+
+    def rsample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(loc, scale):
+            return loc + scale * jax.random.gumbel(key, out_shape,
+                                                   jnp.float32)
+
+        return run_op("gumbel_rsample", fn, (self.loc, self.scale))
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, loc, scale):
+            z = (v - loc) / scale
+            return -(z + jnp.exp(-z)) - jnp.log(scale)
+
+        return run_op("gumbel_log_prob", fn, (value, self.loc, self.scale))
+
+    def entropy(self):
+        return self.scale.log() + (1.0 + jnp.euler_gamma)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    def rsample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(loc, scale):
+            return loc + scale * jax.random.cauchy(key, out_shape,
+                                                   jnp.float32)
+
+        return run_op("cauchy_rsample", fn, (self.loc, self.scale))
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, loc, scale):
+            z = (v - loc) / scale
+            return -jnp.log(math.pi * scale * (1 + z * z))
+
+        return run_op("cauchy_log_prob", fn, (value, self.loc, self.scale))
+
+    def entropy(self):
+        return (4.0 * math.pi * self.scale).log()
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha._data.shape,
+                                              self.beta._data.shape))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1.0))
+
+    def rsample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(a, b):
+            return jax.random.beta(key, a, b, out_shape, jnp.float32)
+
+        return run_op("beta_rsample", fn, (self.alpha, self.beta))
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, a, b):
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) \
+                - (jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b))
+
+        return run_op("beta_log_prob", fn, (value, self.alpha, self.beta))
+
+    def entropy(self):
+        def fn(a, b):
+            total = a + b
+            return (jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(total)
+                    - (a - 1) * jsp.digamma(a) - (b - 1) * jsp.digamma(b)
+                    + (total - 2) * jsp.digamma(total))
+
+        return run_op("beta_entropy", fn, (self.alpha, self.beta))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(jnp.broadcast_shapes(
+            self.concentration._data.shape, self.rate._data.shape))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / (self.rate * self.rate)
+
+    def rsample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(a, r):
+            return jax.random.gamma(key, a, out_shape, jnp.float32) / r
+
+        return run_op("gamma_rsample", fn, (self.concentration, self.rate))
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, a, r):
+            return a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v \
+                - jsp.gammaln(a)
+
+        return run_op("gamma_log_prob", fn,
+                      (value, self.concentration, self.rate))
+
+    def entropy(self):
+        def fn(a, r):
+            return a - jnp.log(r) + jsp.gammaln(a) \
+                + (1 - a) * jsp.digamma(a)
+
+        return run_op("gamma_entropy", fn, (self.concentration, self.rate))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        shape = self.concentration._data.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(
+            axis=-1, keepdim=True)
+
+    def rsample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape) \
+            + tuple(self.event_shape)
+
+        def fn(a):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, out_shape),
+                                 dtype=jnp.float32)
+            return g / jnp.sum(g, axis=-1, keepdims=True)
+
+        return run_op("dirichlet_rsample", fn, (self.concentration,))
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, a):
+            return jnp.sum((a - 1) * jnp.log(v), -1) \
+                + jsp.gammaln(jnp.sum(a, -1)) - jnp.sum(jsp.gammaln(a), -1)
+
+        return run_op("dirichlet_log_prob", fn,
+                      (value, self.concentration))
+
+
+# ---------------------------------------------------------------------------
+# discrete
+# ---------------------------------------------------------------------------
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _t(probs)
+        else:
+            self.probs = _t(logits).sigmoid()
+        super().__init__(self.probs._data.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(p):
+            return jax.random.bernoulli(key, p, out_shape) \
+                .astype(jnp.float32)
+
+        return run_op("bernoulli_sample", fn, (self.probs,),
+                      differentiable=False)
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, p):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return run_op("bernoulli_log_prob", fn, (value, self.probs))
+
+    def entropy(self):
+        def fn(p):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return run_op("bernoulli_entropy", fn, (self.probs,))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(self.probs._data.shape)
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1.0 - self.probs) / (self.probs * self.probs)
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(p):
+            return jax.random.geometric(key, p, out_shape) \
+                .astype(jnp.float32) - 1.0
+
+        return run_op("geometric_sample", fn, (self.probs,),
+                      differentiable=False)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run_op(
+            "geometric_log_prob",
+            lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+            (value, self.probs))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate._data.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(r):
+            return jax.random.poisson(key, r, out_shape) \
+                .astype(jnp.float32)
+
+        return run_op("poisson_sample", fn, (self.rate,),
+                      differentiable=False)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run_op(
+            "poisson_log_prob",
+            lambda v, r: v * jnp.log(r) - r - jsp.gammaln(v + 1),
+            (value, self.rate))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if logits is not None:
+            self.logits = _t(logits)
+        else:
+            self.logits = _t(probs).log()
+        shape = self.logits._data.shape
+        super().__init__(shape[:-1])
+        self._n = shape[-1]
+
+    @property
+    def probs(self):
+        from ..nn import functional as F
+        return F.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(logits):
+            return jax.random.categorical(key, logits, shape=out_shape) \
+                .astype(jnp.int32)
+
+        return run_op("categorical_sample", fn, (self.logits,),
+                      differentiable=False)
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, logits):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+        return run_op("categorical_log_prob", fn, (value, self.logits))
+
+    def entropy(self):
+        def fn(logits):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+        return run_op("categorical_entropy", fn, (self.logits,))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shape = self.probs._data.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.probs * float(self.total_count)
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+        n = self.total_count
+
+        def fn(p):
+            logits = jnp.log(p)
+            draws = jax.random.categorical(
+                key, logits, shape=(n,) + out_shape)
+            onehot = jax.nn.one_hot(draws, p.shape[-1], dtype=jnp.float32)
+            return jnp.sum(onehot, axis=0)
+
+        return run_op("multinomial_sample", fn, (self.probs,),
+                      differentiable=False)
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, p):
+            return jsp.gammaln(jnp.sum(v, -1) + 1) \
+                - jnp.sum(jsp.gammaln(v + 1), -1) \
+                + jnp.sum(v * jnp.log(p), -1)
+
+        return run_op("multinomial_log_prob", fn, (value, self.probs))
+
+
+# ---------------------------------------------------------------------------
+# transforms + transformed distribution
+# ---------------------------------------------------------------------------
+class Transform:
+    """Bijector base (reference transform.py Transform)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return self.scale.abs().log()
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return x.exp()
+
+    def inverse(self, y):
+        return y.log()
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return x.sigmoid()
+
+    def inverse(self, y):
+        return (y / (1.0 - y)).log()
+
+    def forward_log_det_jacobian(self, x):
+        s = x.sigmoid()
+        return (s * (1.0 - s)).log()
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return x.tanh()
+
+    def inverse(self, y):
+        return 0.5 * ((1.0 + y) / (1.0 - y)).log()
+
+    def forward_log_det_jacobian(self, x):
+        return (1.0 - x.tanh() * x.tanh()).log()
+
+
+class TransformedDistribution(Distribution):
+    """base pushed through a chain of transforms (reference
+    transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x.detach()
+
+    def log_prob(self, value):
+        logp = None
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            term = t.forward_log_det_jacobian(x)
+            logp = term if logp is None else logp + term
+            y = x
+        base_lp = self.base.log_prob(y)
+        return base_lp - logp if logp is not None else base_lp
+
+
+# ---------------------------------------------------------------------------
+# kl_divergence registry
+# ---------------------------------------------------------------------------
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Reference kl.py register_kl decorator."""
+
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        for (pc, qc), f in _KL_REGISTRY.items():
+            if isinstance(p, pc) and isinstance(q, qc):
+                fn = f
+                break
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__}) "
+            "is not registered")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1.0 - var_ratio.log())
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return ((q.high - q.low) / (p.high - p.low)).log()
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def fn(pp, qp):
+        eps = 1e-7
+        pp = jnp.clip(pp, eps, 1 - eps)
+        qp = jnp.clip(qp, eps, 1 - eps)
+        return pp * (jnp.log(pp) - jnp.log(qp)) \
+            + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp))
+
+    return run_op("kl_bernoulli", fn, (p.probs, q.probs))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def fn(pl, ql):
+        lp = jax.nn.log_softmax(pl, -1)
+        lq = jax.nn.log_softmax(ql, -1)
+        return jnp.sum(jnp.exp(lp) * (lp - lq), -1)
+
+    return run_op("kl_categorical", fn, (p.logits, q.logits))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = p.rate / q.rate
+    return r.log() + 1.0 / r - 1.0
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def fn(pa, pr, qa, qr):
+        return ((pa - qa) * jsp.digamma(pa) - jsp.gammaln(pa)
+                + jsp.gammaln(qa) + qa * (jnp.log(pr) - jnp.log(qr))
+                + pa * (qr / pr - 1.0))
+
+    return run_op("kl_gamma", fn, (p.concentration, p.rate,
+                                   q.concentration, q.rate))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def fn(pa, pb, qa, qb):
+        def lbeta(a, b):
+            return jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+        return (lbeta(qa, qb) - lbeta(pa, pb)
+                + (pa - qa) * jsp.digamma(pa)
+                + (pb - qb) * jsp.digamma(pb)
+                + (qa - pa + qb - pb) * jsp.digamma(pa + pb))
+
+    return run_op("kl_beta", fn, (p.alpha, p.beta, q.alpha, q.beta))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def fn(pl, ps, ql, qs):
+        t = jnp.abs(pl - ql)
+        return (jnp.log(qs) - jnp.log(ps)
+                + (ps * jnp.exp(-t / ps) + t) / qs - 1.0)
+
+    return run_op("kl_laplace", fn, (p.loc, p.scale, q.loc, q.scale))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def fn(pa, qa):
+        sp = jnp.sum(pa, -1)
+        return (jsp.gammaln(sp) - jnp.sum(jsp.gammaln(pa), -1)
+                - jsp.gammaln(jnp.sum(qa, -1))
+                + jnp.sum(jsp.gammaln(qa), -1)
+                + jnp.sum((pa - qa) * (jsp.digamma(pa)
+                                       - jsp.digamma(sp)[..., None]), -1))
+
+    return run_op("kl_dirichlet", fn, (p.concentration, q.concentration))
+
+
+class Binomial(Distribution):
+    """Reference `distribution/binomial.py`."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(jnp.broadcast_shapes(
+            self.total_count._data.shape, self.probs._data.shape))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(n, p):
+            return jax.random.binomial(key, n, p, shape=out_shape) \
+                .astype(jnp.float32)
+
+        return run_op("binomial_sample", fn, (self.total_count, self.probs),
+                      differentiable=False)
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, n, p):
+            logc = (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(n - v + 1))
+            return logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+
+        return run_op("binomial_log_prob", fn,
+                      (value, self.total_count, self.probs))
+
+    def entropy(self):
+        # half the support often suffices; exact via summation over k
+        def fn(n, p):
+            nmax = int(np.max(np.asarray(n)))
+            k = jnp.arange(nmax + 1, dtype=jnp.float32)
+            logc = (jsp.gammaln(n[..., None] + 1) - jsp.gammaln(k + 1)
+                    - jsp.gammaln(n[..., None] - k + 1))
+            logp = logc + k * jnp.log(p[..., None]) \
+                + (n[..., None] - k) * jnp.log1p(-p[..., None])
+            mask = k <= n[..., None]
+            pk = jnp.where(mask, jnp.exp(logp), 0.0)
+            return -jnp.sum(pk * jnp.where(mask, logp, 0.0), axis=-1)
+
+        return run_op("binomial_entropy", fn,
+                      (self.total_count, self.probs))
+
+
+class ContinuousBernoulli(Distribution):
+    """Reference `distribution/continuous_bernoulli.py`: the [0, 1]
+    continuous relaxation with normalizer C(p)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(self.probs._data.shape)
+
+    def _log_norm(self, p):
+        # C(p) = 2*atanh(1-2p) / (1-2p) for p != 0.5, else 2
+        safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+        near_half = jnp.abs(safe - 0.5) < (self._lims[1] - 0.5)
+        x = jnp.where(near_half, 0.4, safe)  # safe value for the formula
+        c = 2 * jnp.arctanh(1 - 2 * x) / (1 - 2 * x)
+        # 2nd-order Taylor around 0.5: C = 2 + (4/3)*(p-1/2)^2
+        taylor = 2.0 + (4.0 / 3.0) * (safe - 0.5) ** 2
+        return jnp.log(jnp.where(near_half, taylor, c))
+
+    @property
+    def mean(self):
+        def fn(p):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            near_half = jnp.abs(safe - 0.5) < (self._lims[1] - 0.5)
+            x = jnp.where(near_half, 0.4, safe)
+            m = x / (2 * x - 1) + 1 / (2 * jnp.arctanh(1 - 2 * x))
+            return jnp.where(near_half, 0.5, m)
+
+        return run_op("cb_mean", fn, (self.probs,))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def fn(p):
+            u = jax.random.uniform(key, out_shape)
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            near_half = jnp.abs(safe - 0.5) < (self._lims[1] - 0.5)
+            x = jnp.where(near_half, 0.4, safe)
+            # inverse CDF for p != 0.5
+            icdf = (jnp.log1p(u * (2 * x - 1) / (1 - x))
+                    / (jnp.log(x) - jnp.log1p(-x)))
+            return jnp.where(near_half, u, icdf)
+
+        return run_op("cb_sample", fn, (self.probs,),
+                      differentiable=False)
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, p):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            return (v * jnp.log(safe) + (1 - v) * jnp.log1p(-safe)
+                    + self._log_norm(safe))
+
+        return run_op("cb_log_prob", fn, (value, self.probs))
+
+
+class Independent(Distribution):
+    """Reference `distribution/independent.py`: reinterpret the last
+    ``reinterpreted_batch_rank`` batch dims as event dims (log_prob
+    sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        if self.rank > len(base.batch_shape):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self.rank} exceeds base batch "
+                f"rank {len(base.batch_shape)}")
+        super().__init__(tuple(base.batch_shape)[:len(base.batch_shape)
+                                                 - self.rank])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        for _ in range(self.rank):
+            lp = lp.sum(-1)
+        return lp
+
+    def entropy(self):
+        e = self.base.entropy()
+        for _ in range(self.rank):
+            e = e.sum(-1)
+        return e
+
+
+class MultivariateNormal(Distribution):
+    """Reference `distribution/multivariate_normal.py` (loc +
+    covariance_matrix parameterization)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _t(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError(
+                "pass exactly one of covariance_matrix/scale_tril")
+        if covariance_matrix is not None:
+            self.covariance_matrix = _t(covariance_matrix)
+            self._tril = run_op(
+                "mvn_chol", lambda c: jnp.linalg.cholesky(c),
+                (self.covariance_matrix,))
+        else:
+            self._tril = _t(scale_tril)
+            self.covariance_matrix = run_op(
+                "mvn_cov", lambda L: L @ jnp.swapaxes(L, -1, -2),
+                (self._tril,))
+        super().__init__(self.loc._data.shape[:-1])
+        self._d = self.loc._data.shape[-1]
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return run_op(
+            "mvn_var", lambda c: jnp.diagonal(c, axis1=-2, axis2=-1),
+            (self.covariance_matrix,))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape) + (self._d,)
+
+        def fn(mu, L):
+            eps = jax.random.normal(key, out_shape)
+            return mu + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return run_op("mvn_sample", fn, (self.loc, self._tril),
+                      differentiable=False)
+
+    def rsample(self, shape=()):
+        key = frandom.next_key()
+        out_shape = _shape(shape, self.batch_shape) + (self._d,)
+
+        def fn(mu, L):
+            eps = jax.random.normal(key, out_shape)
+            return mu + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return run_op("mvn_rsample", fn, (self.loc, self._tril))
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, mu, L):
+            diff = v - mu
+            sol = jax.scipy.linalg.solve_triangular(
+                L, diff[..., None], lower=True)[..., 0]
+            maha = jnp.sum(sol ** 2, axis=-1)
+            logdet = 2 * jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+            return -0.5 * (self._d * jnp.log(2 * jnp.pi) + logdet + maha)
+
+        return run_op("mvn_log_prob", fn, (value, self.loc, self._tril))
+
+    def entropy(self):
+        def fn(L):
+            logdet = 2 * jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+            return 0.5 * self._d * (1 + jnp.log(2 * jnp.pi)) + 0.5 * logdet
+
+        return run_op("mvn_entropy", fn, (self._tril,))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    def fn(mu_p, Lp, mu_q, Lq):
+        d = mu_p.shape[-1]
+        diff = mu_q - mu_p
+        sol_mean = jax.scipy.linalg.solve_triangular(
+            Lq, diff[..., None], lower=True)[..., 0]
+        m = jax.scipy.linalg.solve_triangular(
+            Lq, Lp, lower=True)
+        tr = jnp.sum(m ** 2, axis=(-2, -1))
+        logdet_p = 2 * jnp.sum(
+            jnp.log(jnp.diagonal(Lp, axis1=-2, axis2=-1)), axis=-1)
+        logdet_q = 2 * jnp.sum(
+            jnp.log(jnp.diagonal(Lq, axis1=-2, axis2=-1)), axis=-1)
+        return 0.5 * (tr + jnp.sum(sol_mean ** 2, axis=-1) - d
+                      + logdet_q - logdet_p)
+
+    return run_op("kl_mvn", fn, (p.loc, p._tril, q.loc, q._tril))
+
+
+__all__ += ["Binomial", "ContinuousBernoulli", "Independent",
+            "MultivariateNormal"]

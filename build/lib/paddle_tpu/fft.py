@@ -1,0 +1,78 @@
+"""``paddle.fft`` — discrete Fourier transforms.
+
+Reference: `python/paddle/fft.py` (fft/ifft/rfft/... with norm modes).
+TPU-native backend: ``jnp.fft`` — XLA lowers FFTs to its native
+DFT/real-DFT HLOs. All transforms record on the tape (jax's fft has a
+VJP), so spectral losses differentiate.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.tensor import run_op
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2",
+           "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _wrap1(opname, jfn, has_n=True):
+    if has_n:
+        def op(x, n=None, axis=-1, norm="backward", name=None):
+            return run_op(opname, lambda a: jfn(a, n=n, axis=axis,
+                                                norm=norm), (x,))
+    else:
+        def op(x, axes=None, name=None):
+            return run_op(opname, lambda a: jfn(a, axes=axes), (x,))
+    op.__name__ = opname
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+fftshift = _wrap1("fftshift", jnp.fft.fftshift, has_n=False)
+ifftshift = _wrap1("ifftshift", jnp.fft.ifftshift, has_n=False)
+
+
+def _wrap2(opname, jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return run_op(opname, lambda a: jfn(a, s=s, axes=axes, norm=norm),
+                      (x,))
+    op.__name__ = opname
+    return op
+
+
+fft2 = _wrap2("fft2", jnp.fft.fft2)
+ifft2 = _wrap2("ifft2", jnp.fft.ifft2)
+rfft2 = _wrap2("rfft2", jnp.fft.rfft2)
+irfft2 = _wrap2("irfft2", jnp.fft.irfft2)
+
+
+def _wrapn(opname, jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return run_op(opname, lambda a: jfn(a, s=s, axes=axes, norm=norm),
+                      (x,))
+    op.__name__ = opname
+    return op
+
+
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))

@@ -1,0 +1,116 @@
+"""Global runtime flag registry.
+
+TPU-native analog of the reference's exported-flag system
+(`paddle/common/flags.h:38`, `paddle/common/flags.cc` — 146 `PHI_DEFINE_EXPORTED_*`
+definitions, surfaced in Python as ``paddle.set_flags`` / ``paddle.get_flags``).
+Flags are plain Python values; each flag may also be seeded from an environment
+variable ``FLAGS_<name>`` at definition time, matching the reference's behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable
+
+__all__ = ["define_flag", "set_flags", "get_flags", "flag"]
+
+_lock = threading.Lock()
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "help", "type", "on_change")
+
+    def __init__(self, name, default, help_str, typ, on_change=None):
+        self.name = name
+        self.default = default
+        self.help = help_str
+        self.type = typ
+        self.on_change = on_change
+        self.value = self._from_env(default)
+
+    def _from_env(self, default):
+        env = os.environ.get("FLAGS_" + self.name)
+        if env is None:
+            return default
+        return _parse(env, self.type)
+
+
+def _parse(text: str, typ: type) -> Any:
+    if typ is bool:
+        return text.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(text)
+    if typ is float:
+        return float(text)
+    return text
+
+
+_REGISTRY: dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default: Any, help_str: str = "",
+                on_change: Callable[[Any], None] | None = None) -> None:
+    """Register a runtime flag (analog of ``PHI_DEFINE_EXPORTED_*``)."""
+    with _lock:
+        if name in _REGISTRY:
+            raise KeyError(f"flag '{name}' already defined")
+        _REGISTRY[name] = _Flag(name, default, help_str, type(default), on_change)
+
+
+def set_flags(flags: dict[str, Any]) -> None:
+    """Set one or more flags (``paddle.set_flags`` equivalent)."""
+    with _lock:
+        for name, value in flags.items():
+            key = name[6:] if name.startswith("FLAGS_") else name
+            if key not in _REGISTRY:
+                raise KeyError(f"unknown flag '{name}'")
+            f = _REGISTRY[key]
+            if isinstance(value, str) and f.type is not str:
+                value = _parse(value, f.type)
+            f.value = value
+            if f.on_change is not None:
+                f.on_change(value)
+
+
+def get_flags(flags: list[str] | str | None = None) -> dict[str, Any]:
+    """Read flags (``paddle.get_flags`` equivalent)."""
+    if flags is None:
+        names = list(_REGISTRY)
+    elif isinstance(flags, str):
+        names = [flags]
+    else:
+        names = list(flags)
+    out = {}
+    for name in names:
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag '{name}'")
+        out["FLAGS_" + key] = _REGISTRY[key].value
+    return out
+
+
+def flag(name: str) -> Any:
+    """Fast internal accessor for a single flag value."""
+    return _REGISTRY[name].value
+
+
+# ---------------------------------------------------------------------------
+# Core flags (subset of the reference's most load-bearing knobs,
+# common/flags.cc). More are defined where their subsystem lives.
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "Check outputs of every op for NaN/Inf in eager mode "
+            "(reference: FLAGS_check_nan_inf).")
+define_flag("benchmark", False, "Synchronize after each op for timing.")
+define_flag("low_precision_op_list", 0,
+            "Report ops executed in low precision under AMP.")
+define_flag("use_pallas_kernels", True,
+            "Use Pallas TPU kernels for fused ops (flash attention, rms_norm, "
+            "rope) where available; falls back to XLA lowering otherwise.")
+define_flag("comm_timeout_seconds", 1800,
+            "Collective watchdog timeout (reference: NCCL comm watchdog, "
+            "phi/core/distributed/comm_task.h:127).")
+define_flag("eager_delete_tensor_gb", 0.0, "Compat no-op: XLA manages memory.")
+define_flag("allocator_strategy", "auto_growth",
+            "Compat: allocator strategy label (XLA/PJRT owns allocation).")

@@ -1,0 +1,31 @@
+"""Framework core: dtype system, Tensor, autograd engine, RNG.
+
+The analog of the reference's `paddle/phi/core` + `paddle/fluid/eager`
+(SURVEY §2.1, §2.3) — except the device runtime is PJRT via JAX and
+gradients come from `jax.vjp` instead of generated grad kernels.
+"""
+
+from . import dtype  # noqa: F401  (module; the class is dtype.dtype)
+from .dtype import (  # noqa: F401
+    convert_dtype, get_default_dtype, set_default_dtype,
+    is_floating_point_dtype, iinfo, finfo,
+)
+from .tensor import (  # noqa: F401
+    Tensor, Parameter, to_tensor, no_grad, enable_grad,
+    is_grad_enabled, set_grad_enabled,
+)
+from . import random  # noqa: F401
+from .random import seed, get_rng_state, set_rng_state  # noqa: F401
+from . import autograd_engine  # noqa: F401
+
+
+def in_dynamic_mode():
+    return True
+
+
+def in_pir_mode():
+    return False
+
+
+def in_dynamic_or_pir_mode():
+    return True

@@ -1,0 +1,83 @@
+"""Autocast state + per-op cast wrapping for the eager executor.
+
+Reference: the AMP branch the reference's codegen emits into every eager op
+(`paddle/fluid/eager/amp_auto_cast.h`, driven by the op lists in
+`python/paddle/amp/amp_lists.py`). Here the policy is applied at the single
+dispatch seam (`framework.tensor.run_op`): white-list ops cast their
+floating inputs to the autocast dtype (bf16 on TPU — the MXU's native
+format), black-list ops cast to float32, everything else runs in whatever
+dtype its inputs already have. The cast happens *inside* the op's pure
+function, so it is differentiated by ``jax.vjp`` (cotangents cast back
+automatically) and traces cleanly under ``jit``.
+
+This module holds only the mutable state and the cast transform; the user
+API lives in ``paddle_tpu.amp``.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+__all__ = ["AmpAttrs", "current", "push", "pop", "enabled", "wrap"]
+
+_CASTABLE = ("float16", "bfloat16", "float32")
+
+
+class AmpAttrs:
+    __slots__ = ("dtype", "level", "white", "black")
+
+    def __init__(self, dtype, level, white, black):
+        self.dtype = np.dtype(dtype)
+        self.level = level
+        self.white = frozenset(white)
+        self.black = frozenset(black)
+
+
+_stack: list[AmpAttrs] = []
+
+
+def current():
+    return _stack[-1] if _stack else None
+
+
+def push(attrs):
+    _stack.append(attrs)
+
+
+def pop():
+    return _stack.pop()
+
+
+def enabled():
+    return bool(_stack)
+
+
+def _cast(v, target):
+    if isinstance(v, (jax.Array, jax.core.Tracer)) \
+            and v.dtype.name in _CASTABLE and v.dtype != target:
+        return v.astype(target)
+    if isinstance(v, (list, tuple)):
+        return type(v)(_cast(e, target) for e in v)
+    return v
+
+
+def wrap(name, fn):
+    """Return ``fn`` with autocast input casting for op ``name`` (identity
+    when the op is in neither list)."""
+    st = current()
+    if st is None:
+        return fn
+    if name in st.white:
+        target = st.dtype
+    elif name in st.black:
+        target = np.dtype("float32")
+    else:
+        return fn
+
+    def casted(*args, **kwargs):
+        args = tuple(_cast(a, target) for a in args)
+        kwargs = {k: _cast(v, target) for k, v in kwargs.items()}
+        return fn(*args, **kwargs)
+
+    return casted

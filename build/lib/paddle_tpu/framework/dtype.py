@@ -1,0 +1,102 @@
+"""Dtype system.
+
+Maps the reference's ``paddle.dtype`` surface (phi ``DataType``,
+`paddle/phi/common/data_type.h`) onto JAX dtypes. Dtypes are plain
+``jnp.dtype`` objects so they interoperate directly with jax/numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "float16", "float32", "float64", "bfloat16",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "bool_", "complex64", "complex128",
+    "dtype", "convert_dtype", "get_default_dtype", "set_default_dtype",
+    "is_floating_point_dtype", "iinfo", "finfo",
+]
+
+dtype = jnp.dtype
+
+float16 = jnp.dtype(jnp.float16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64)
+uint8 = jnp.dtype(jnp.uint8)
+uint16 = jnp.dtype(jnp.uint16)
+uint32 = jnp.dtype(jnp.uint32)
+uint64 = jnp.dtype(jnp.uint64)
+bool_ = jnp.dtype(jnp.bool_)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128)
+
+_ALIASES = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_, "complex64": complex64, "complex128": complex128,
+}
+
+_default_dtype = float32
+
+
+def convert_dtype(d) -> jnp.dtype:
+    """Normalize any dtype spec (str / np / jnp / paddle-style) to jnp.dtype.
+
+    TPU-first: when JAX runs in its default 32-bit regime, 64-bit requests
+    canonicalize to 32-bit (int32 indices are what the TPU wants; the
+    reference defaults to int64/float64 on CPU but we do not follow that).
+    """
+    if d is None:
+        return None
+    if isinstance(d, str):
+        key = d.lower()
+        d = _ALIASES[key] if key in _ALIASES else jnp.dtype(d)
+    else:
+        d = jnp.dtype(d)
+    import jax
+    if not jax.config.jax_enable_x64:
+        d = _X64_DOWN.get(d, d)
+    return d
+
+
+_X64_DOWN = {float64: float32, int64: int32, uint64: uint32,
+             complex128: complex64}
+
+
+def default_int() -> jnp.dtype:
+    return convert_dtype(int64)
+
+
+def get_default_dtype() -> jnp.dtype:
+    return _default_dtype
+
+
+def set_default_dtype(d) -> None:
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, float32, float64, bfloat16):
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _default_dtype = d
+
+
+def is_floating_point_dtype(d) -> bool:
+    return jnp.issubdtype(convert_dtype(d), jnp.floating)
+
+
+def iinfo(d):
+    return jnp.iinfo(convert_dtype(d))
+
+
+def finfo(d):
+    return jnp.finfo(convert_dtype(d))

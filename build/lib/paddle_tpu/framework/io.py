@@ -1,0 +1,89 @@
+"""``paddle.save`` / ``paddle.load`` (reference: `python/paddle/framework/io.py:725,967`).
+
+Pickle-based object serialization; Tensors are stored as numpy arrays (with
+dtype preserved, including bfloat16 via ml_dtypes) and restored as Tensors.
+Distributed sharded checkpointing lives in `paddle_tpu.distributed.checkpoint`.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from .tensor import Tensor, Parameter
+
+__all__ = ["save", "load"]
+
+_PROTO = 4
+
+
+class _TensorPayload:
+    """Pickle-stable tensor container (numpy buffer + dtype string + flags)."""
+
+    __slots__ = ("buffer", "dtype", "shape", "stop_gradient", "is_param", "name")
+
+    def __init__(self, t: Tensor):
+        arr = np.asarray(t._data)
+        self.dtype = str(t.dtype)
+        if arr.dtype == jnp.bfloat16:
+            arr = arr.view(np.uint16)
+        self.buffer = arr
+        self.shape = tuple(t.shape)
+        self.stop_gradient = t.stop_gradient
+        self.is_param = isinstance(t, Parameter)
+        self.name = t.name
+
+    def restore(self) -> Tensor:
+        arr = self.buffer
+        if self.dtype == "bfloat16":
+            arr = jnp.asarray(arr).view(jnp.bfloat16)
+        else:
+            arr = jnp.asarray(arr)
+        if self.is_param:
+            t = Parameter(arr, trainable=not self.stop_gradient)
+        else:
+            t = Tensor(arr, stop_gradient=self.stop_gradient)
+        t.name = self.name
+        return t
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        t = obj.restore()
+        return t.numpy() if return_numpy else t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTO, **configs):
+    """Save a Tensor / state_dict / nested object to ``path``."""
+    if hasattr(obj, "state_dict") and not isinstance(obj, dict):
+        obj = obj.state_dict()
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """Load an object saved with ``save``."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,487 @@
+"""Eager Tensor with define-by-run autograd on top of JAX.
+
+This is the TPU-native analog of the reference's eager layer
+(`paddle/fluid/eager`): every op call records a grad node
+(`GradNodeBase`, `fluid/eager/grad_node_info.h:197`) whose backward fn is
+obtained from ``jax.vjp`` instead of hand-written grad kernels — JAX's AD is
+the single source of truth for gradients, mirroring how the reference
+generates grad nodes from `backward.yaml` rather than writing them by hand.
+
+Design notes (TPU-first):
+- ``Tensor`` wraps a ``jax.Array`` (committed to the default device). All
+  compute lowers through jax.numpy → XLA, so eager ops are still
+  XLA-executed (dispatched one at a time, like the reference's eager mode
+  dispatching one CUDA kernel at a time).
+- The same tape works under ``jax.jit`` tracing: ``paddle_tpu.jit.to_static``
+  swaps Tensor payloads for tracers and traces imperative user code
+  (forward + ``loss.backward()`` + ``opt.step()``) into a single pure XLA
+  computation — the analog of the reference's dy2static/SOT capture
+  (`python/paddle/jit/`), with no bytecode tricks needed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+from . import amp_state
+from . import enforce
+from .. import flags
+
+__all__ = ["Tensor", "Parameter", "GradNode", "is_grad_enabled", "set_grad_enabled",
+           "no_grad", "enable_grad", "run_op", "to_tensor"]
+
+# ---------------------------------------------------------------------------
+# grad-mode switch (reference: tracer has_grad / paddle.no_grad)
+# ---------------------------------------------------------------------------
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    prev = _grad_enabled
+    _grad_enabled = bool(mode)
+    return prev
+
+
+class _GradModeGuard:
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _GradModeGuard(self._mode):
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+def no_grad(fn=None):
+    """``paddle.no_grad`` — usable as context manager or decorator."""
+    guard = _GradModeGuard(False)
+    return guard if fn is None else guard(fn)
+
+
+def enable_grad(fn=None):
+    guard = _GradModeGuard(True)
+    return guard if fn is None else guard(fn)
+
+
+# ---------------------------------------------------------------------------
+# Grad node: one per recorded op (reference: GradNodeBase)
+# ---------------------------------------------------------------------------
+class GradNode:
+    __slots__ = ("name", "vjp_fn", "inputs", "n_outputs", "out_avals",
+                 "pure_fn", "replay_fn", "__weakref__")
+
+    def __init__(self, name, vjp_fn, inputs, n_outputs, out_avals,
+                 pure_fn=None, replay_fn=None):
+        self.name = name
+        self.vjp_fn = vjp_fn          # tuple-of-cotangents -> tuple-of-input-grads
+        self.inputs = inputs          # list[Tensor] — differentiable inputs
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals    # [(shape, dtype)] for zero-cotangent fill
+        self.pure_fn = pure_fn        # pure fn of diff inputs (create_graph replay)
+        self.replay_fn = replay_fn    # Tensor-level backward (PyLayer create_graph)
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_in={len(self.inputs)} n_out={self.n_outputs}>"
+
+
+def _check_nan_inf(name, arrays):
+    for a in arrays:
+        if isinstance(a, jax.Array) and jnp.issubdtype(a.dtype, jnp.floating):
+            if not isinstance(a, jax.core.Tracer) and not bool(jnp.isfinite(a).all()):
+                raise FloatingPointError(
+                    f"Operator '{name}' output contains NaN/Inf "
+                    f"(FLAGS_check_nan_inf is set).")
+
+
+# ---------------------------------------------------------------------------
+# The generic eager-op executor (analog of the generated `*_ad_func` +
+# PHI API dispatch path, SURVEY §3.1 steps 2-6).
+# ---------------------------------------------------------------------------
+def run_op(name, fn, args, kwargs=None, differentiable=True):
+    """Execute op ``fn`` (a pure jax function) on mixed Tensor/array args.
+
+    Records a GradNode when grad is enabled and any input Tensor requires
+    grad. Returns Tensor or tuple of Tensors, matching fn's output structure.
+    """
+    kwargs = kwargs or {}
+    if amp_state.enabled():
+        fn = amp_state.wrap(name, fn)
+    diff_tensors = []       # Tensors we differentiate w.r.t.
+    spec_args = []          # arg template: ('d', idx) | raw value
+    record = _grad_enabled and differentiable
+
+    def scan(v):
+        if isinstance(v, Tensor):
+            if record and not v.stop_gradient \
+                    and jnp.issubdtype(v._data.dtype, jnp.inexact):
+                diff_tensors.append(v)
+                return ("__diff__", len(diff_tensors) - 1)
+            return v._data
+        if isinstance(v, (list, tuple)) and any(isinstance(e, Tensor) for e in v):
+            return type(v)(scan(e) for e in v)
+        return v
+
+    spec_args = [scan(a) for a in args]
+    spec_kwargs = {k: scan(v) for k, v in kwargs.items()}
+
+    def substitute(template, diff_arrays):
+        def sub(v):
+            if isinstance(v, tuple) and len(v) == 2 and v[0] == "__diff__":
+                return diff_arrays[v[1]]
+            if isinstance(v, (list, tuple)):
+                return type(v)(sub(e) for e in v)
+            return v
+        return [sub(t) for t in template]
+
+    if not diff_tensors:
+        raw_args = substitute(spec_args, [])
+        raw_kwargs = {k: substitute([v], [])[0] for k, v in spec_kwargs.items()}
+        try:
+            out = fn(*raw_args, **raw_kwargs)
+        except Exception as e:
+            raise enforce.attach_op_context(e, name)
+        return _wrap_outputs(name, out, stop_gradient=True)
+
+    def pure(*diff_arrays):
+        raw_args = substitute(spec_args, diff_arrays)
+        raw_kwargs = {k: substitute([v], diff_arrays)[0] for k, v in spec_kwargs.items()}
+        return fn(*raw_args, **raw_kwargs)
+
+    primal_arrays = [t._data for t in diff_tensors]
+    try:
+        out, vjp_fn = jax.vjp(pure, *primal_arrays)
+    except Exception as e:
+        raise enforce.attach_op_context(e, name)
+
+    is_multi = isinstance(out, (tuple, list))
+    outs = list(out) if is_multi else [out]
+    out_avals = [(o.shape, o.dtype) for o in outs]
+    node = GradNode(name, vjp_fn, diff_tensors, len(outs), out_avals,
+                    pure_fn=pure)
+
+    result = _wrap_outputs(name, out, stop_gradient=False)
+    rts = result if isinstance(result, tuple) else (result,)
+    for i, t in enumerate(rts):
+        if jnp.issubdtype(t._data.dtype, jnp.inexact):
+            t._node = node
+            t._out_index = i
+    return result
+
+
+# observers called as observer(op_name, raw_output) after each op —
+# the instrumentation seam the reference codegens into eager ops
+# (consumed by paddle_tpu.amp.debugging operator-stats collection)
+op_observers = []
+
+
+def _wrap_outputs(name, out, stop_gradient):
+    if flags.flag("check_nan_inf"):
+        _check_nan_inf(name, out if isinstance(out, (tuple, list)) else [out])
+    for obs in op_observers:
+        obs(name, out)
+    if isinstance(out, (tuple, list)):
+        return tuple(
+            Tensor(o, stop_gradient=stop_gradient or not jnp.issubdtype(o.dtype, jnp.inexact))
+            for o in out)
+    return Tensor(out, stop_gradient=stop_gradient or not jnp.issubdtype(out.dtype, jnp.inexact))
+
+
+# ---------------------------------------------------------------------------
+# Tensor
+# ---------------------------------------------------------------------------
+class Tensor:
+    """Eager tensor. API mirrors ``paddle.Tensor``
+    (reference: `paddle/fluid/pybind/eager_method.cc`)."""
+
+    # let Tensor.__r*__ win over numpy array ops
+    __array_priority__ = 100
+
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
+                 "name", "persistable", "trainable", "_backward_hooks",
+                 "__weakref__", "is_dist", "_placements", "_process_mesh")
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array) and not isinstance(data, jax.core.Tracer):
+            np_data = np.asarray(data)
+            if dtype is None and np_data.dtype == np.float64:
+                np_data = np_data.astype(dtypes.get_default_dtype())
+            data = jnp.asarray(np_data, dtype=dtypes.convert_dtype(dtype))
+        elif dtype is not None:
+            d = dtypes.convert_dtype(dtype)
+            if data.dtype != d:
+                data = data.astype(d)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._backward_hooks = None
+        self.is_dist = False
+        self._placements = None
+        self._process_mesh = None
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def data(self):
+        return self
+
+    @data.setter
+    def data(self, value):
+        self._data = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        try:
+            devs = self._data.devices()
+            return next(iter(devs))
+        except Exception:
+            return "traced"
+
+    @property
+    def T(self):
+        from ..tensor import manipulation
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *idx):
+        a = self._data
+        if idx:
+            return a[idx].item() if len(idx) > 1 else a.reshape(-1)[idx[0]].item()
+        return a.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        from ..tensor import manipulation
+        return manipulation.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def clone(self):
+        from ..tensor import creation
+        return creation.assign(self)
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        """Move/cast: accepts dtype and/or device specs like ``paddle.Tensor.to``.
+
+        Device moves are recorded on the tape (``jax.device_put`` is
+        differentiable), so ``w.to('cpu')`` keeps gradient flow back to ``w``.
+        """
+        from ..device import _resolve_device, _looks_like_device
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if a is None:
+                continue
+            if _looks_like_device(a):
+                dev = _resolve_device(str(a))
+                out = run_op("to_device",
+                             lambda arr: jax.device_put(arr, dev), (out,))
+            else:
+                try:
+                    out = out.astype(a)
+                except (TypeError, ValueError):
+                    pass
+        return out
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from . import autograd_engine
+        autograd_engine.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data), stop_gradient=True)
+        else:
+            self.grad = None
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def register_hook(self, hook):
+        if self._backward_hooks is None:
+            self._backward_hooks = []
+        self._backward_hooks.append(hook)
+
+        class _Handle:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+        return _Handle(self._backward_hooks, hook)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def set_value(self, value):
+        """In-place payload replacement (optimizer updates use this)."""
+        if isinstance(value, Tensor):
+            value = value._data
+        else:
+            value = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}")
+        self._data = value.astype(self._data.dtype)
+        return self
+
+    def get_tensor(self):
+        return self
+
+    # -- python protocol ----------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        body = repr(self._data) if isinstance(self._data, jax.core.Tracer) \
+            else np.array2string(np.asarray(self._data), precision=6, separator=", ")
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_str},\n"
+                f"       {body})")
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __getitem__(self, idx):
+        from ..tensor import manipulation
+        return manipulation._getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from ..tensor import manipulation
+        manipulation._setitem(self, idx, value)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # arithmetic operators are attached by paddle_tpu.tensor at import time
+    # (mirrors the reference's monkey-patching in
+    #  python/paddle/base/dygraph/math_op_patch.py)
+
+
+class Parameter(Tensor):
+    """Trainable parameter (reference: ``paddle.base.framework.Parameter``)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """``paddle.to_tensor`` equivalent."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

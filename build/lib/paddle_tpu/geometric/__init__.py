@@ -1,0 +1,125 @@
+"""``paddle.geometric`` — graph message passing.
+
+Reference: `python/paddle/geometric/message_passing/send_recv.py`
+(``send_u_recv``/``send_ue_recv``/``send_uv``) and `math.py`
+(``segment_sum/mean/max/min``). TPU-native backend: ``jax.ops.segment_*``
+— XLA lowers segment reductions to sorted scatter-adds that ride the
+VPU; gather/scatter indices are data, so everything traces under jit and
+differentiates through the tape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, run_op
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]
+
+_SEG = {
+    "sum": jax.ops.segment_sum,
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+_COMBINE = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}
+
+
+def _reduce(msgs, ids, num_segments, op):
+    """THE segment reduction (shared by every public op): paddle
+    semantics — mean divides by counts, empty max/min segments fill 0
+    (jax fills +-inf). Counts only computed when the op needs them."""
+    def counts():
+        c = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids,
+                                num_segments)
+        return c.reshape((-1,) + (1,) * (msgs.ndim - 1))
+
+    if op == "mean":
+        return jax.ops.segment_sum(msgs, ids, num_segments) \
+            / jnp.maximum(counts(), 1.0)
+    out = _SEG[op](msgs, ids, num_segments)
+    if op in ("max", "min"):
+        out = jnp.where(counts() == 0, jnp.zeros_like(out), out)
+    return out
+
+
+def _segment(name, data, ids, num_segments):
+    return run_op(f"segment_{name}",
+                  lambda x, i: _reduce(x, i, num_segments, name),
+                  (data, ids))
+
+
+def segment_sum(data, segment_ids, num_segments=None, name=None):
+    """Reference geometric/math.py segment_sum."""
+    n = _num_segments(segment_ids, num_segments)
+    return _segment("sum", data, segment_ids, n)
+
+
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    n = _num_segments(segment_ids, num_segments)
+    return _segment("mean", data, segment_ids, n)
+
+
+def segment_max(data, segment_ids, num_segments=None, name=None):
+    n = _num_segments(segment_ids, num_segments)
+    return _segment("max", data, segment_ids, n)
+
+
+def segment_min(data, segment_ids, num_segments=None, name=None):
+    n = _num_segments(segment_ids, num_segments)
+    return _segment("min", data, segment_ids, n)
+
+
+def _num_segments(ids, num_segments):
+    if num_segments is not None:
+        return int(num_segments)
+    arr = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+    return int(arr.max()) + 1   # eager-only convenience; pass it under jit
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather features at ``src_index``, reduce onto ``dst_index``
+    (reference send_recv.py send_u_recv)."""
+    n = out_size if out_size is not None else (
+        x.shape[0] if isinstance(x, Tensor) else jnp.asarray(x).shape[0])
+
+    def fn(xa, s, d):
+        return _reduce(xa[s], d, n, reduce_op)
+
+    return run_op("send_u_recv", fn, (x, src_index, dst_index))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Node features combined with edge features, then reduced
+    (reference send_ue_recv)."""
+    n = out_size if out_size is not None else (
+        x.shape[0] if isinstance(x, Tensor) else jnp.asarray(x).shape[0])
+    combine = _COMBINE[message_op]
+
+    def fn(xa, ya, s, d):
+        return _reduce(combine(xa[s], ya), d, n, reduce_op)
+
+    return run_op("send_ue_recv", fn, (x, y, src_index, dst_index))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Edge messages from both endpoints (reference send_uv)."""
+    combine = _COMBINE[message_op]
+
+    def fn(xa, ya, s, d):
+        return combine(xa[s], ya[d])
+
+    return run_op("send_uv", fn, (x, y, src_index, dst_index))
+
+
+def segment_pool(data, segment_ids, pool_type="sum", name=None):
+    """Legacy unified segment op (reference op `segment_pool`):
+    dispatches to segment_{sum,mean,max,min}."""
+    fn = {"sum": segment_sum, "mean": segment_mean, "max": segment_max,
+          "min": segment_min}[pool_type.lower()]
+    return fn(data, segment_ids)

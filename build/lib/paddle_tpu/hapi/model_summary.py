@@ -1,0 +1,155 @@
+"""``paddle.summary`` / ``paddle.flops`` (reference:
+`python/paddle/hapi/model_summary.py`, `hapi/dynamic_flops.py`).
+
+Both run one forward pass with forward-post hooks on every leaf layer,
+collecting output shapes / parameter counts (summary) and applying
+per-layer-type FLOP rules (flops). Layer-type coverage mirrors the
+reference's `register_hooks` table: conv, linear, norms, pooling,
+activations (zero-cost entries count as 0 but still print).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..framework.tensor import Tensor
+
+__all__ = ["summary", "flops"]
+
+
+def _num_params(layer):
+    return sum(int(np.prod(p.shape))
+               for p in layer.parameters(include_sublayers=False))
+
+
+def _run_with_hooks(net, input_size, dtype, per_layer):
+    """Forward random input through net with a post-hook on each leaf
+    layer calling ``per_layer(layer, name, inputs, outputs)``."""
+    if isinstance(input_size, (list, tuple)) and input_size \
+            and isinstance(input_size[0], (list, tuple)):
+        shapes = list(input_size)
+    else:
+        shapes = [tuple(input_size)]
+    xs = [Tensor(np.zeros(s, dtype or "float32")) for s in shapes]
+    removes = []
+    try:
+        for name, sub in net.named_sublayers(include_self=False):
+            if list(sub.children()):
+                continue  # hook leaves only
+
+            def hook(layer, inputs, outputs, _name=name):
+                per_layer(layer, _name, inputs, outputs)
+
+            removes.append(sub.register_forward_post_hook(hook))
+        was_training = net.training
+        net.eval()
+        try:
+            net(*xs)
+        finally:
+            if was_training:
+                net.train()
+    finally:
+        for r in removes:
+            r.remove()
+
+
+def summary(net, input_size, dtypes=None, input=None):
+    """Print a per-layer table (type, output shape, params); returns
+    ``{'total_params': ..., 'trainable_params': ...}``."""
+    rows = []
+
+    def per_layer(layer, name, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) \
+            else outputs
+        shape = list(out.shape) if hasattr(out, "shape") else "-"
+        rows.append((f"{type(layer).__name__}-{len(rows) + 1}",
+                     str(shape), _num_params(layer)))
+
+    if input is not None:
+        raise NotImplementedError(
+            "summary(input=...) is not supported; pass input_size")
+    _run_with_hooks(net, input_size, dtypes, per_layer)
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if p.trainable)
+    w1 = max([len(r[0]) for r in rows] + [12])
+    w2 = max([len(r[1]) for r in rows] + [14])
+    sep = "-" * (w1 + w2 + 14)
+    print(sep)
+    print(f"{'Layer (type)':<{w1}}  {'Output Shape':<{w2}}  {'Params':>10}")
+    print("=" * (w1 + w2 + 14))
+    for r in rows:
+        print(f"{r[0]:<{w1}}  {r[1]:<{w2}}  {r[2]:>10,}")
+    print(sep)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(sep)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def _conv_flops(layer, inputs, out):
+    # MACs = out_elems * (Cin/groups) * prod(kernel); FLOPs = 2 * MACs
+    k = np.prod(layer._kernel_size) if hasattr(layer, "_kernel_size") \
+        else np.prod(layer.weight.shape[2:])
+    cin = layer.weight.shape[1]  # already Cin/groups in the weight
+    out_elems = int(np.prod(out.shape))
+    return 2 * out_elems * int(cin) * int(k)
+
+
+def _linear_flops(layer, inputs, out):
+    in_f, out_f = layer.weight.shape
+    batch = int(np.prod(out.shape)) // int(out_f)
+    return 2 * batch * int(in_f) * int(out_f)
+
+
+def _norm_flops(layer, inputs, out):
+    return 2 * int(np.prod(out.shape))
+
+
+def _pool_flops(layer, inputs, out):
+    return int(np.prod(out.shape))
+
+
+_FLOP_RULES = [
+    ((nn.Conv1D, nn.Conv2D, nn.Conv3D), _conv_flops),
+    ((nn.Linear,), _linear_flops),
+    ((nn.BatchNorm1D, nn.BatchNorm2D, nn.BatchNorm3D, nn.LayerNorm,
+      getattr(nn, "GroupNorm", ()), getattr(nn, "RMSNorm", ())),
+     _norm_flops),
+    ((nn.MaxPool2D, nn.AvgPool2D, nn.AdaptiveAvgPool2D), _pool_flops),
+]
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total forward FLOPs for one batch of ``input_size`` (reference
+    `hapi/dynamic_flops.py:flops`). ``custom_ops`` maps layer TYPE to
+    ``fn(layer, inputs, output) -> flops``."""
+    total = [0]
+    detail = []
+
+    def per_layer(layer, name, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) \
+            else outputs
+        fn = None
+        if custom_ops:
+            fn = custom_ops.get(type(layer))
+        if fn is None:
+            for types, rule in _FLOP_RULES:
+                ts = tuple(t for t in (types if isinstance(types, tuple)
+                                       else (types,)) if t != ())
+                if isinstance(layer, ts):
+                    fn = rule
+                    break
+        n = int(fn(layer, inputs, out)) if fn else 0
+        total[0] += n
+        detail.append((name, type(layer).__name__, n))
+
+    _run_with_hooks(net, input_size, None, per_layer)
+    if print_detail:
+        for name, t, n in detail:
+            print(f"{name:<40} {t:<20} {n:>14,}")
+        print(f"{'Total':<61} {total[0]:>14,}")
+    return total[0]

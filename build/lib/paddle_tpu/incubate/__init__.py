@@ -1,0 +1,16 @@
+"""``paddle_tpu.incubate`` — incubating APIs (fused transformer ops, MoE).
+
+Reference surface: `python/paddle/incubate/` (fused functional ops in
+`incubate/nn/functional/`, MoE under `incubate/distributed/models/moe/`).
+"""
+
+from . import nn  # noqa: F401
+from . import moe  # noqa: F401
+from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
+from . import autograd  # noqa: F401
+
+__all__ = ["nn", "moe"]
+
+from ..geometric import (  # noqa: F401  (reference incubate.segment_*)
+    segment_sum, segment_mean, segment_max, segment_min)

@@ -1,0 +1,126 @@
+"""ASP — automatic n:m structured sparsity (reference:
+`python/paddle/incubate/asp/asp.py`, `utils.py`).
+
+Workflow identical to the reference: ``prune_model`` computes n:m masks
+over supported weights (largest-|w| n of every m consecutive elements
+along the contraction dim) and applies them; ``decorate`` wraps an
+optimizer so the masks are re-applied after every step, keeping pruned
+positions at zero through sparse training. TPU note: XLA has no 2:4
+sparse tensor-core path — the masks' value here is model-compression
+semantics (and forward-compatibility with sparsity-aware hardware), so
+the implementation is pure mask bookkeeping over ordinary dense ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...framework.tensor import Tensor
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "add_supported_layer", "get_mask_1d", "check_mask_1d"]
+
+# param name -> numpy mask; populated by prune_model, consumed by decorate
+_masks: dict[int, tuple] = {}
+_excluded_param_names: set[str] = set()
+_supported_types = {nn.Linear}
+
+
+def calculate_density(x):
+    """Fraction of non-zero entries (reference `asp.py:calculate_density`)."""
+    arr = np.asarray(getattr(x, "_data", x))
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the ``n`` largest-|.| of every ``m`` consecutive elements of
+    each row (reference `utils.py:get_mask_1d`). mat: 2-D numpy."""
+    mat = np.asarray(mat)
+    rows, cols = mat.shape
+    if cols % m:
+        raise ValueError(f"columns ({cols}) not divisible by m={m}")
+    groups = np.abs(mat).reshape(rows, cols // m, m)
+    order = np.argsort(groups, axis=-1)          # ascending
+    mask = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[..., m - n:], True, axis=-1)
+    return mask.reshape(rows, cols).astype(mat.dtype)
+
+
+def check_mask_1d(mat, n, m):
+    """True iff every m-chunk of every row has at most n non-zeros."""
+    mat = np.asarray(mat)
+    rows, cols = mat.shape
+    if cols % m:
+        return False
+    chunks = mat.reshape(rows, cols // m, m)
+    return bool((np.count_nonzero(chunks, axis=-1) <= n).all())
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded_param_names.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded_param_names.clear()
+
+
+def add_supported_layer(layer_type):
+    """Register an additional nn.Layer subclass whose ``weight`` should
+    be pruned (reference `supported_layer_list.py`)."""
+    _supported_types.add(layer_type)
+
+
+def _iter_prunable(model):
+    for name, sub in model.named_sublayers(include_self=True):
+        if type(sub) in _supported_types \
+                and getattr(sub, "weight", None) is not None:
+            w = sub.weight
+            pname = w.name or f"{name}.weight"
+            if pname not in _excluded_param_names:
+                yield pname, sub, w
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute + apply n:m masks over the model's supported weights.
+    Returns {param_name: density_after} (reference returns the masks via
+    the internal ASPHelper; the density map is more useful here)."""
+    if mask_algo not in ("mask_1d",):
+        raise ValueError(
+            f"mask_algo {mask_algo!r} not supported (mask_1d only: 2-D "
+            "permutation search has no TPU payoff)")
+    out = {}
+    for pname, _layer, w in _iter_prunable(model):
+        arr = np.asarray(w._data)
+        if arr.ndim != 2 or arr.shape[0] % m:
+            continue
+        # Linear weight is [in, out]; y = x @ W contracts over rows, so
+        # the n:m pattern runs down each column -> mask the transpose
+        mask = get_mask_1d(arr.T, n, m).T
+        w.set_value((arr * mask).astype(arr.dtype))
+        if with_mask:
+            _masks[pname] = (w, mask)
+        out[pname] = calculate_density(arr * mask)
+    return out
+
+
+def decorate(optimizer):
+    """Wrap ``optimizer.step`` to re-apply the pruning masks after each
+    update (reference `asp.py:decorate` / OptimizerWithSparsityGuarantee)."""
+    inner_step = optimizer.step
+
+    def masked_step(*args, **kwargs):
+        result = inner_step(*args, **kwargs)
+        for w, mask in _masks.values():
+            w.set_value(np.asarray(w._data) * mask)
+        return result
+
+    optimizer.step = masked_step
+    return optimizer
+
+
+def _reset_state():
+    """Test hook: forget masks + exclusions."""
+    _masks.clear()
+    _excluded_param_names.clear()

@@ -1,0 +1,150 @@
+"""Functional autograd API (reference:
+`python/paddle/incubate/autograd/` — jvp/vjp/Jacobian/Hessian + the
+prim flags).
+
+TPU-native: these are direct surfaces over ``jax.jvp``/``jax.vjp`` on
+the pure function extracted from the Tensor computation — forward-mode
+AD is native here (the reference lowers to primitive ops to get it).
+``enable_prim`` is therefore a no-op that reports True: everything is
+always traced to primitives (StableHLO) by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled"]
+
+
+def _unwrap(xs):
+    if isinstance(xs, (list, tuple)):
+        return type(xs)(_unwrap(x) for x in xs)
+    return xs._data if isinstance(xs, Tensor) else jnp.asarray(xs)
+
+
+def _wrap(xs):
+    if isinstance(xs, (list, tuple)):
+        return type(xs)(_wrap(x) for x in xs)
+    return Tensor(xs)
+
+
+def _as_pure(func):
+    def pure(*arrays):
+        out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+    return pure
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (func(xs), J @ v) (reference
+    `incubate/autograd/functional.py:jvp`)."""
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        v = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [_unwrap(t) for t in v]
+    out, tangent_out = jax.jvp(_as_pure(func), tuple(arrays),
+                               tuple(tangents))
+    return _wrap(out), _wrap(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: returns (func(xs), v^T @ J) (reference
+    `functional.py:vjp`)."""
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs]
+    out, vjp_fn = jax.vjp(_as_pure(func), *arrays)
+    if v is None:
+        cotangents = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v = v if isinstance(v, (list, tuple)) else [v]
+        cotangents = tuple(_unwrap(t) for t in v)
+        if not isinstance(out, tuple):
+            cotangents = cotangents[0]
+    grads = vjp_fn(cotangents)
+    grads = grads[0] if len(grads) == 1 else list(grads)
+    return _wrap(out), _wrap(grads)
+
+
+class Jacobian:
+    """Lazy full Jacobian (reference `functional.py:Jacobian`): index or
+    materialize via ``[:]``; rows computed with jax.jacfwd."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        if len(xs) != 1:
+            raise NotImplementedError(
+                "Jacobian over multiple inputs: pass one stacked tensor")
+        self._x = _unwrap(xs[0])
+        self._mat = None
+        self._func = func
+        self._batched = is_batched
+
+    def _materialize(self):
+        if self._mat is None:
+            jac = jax.jacfwd(_as_pure(self._func))(self._x)
+            if self._batched:
+                # [B, out..., B, in...] -> diagonal over the batch
+                b = self._x.shape[0]
+                jac = jnp.stack([jac[i, ..., i, :] for i in range(b)])
+            else:
+                jac = jac.reshape(-1, int(jnp.size(self._x)))
+            self._mat = jac
+        return self._mat
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx])
+
+    @property
+    def shape(self):
+        return list(self._materialize().shape)
+
+
+class Hessian:
+    """Lazy Hessian of a scalar function (reference
+    `functional.py:Hessian`)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError("batched Hessian not supported")
+        xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        self._x = _unwrap(xs[0])
+        self._func = func
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is None:
+            def scalar(x):
+                out = _as_pure(self._func)(x)
+                return jnp.reshape(out, ())
+            h = jax.hessian(scalar)(self._x)
+            n = int(jnp.size(self._x))
+            self._mat = h.reshape(n, n)
+        return self._mat
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx])
+
+    @property
+    def shape(self):
+        return list(self._materialize().shape)
+
+
+def enable_prim():
+    """No-op: this framework always traces to primitives (StableHLO)."""
+
+
+def disable_prim():
+    """No-op (see enable_prim)."""
+
+
+def prim_enabled():
+    return True

@@ -1,0 +1,102 @@
+"""Kernel/layout/dataloader auto-tuning config (reference:
+`python/paddle/incubate/autotune.py:set_config` over
+`phi/kernels/autotune/`).
+
+TPU mapping of the three knobs:
+- kernel: XLA's own autotuner owns GEMM/conv algorithm choice; the knob
+  here selects the Pallas-vs-XLA attention path empirically — when
+  enabled, the first ``flash_attention``-eligible call of each shape
+  times both paths and caches the winner (the reference's exhaustive-
+  search-then-cache semantics at our kernel boundary).
+- layout: a no-op acknowledged in the returned status — XLA chooses
+  layouts during compilation; there is no NCHW/NHWC choice to make.
+- dataloader: :func:`tune_num_workers` times a DataLoader over candidate
+  worker counts and returns the fastest (call it when the domain is
+  enabled; automatic in ``hapi.Model.fit`` is not wired — explicit
+  beats implicit for a tuning probe that consumes real batches).
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["set_config", "get_config", "kernel_choice",
+           "tune_num_workers"]
+
+_config = {
+    "kernel": {"enable": False, "tuning_range": [1, 10]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False},
+}
+_kernel_cache: dict = {}
+
+
+def set_config(config=None):
+    """Enable/disable auto-tuning domains (dict, json path, or None for
+    all-on, matching the reference)."""
+    if config is None:
+        for v in _config.values():
+            v["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    for key, val in config.items():
+        if key not in _config:
+            raise ValueError(
+                f"unknown autotune domain {key!r}; expected one of "
+                f"{sorted(_config)}")
+        _config[key].update(val)
+
+
+def get_config():
+    return {k: dict(v) for k, v in _config.items()}
+
+
+def kernel_choice(key, candidates, args):
+    """Time ``candidates`` ({name: fn}) once for ``key`` and cache the
+    winner; subsequent calls dispatch directly. Used by the attention
+    dispatch seam when kernel tuning is enabled."""
+    import time
+
+    import jax
+
+    if not _config["kernel"]["enable"]:
+        raise RuntimeError("kernel autotuning is disabled")
+    chosen = _kernel_cache.get(key)
+    if chosen is None:
+        timings = {}
+        for name, fn in candidates.items():
+            out = fn(*args)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.block_until_ready(out)
+            timings[name] = time.perf_counter() - t0
+        chosen = min(timings, key=timings.get)
+        _kernel_cache[key] = chosen
+    return chosen, candidates[chosen]
+
+
+def tune_num_workers(dataset, batch_size, candidates=(0, 2, 4),
+                     probe_batches=8, **loader_kwargs):
+    """Time ``probe_batches`` batches per candidate worker count and
+    return the fastest (the reference dataloader-tuning knob)."""
+    import itertools
+    import time
+
+    from ..io import DataLoader
+
+    if not _config["dataloader"]["enable"]:
+        raise RuntimeError("dataloader autotuning is disabled")
+    timings = {}
+    for n in candidates:
+        loader = DataLoader(dataset, batch_size=batch_size, num_workers=n,
+                            **loader_kwargs)
+        it = iter(loader)
+        next(it)  # spin-up cost excluded
+        t0 = time.perf_counter()
+        for _ in itertools.islice(it, probe_batches):
+            pass
+        timings[n] = time.perf_counter() - t0
+    return min(timings, key=timings.get)

@@ -1,0 +1,10 @@
+from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedLinear, FusedDropoutAdd, FusedBiasDropoutResidualLayerNorm,
+    FusedMultiHeadAttention, FusedFeedForward,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = ["functional", "FusedLinear", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer"]

@@ -1,0 +1,280 @@
+"""Fused transformer functionals.
+
+Reference capability: `python/paddle/incubate/nn/functional/` — `swiglu.py`,
+`fused_rms_norm.py`, `fused_layer_norm.py`, `fused_rotary_position_embedding`
+(CUDA kernels under `paddle/phi/kernels/fusion/gpu/`). TPU-native design:
+each "fused" op here is a single pure jax function executed through the
+autograd tape (`run_op`), so under ``jit``/``to_static`` XLA fuses the whole
+chain into one kernel on the VPU/MXU — the fusion the reference hand-writes
+in CUDA falls out of the compiler. Normalizations accumulate in fp32
+(TPU numerics idiom) and cast back to the input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.tensor import run_op
+from ....framework import random as frandom
+from ....tensor.registry import OPS
+
+# raw jnp-level normalization cores (single source of the norm math,
+# shared with nn.functional.layer_norm / rms_norm)
+_rms_core = None
+_ln_core = None
+
+
+def _norm_cores():
+    global _rms_core, _ln_core
+    if _rms_core is None:
+        from ....nn import functional as _  # ensure norm ops registered
+        _rms_core = OPS["rms_norm"]["fn"]
+        _ln_core = OPS["layer_norm"]["fn"]
+    return _rms_core, _ln_core
+
+__all__ = [
+    "swiglu",
+    "fused_rms_norm",
+    "fused_layer_norm",
+    "fused_rotary_position_embedding",
+    "fused_dropout_add",
+    "fused_linear",
+    "fused_bias_act",
+]
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU: ``silu(x) * y``; with ``y=None``, ``x`` is split in half on
+    the last axis (reference: `incubate/nn/functional/swiglu.py`)."""
+    if y is None:
+        def fn(x_):
+            a, b = jnp.split(x_, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        return run_op("swiglu", fn, (x,))
+
+    def fn(x_, y_):
+        return jax.nn.silu(x_) * y_
+    return run_op("swiglu", fn, (x, y))
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None, name=None):
+    """RMSNorm with optional pre-norm residual add (reference:
+    `incubate/nn/functional/fused_rms_norm.py`).
+
+    Computes ``out = rms_norm(x + bias + residual)``; returns ``out`` or
+    ``(out, residual_out)`` when ``residual`` is given (residual_out is the
+    pre-norm sum, fed to the next block's residual stream).
+    """
+    axes = begin_norm_axis
+    rms_core, _ = _norm_cores()
+
+    def fn(x_, w_, b_, bias_, res_):
+        h = x_
+        if bias_ is not None:
+            h = h + bias_
+        if res_ is not None:
+            h = h + res_
+        red = -1 if axes in (-1, h.ndim - 1) else tuple(range(axes, h.ndim))
+        out = rms_core(h, weight=w_, epsilon=epsilon, bias=b_, axis=red)
+        if res_ is not None:
+            return out, h.astype(x_.dtype)
+        return out
+
+    return run_op("fused_rms_norm", fn, (x, norm_weight, norm_bias, bias,
+                                         residual))
+
+
+def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, name=None):
+    """LayerNorm with optional pre-norm residual add (reference:
+    `incubate/nn/functional/fused_layer_norm.py`). Same return convention
+    as :func:`fused_rms_norm`."""
+    axes = begin_norm_axis
+    _, ln_core = _norm_cores()
+
+    def fn(x_, w_, b_, bias_, res_):
+        h = x_
+        if bias_ is not None:
+            h = h + bias_
+        if res_ is not None:
+            h = h + res_
+        start = axes if axes != -1 else h.ndim - 1
+        normalized_shape = list(h.shape[start:])
+        out = ln_core(h, normalized_shape, weight=w_, bias=b_,
+                      epsilon=epsilon)
+        if res_ is not None:
+            return out, h.astype(x_.dtype)
+        return out
+
+    return run_op("fused_layer_norm", fn, (x, norm_weight, norm_bias, bias,
+                                           residual))
+
+
+def _default_sin_cos(seq_len, head_dim, base=10000.0):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)                     # [S, D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)     # [S, D]
+    return jnp.sin(emb), jnp.cos(emb)
+
+
+def _rotate_half(x):
+    a, b = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-b, a], axis=-1)
+
+
+def _apply_rope(x, sin_e, cos_e, neox):
+    """x: [B, S, H, D]; sin_e/cos_e already expanded to a shape
+    broadcastable against it ([*, S, 1, D], fp32). Rotation runs in fp32
+    and casts back, so bf16 activations stay bf16."""
+    xf = x.astype(jnp.float32)
+    if neox:
+        out = xf * cos_e + _rotate_half(xf) * sin_e
+    else:
+        # GPT-J interleaved style: pairs (x0,x1),(x2,x3),...
+        half = sin_e.shape[-1] // 2
+        s_, c_ = sin_e[..., :half], cos_e[..., :half]
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        out = jnp.stack([x1 * c_ - x2 * s_, x2 * c_ + x1 * s_],
+                        axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0,
+                                    name=None):
+    """Rotary position embedding applied to q/k (v passes through untouched,
+    matching the reference's tuple return). Inputs [B, S, H, D].
+
+    Reference: `python/paddle/incubate/nn/functional/
+    fused_rotary_position_embedding.py` (CUDA kernel
+    `phi/kernels/fusion/gpu/fused_rope_kernel.cu`). On TPU the rotation is
+    an elementwise chain XLA fuses into the surrounding matmuls.
+    """
+    if time_major:
+        raise NotImplementedError(
+            "fused_rotary_position_embedding: time_major=True is not "
+            "supported; pass batch-major [B, S, H, D] inputs")
+    neox = bool(use_neox_rotary_style)
+    base = float(rotary_emb_base)
+
+    def fn(q_, k_, v_, sin_, cos_, pos_):
+        seq_len, head_dim = q_.shape[1], q_.shape[3]
+        if pos_ is not None and (sin_ is None or cos_ is None):
+            # compute angles directly from the positions — no table, so
+            # arbitrary position ids (KV-cache decode) never clamp
+            inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                             dtype=jnp.float32) / head_dim))
+            ang = pos_.astype(jnp.float32)[..., None] * inv   # [B, S, D/2]
+            emb = jnp.concatenate([ang, ang], axis=-1)        # [B, S, D]
+            sin_b, cos_b = jnp.sin(emb), jnp.cos(emb)
+        elif pos_ is not None:
+            sin_ = jnp.reshape(sin_, (-1, sin_.shape[-1]))  # accept [1,S,1,D]
+            cos_ = jnp.reshape(cos_, (-1, cos_.shape[-1]))
+            # per-batch gather from the user-provided table: [B, S, D]
+            sin_b = jnp.take(sin_, pos_, axis=0)
+            cos_b = jnp.take(cos_, pos_, axis=0)
+        if pos_ is not None:
+            sin_e = sin_b.astype(jnp.float32)[:, :, None, :]   # [B, S, 1, D]
+            cos_e = cos_b.astype(jnp.float32)[:, :, None, :]
+
+            def app(x):
+                return _apply_rope(x, sin_e, cos_e, neox)
+        else:
+            if sin_ is None or cos_ is None:
+                sin_, cos_ = _default_sin_cos(seq_len, head_dim, base)
+            sin_ = jnp.reshape(sin_, (-1, sin_.shape[-1]))  # accept [1,S,1,D]
+            cos_ = jnp.reshape(cos_, (-1, cos_.shape[-1]))
+            sin_e = sin_[:seq_len].astype(jnp.float32)[None, :, None, :]
+            cos_e = cos_[:seq_len].astype(jnp.float32)[None, :, None, :]
+
+            def app(x):
+                return _apply_rope(x, sin_e, cos_e, neox)
+
+        outs = [app(q_)]
+        if k_ is not None:
+            outs.append(app(k_))
+        if v_ is not None:
+            outs.append(v_)  # untouched
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    out = run_op("fused_rotary_position_embedding", fn,
+                 (q, k, v, sin, cos, position_ids))
+    outs = list(out) if isinstance(out, tuple) else [out]
+    result = [outs.pop(0)]
+    result.append(outs.pop(0) if k is not None else None)
+    result.append(outs.pop(0) if v is not None else None)
+    return tuple(result)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """``dropout(x) + y`` in one fused region (reference:
+    `incubate/nn/functional/fused_dropout_add.py`)."""
+    if not training or p == 0.0:
+        def fn(x_, y_):
+            if mode == "downscale_in_infer" and not training:
+                return x_ * (1.0 - p) + y_
+            return x_ + y_
+        return run_op("fused_dropout_add", fn, (x, y))
+    key = frandom.next_key()
+
+    def fn(x_, y_, k_):
+        keep = jax.random.bernoulli(k_, 1.0 - p, x_.shape)
+        if mode == "upscale_in_train":
+            d = jnp.where(keep, x_ / (1.0 - p), 0.0)
+        else:
+            d = jnp.where(keep, x_, 0.0)
+        return d.astype(x_.dtype) + y_
+
+    return run_op("fused_dropout_add", fn, (x, y, key))
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """matmul + bias epilogue (reference:
+    `incubate/nn/functional/fused_matmul_bias.py`, cublasLt epilogue —
+    on TPU XLA fuses the bias add into the MXU matmul)."""
+    def fn(x_, w_, b_):
+        w_ = w_.T if transpose_weight else w_
+        out = jnp.matmul(x_, w_)
+        if b_ is not None:
+            out = out + b_
+        return out
+    return run_op("fused_linear", fn, (x, weight, bias))
+
+
+_ACTS = {
+    "gelu": jax.nn.gelu,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "identity": lambda x: x,
+    "swiglu": None,  # handled specially
+    "geglu": None,
+}
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", name=None, **kwargs):
+    """bias + activation epilogue (reference:
+    `phi/kernels/fusion/gpu/fused_bias_act_kernel.cu`). ``swiglu``/``geglu``
+    split the last axis in half (gated variants)."""
+    act = act_method.lower()
+    if act not in _ACTS:
+        raise ValueError(f"unsupported act_method {act_method!r}")
+
+    def fn(x_, b_):
+        h = x_ + b_ if b_ is not None else x_
+        if act in ("swiglu", "geglu"):
+            a, g = jnp.split(h, 2, axis=-1)
+            gate = jax.nn.silu(a) if act == "swiglu" else jax.nn.gelu(a)
+            return gate * g
+        return _ACTS[act](h)
+
+    return run_op("fused_bias_act", fn, (x, bias))

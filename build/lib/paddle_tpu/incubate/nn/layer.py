@@ -1,0 +1,225 @@
+"""Fused transformer layer classes (reference:
+`python/paddle/incubate/nn/layer/fused_transformer.py`,
+`fused_linear.py`, `fused_dropout_add.py`).
+
+On TPU the "fusion" is XLA's job — these classes provide the reference's
+layer API over the in-tree fused functionals
+(`paddle_tpu/incubate/nn/functional`) and the Pallas attention dispatch,
+so models written against the incubate fused layers port unchanged while
+the compiler decides the actual kernel grouping.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ... import nn
+from ...nn import functional as F
+from ...nn.initializer import Constant
+from . import functional as FI
+
+__all__ = ["FusedLinear", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer"]
+
+
+class FusedLinear(nn.Layer):
+    """Reference `fused_linear.py:19` (cublasLt epilogue fusion there;
+    XLA fuses bias+gelu into the matmul here)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = [out_features, in_features] if transpose_weight \
+            else [in_features, out_features]
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        w = self.weight.t() if self.transpose_weight else self.weight
+        return FI.fused_linear(x, w, self.bias)
+
+
+class FusedDropoutAdd(nn.Layer):
+    """Reference `fused_dropout_add.py`: out = residual + dropout(x)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return FI.fused_dropout_add(x, y, p=self.p, training=self.training,
+                                    mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """Reference `fused_transformer.py:83`:
+    ``layer_norm(residual + dropout(x + bias))``."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter([embed_dim],
+                                                 attr=bias_attr,
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        h = FI.fused_dropout_add(x + self.linear_bias, residual,
+                                 p=self.dropout_rate,
+                                 training=self.training)
+        return F.layer_norm(h, [self.embed_dim], weight=self.ln_scale,
+                            bias=self.ln_bias, epsilon=self.epsilon)
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """Reference `fused_transformer.py:189`: pre/post-LN self-attention
+    block with fused qkv and the flash-attention dispatch."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if need_weights:
+            raise NotImplementedError(
+                "need_weights=True: the fused path never materializes "
+                "attention probabilities")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        # fused qkv: one [D, 3D] matmul (the fusion the reference's
+        # kernel does; one MXU call here)
+        self.qkv_weight = self.create_parameter(
+            [embed_dim, 3 * embed_dim], attr=qkv_weight_attr)
+        self.qkv_bias = None if qkv_bias_attr is False else \
+            self.create_parameter([3 * embed_dim], attr=qkv_bias_attr,
+                                  is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr)
+        self.linear_bias = None if linear_bias_attr is False else \
+            self.create_parameter([embed_dim], attr=linear_bias_attr,
+                                  is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "cache: use LlamaForCausalLM-style static caches or the "
+                "paged serving engine for decode")
+        residual = query
+        x = query
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.embed_dim], weight=self.pre_ln_scale,
+                             bias=self.pre_ln_bias, epsilon=self.epsilon)
+        qkv = FI.fused_linear(x, self.qkv_weight, self.qkv_bias)
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, training=self.training)
+        out = out.reshape([b, s, self.embed_dim])
+        out = FI.fused_linear(out, self.linear_weight, self.linear_bias)
+        out = FI.fused_dropout_add(out, residual, p=self.dropout_rate,
+                                   training=self.training)
+        if not self.normalize_before:
+            out = F.layer_norm(out, [self.embed_dim], weight=self.ln_scale,
+                               bias=self.ln_bias, epsilon=self.epsilon)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    """Reference `fused_transformer.py:483`: pre/post-LN MLP block."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = dropout_rate if act_dropout_rate is None \
+            else act_dropout_rate
+        self.activation = activation
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter([dim_feedforward],
+                                                  attr=linear1_bias_attr,
+                                                  is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter([d_model],
+                                                  attr=linear2_bias_attr,
+                                                  is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = src
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.d_model], weight=self.ln_scale,
+                             bias=self.ln_bias, epsilon=self.epsilon)
+        x = FI.fused_bias_act(x @ self.linear1_weight, self.linear1_bias,
+                              act_method=self.activation)
+        x = F.dropout(x, p=self.act_dropout_rate, training=self.training)
+        x = FI.fused_linear(x, self.linear2_weight, self.linear2_bias)
+        x = FI.fused_dropout_add(x, residual, p=self.dropout_rate,
+                                 training=self.training)
+        if not self.normalize_before:
+            x = F.layer_norm(x, [self.d_model], weight=self.ln_scale,
+                             bias=self.ln_bias, epsilon=self.epsilon)
+        return x
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    """Reference `fused_transformer.py:697`: attention + FFN blocks."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout_rate = dropout_rate if attn_dropout_rate is None \
+            else attn_dropout_rate
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

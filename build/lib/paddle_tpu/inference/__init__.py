@@ -1,0 +1,109 @@
+"""``paddle.inference`` — the deployment predictor API.
+
+Reference: `paddle/fluid/inference/api/analysis_predictor.h:100`
+(``AnalysisPredictor``: load model -> optimize -> zero-copy run) and
+`paddle_analysis_config.h` (``Config``). TPU-native: the "optimized
+program" is the exported StableHLO from ``jit.save`` — XLA re-optimizes
+it for the serving chip at load; handles wrap device arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+from .paged_cache import PagedKVCache  # noqa: F401
+
+__all__ = ["Config", "Predictor", "create_predictor", "PagedKVCache"]
+
+
+class Config:
+    """Reference AnalysisConfig. ``prog_file`` is the ``jit.save`` path
+    prefix (the ``.pdmodel``/``.pdiparams`` pair)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._prefix = prog_file
+        self._device = None
+
+    def set_prog_file(self, path):
+        self._prefix = path
+
+    def prog_file(self):
+        return self._prefix
+
+    # device knobs are accepted for API parity; placement is jax's
+    def enable_use_gpu(self, *a, **k):
+        self._device = "gpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, *a, **k):
+        pass
+
+    def switch_ir_optim(self, *a, **k):
+        pass
+
+
+class _Handle:
+    """Zero-copy-style input/output handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, store, name):
+        self._store = store
+        self._name = name
+
+    def copy_from_cpu(self, arr):
+        self._store[self._name] = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the array itself
+
+    def copy_to_cpu(self):
+        return np.asarray(self._store[self._name])
+
+    def shape(self):
+        return list(np.asarray(self._store[self._name]).shape)
+
+
+class Predictor:
+    def __init__(self, config):
+        from ..jit import load as jit_load
+        if not config.prog_file():
+            raise ValueError("Config needs the jit.save path prefix")
+        self._layer = jit_load(config.prog_file())
+        n_in = len(self._layer._meta.get("inputs", []))
+        self._in_names = [f"input_{i}" for i in range(n_in)]
+        self._inputs = {}
+        self._outputs = {}
+        self._out_names = []
+
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_input_handle(self, name):
+        return _Handle(self._inputs, name)
+
+    def run(self, inputs=None):
+        if inputs is not None:                   # direct-call convenience
+            arrays = [np.asarray(a) for a in inputs]
+        else:
+            arrays = [self._inputs[n] for n in self._in_names]
+        out = self._layer(*arrays)
+        outs = out if isinstance(out, tuple) else (out,)
+        self._out_names = [f"output_{i}" for i in range(len(outs))]
+        for n, o in zip(self._out_names, outs):
+            self._outputs[n] = o.numpy()
+        return [self._outputs[n] for n in self._out_names]
+
+    def get_output_names(self):
+        return list(self._out_names)
+
+    def get_output_handle(self, name):
+        return _Handle(self._outputs, name)
+
+
+def create_predictor(config):
+    return Predictor(config)

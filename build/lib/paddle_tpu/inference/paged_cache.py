@@ -1,0 +1,146 @@
+"""Paged KV-cache management for continuous-batching decode.
+
+Reference capability: the paged/block KV cache behind
+`paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu`
+(block tables, per-sequence lengths, block reuse across requests). Host
+side this is pure bookkeeping — :class:`PageAllocator` keeps a free list
+of page ids and a block table per live sequence — while the device side
+is two functional updates: scatter new K/V into the page pool
+(`.at[...]` — XLA lowers to dynamic-update-slice / scatter on TPU), and
+the Pallas `paged_attention` kernel reading through the table.
+
+A transformer with L layers shares ONE allocator (the page structure is
+identical per layer) across L per-layer pools — see
+`paddle_tpu/inference/serving.py`. :class:`PagedKVCache` bundles an
+allocator with a single pool for the one-layer case.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.paged_attention import paged_attention, paged_attention_xla
+
+__all__ = ["PageAllocator", "PagedKVCache"]
+
+
+class PageAllocator:
+    """Free-list page allocator + per-sequence block tables."""
+
+    def __init__(self, num_pages, page_size, max_pages_per_seq=None):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_pages_per_seq = max_pages_per_seq or num_pages
+        self._free = list(range(num_pages - 1, -1, -1))
+        self._tables: dict[int, list[int]] = {}
+        self._lens: dict[int, int] = {}
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    def live_sequences(self):
+        return sorted(self._tables)
+
+    def admit(self, seq_id, n_tokens):
+        """Reserve pages for a new sequence of ``n_tokens`` (prefill)."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id} already admitted")
+        need = max(1, math.ceil(n_tokens / self.page_size))
+        if need > self.max_pages_per_seq:
+            raise ValueError(
+                f"{n_tokens} tokens needs {need} pages > max_pages_per_seq "
+                f"({self.max_pages_per_seq})")
+        if need > len(self._free):
+            raise MemoryError(
+                f"paged cache exhausted: need {need} pages, "
+                f"{len(self._free)} free")
+        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
+        self._lens[seq_id] = n_tokens
+        return list(self._tables[seq_id])
+
+    def extend(self, seq_id, n_tokens=1):
+        """Grow a sequence by ``n_tokens`` (decode), allocating pages as
+        page boundaries are crossed. Returns the previous length (the
+        write offset of the first new token)."""
+        table, ln = self._tables[seq_id], self._lens[seq_id]
+        new_len = ln + n_tokens
+        need = max(1, math.ceil(new_len / self.page_size))
+        if need > self.max_pages_per_seq:
+            raise ValueError(f"sequence {seq_id} exceeds max_pages_per_seq")
+        while len(table) < need:
+            if not self._free:
+                raise MemoryError("paged cache exhausted on extend")
+            table.append(self._free.pop())
+        self._lens[seq_id] = new_len
+        return ln
+
+    def release(self, seq_id):
+        """Return a finished sequence's pages to the free list."""
+        for p in self._tables.pop(seq_id):
+            self._free.append(p)
+        del self._lens[seq_id]
+
+    def context_len(self, seq_id):
+        return self._lens[seq_id]
+
+    def page_positions(self, seq_id, start, count):
+        """(page_ids, offsets) numpy arrays for token positions
+        ``start .. start+count`` of a sequence — the scatter target for a
+        K/V write."""
+        table = self._tables[seq_id]
+        pos = np.arange(start, start + count)
+        page_ids = np.asarray([table[p] for p in pos // self.page_size])
+        return page_ids, pos % self.page_size
+
+    def batch_views(self, seq_ids, width=None, fill_page=0):
+        """(block_tables [B, width], context_lens [B]) for a batch — the
+        kernel inputs. Unused tail entries point at ``fill_page``."""
+        width = width or max(len(self._tables[s]) for s in seq_ids)
+        tables = np.full((len(seq_ids), width), fill_page, np.int32)
+        lens = np.zeros((len(seq_ids),), np.int32)
+        for i, s in enumerate(seq_ids):
+            t = self._tables[s]
+            tables[i, :len(t)] = t
+            lens[i] = self._lens[s]
+        return jnp.asarray(tables), jnp.asarray(lens)
+
+
+class PagedKVCache(PageAllocator):
+    """One layer's K/V pool bundled with its own allocator."""
+
+    def __init__(self, num_pages, page_size, num_kv_heads, head_dim,
+                 dtype=jnp.bfloat16, max_pages_per_seq=None):
+        super().__init__(num_pages, page_size, max_pages_per_seq)
+        # head-major [P, Hk, page, D]: the layout the Pallas kernel tiles
+        shape = (num_pages, num_kv_heads, page_size, head_dim)
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+
+    def write(self, seq_id, k, v, start=None):
+        """Scatter ``[S, Hk, D]`` new K/V at position ``start`` (default:
+        end of already-written context minus the new tokens — i.e. the
+        tokens just accounted by admit/extend)."""
+        k = jnp.asarray(getattr(k, "_data", k), self.k_pages.dtype)
+        v = jnp.asarray(getattr(v, "_data", v), self.v_pages.dtype)
+        s = k.shape[0]
+        if start is None:
+            start = self._lens[seq_id] - s
+        page_ids, offs = self.page_positions(seq_id, start, s)
+        # k is [S, Hk, D]; target (page_ids[s], h, offs[s], :) — the
+        # [S,1]/[1,Hk] index arrays broadcast to [S, Hk] scatter sites
+        hidx = np.arange(self.k_pages.shape[1])[None, :]
+        self.k_pages = self.k_pages.at[
+            page_ids[:, None], hidx, offs[:, None]].set(k)
+        self.v_pages = self.v_pages.at[
+            page_ids[:, None], hidx, offs[:, None]].set(v)
+
+    def attend(self, seq_ids, q, scale=None, use_pallas=True):
+        """Decode-step attention for ``q [B, H, D]`` over the batch's
+        pages; rows of ``q`` correspond to ``seq_ids``."""
+        tables, lens = self.batch_views(seq_ids)
+        fn = paged_attention if use_pallas else paged_attention_xla
+        return fn(q, self.k_pages, self.v_pages, tables, lens, scale=scale)

@@ -1,0 +1,501 @@
+"""Continuous-batching serving engine for the Llama family.
+
+Reference capability: the reference's serving path — AnalysisPredictor +
+paged `block_multi_head_attention` / `masked_multihead_attention`
+kernels (`fluid/inference/api/analysis_predictor.h:100`,
+`phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu`). The
+reference has no in-tree continuous-batching scheduler; this engine goes
+beyond it (vLLM-style): requests are admitted and retired on the fly,
+every live sequence decodes one token per engine step in a single
+batched program, and KV lives in a shared paged pool so ragged contexts
+waste no HBM.
+
+Design (TPU-first):
+- ONE :class:`PageAllocator` shared by all layers (page structure is
+  identical per layer); per-layer K/V pools are device arrays updated
+  functionally.
+- Prefill runs the model's own submodules densely (flash/XLA attention)
+  while collecting post-rope K/V per layer, then scatters them into
+  pages — per request, compiled per prompt-length bucket.
+- The decode step is ONE ``to_static`` program of static shape
+  [max_batch]: embed → per layer (rms_norm → qkv → rope at per-row
+  positions → page write → Pallas ``paged_attention`` → o_proj →
+  swiglu MLP) → logits → greedy argmax. Inactive batch slots point at a
+  reserved trash page with length 1, so shapes never change and the
+  executable is reused for the engine's lifetime.
+- Sustained decode runs as a **burst**: ``lax.scan`` over the same
+  traced decode step, so BURST tokens per sequence cost ONE dispatch,
+  one host→device transfer of (tokens, tables, lens) and one
+  device→host fetch of the emitted block — the per-step host round
+  trip (the dominant cost of dispatch-per-token serving) is amortized
+  away. Pages for the whole burst are reserved up front; sequence
+  lengths advance on device as the scan carry.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, no_grad, run_op
+from ..incubate.nn import functional as FI
+from ..nn import functional as F
+from ..ops.paged_attention import paged_attention
+from .paged_cache import PageAllocator
+
+__all__ = ["LlamaServingEngine", "Request"]
+
+
+def _page_write(pages, new, page_ids, offs):
+    """Functional scatter of ``new [B, Hk, D]`` into head-major ``pages
+    [P, Hk, page, D]`` at (page_ids[b], h, offs[b]) — one token per live
+    sequence."""
+    def fn(pages, new, page_ids, offs):
+        hidx = jnp.arange(pages.shape[1])[None, :]
+        return pages.at[page_ids[:, None], hidx, offs[:, None]].set(
+            new.astype(pages.dtype))
+
+    return run_op("paged_kv_write", fn, (pages, new, page_ids, offs),
+                  differentiable=False)
+
+
+def _page_write_seq(pages, new, page_ids, offs):
+    """Scatter a wave of sequences ``new [B, S, Hk, D]`` into ``pages``
+    at (page_ids[b, s], h, offs[b, s]) — the prefill write, inside the
+    compiled program (trash-page entries absorb bucket padding and pad
+    rows)."""
+    def fn(pages, new, page_ids, offs):
+        hidx = jnp.arange(pages.shape[1])[None, None, :]
+        return pages.at[page_ids[:, :, None], hidx, offs[:, :, None]].set(
+            new.astype(pages.dtype))
+
+    return run_op("paged_kv_write_seq", fn, (pages, new, page_ids, offs),
+                  differentiable=False)
+
+
+class Request:
+    """One generation request (seq_id is assigned by the engine)."""
+
+    def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None):
+        self.prompt_ids = np.asarray(prompt_ids, np.int64).reshape(-1)
+        if self.prompt_ids.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.output_ids: list[int] = []
+        self.seq_id = None
+        self.done = False
+
+
+class LlamaServingEngine:
+    #: default compiled burst length — one scanned decode program serves
+    #: this many tokens per sequence per dispatch
+    BURST = 16
+
+    def __init__(self, model, max_batch=16, page_size=16, num_pages=None,
+                 max_pages_per_seq=None, burst=None):
+        if num_pages is None:
+            num_pages = max_batch * 24 + 8
+        self.model = model
+        cfg = model.config
+        self.max_batch = max_batch
+        self.page_size = page_size
+        # Keep block tables as narrow as the workload allows: the Pallas
+        # decode grid is (B, Hk, width), so a table sized to the whole
+        # pool pays a grid step (and an HBM->VMEM page fetch) per UNUSED
+        # table slot. max_pages_per_seq is the knob.
+        self.burst = int(burst) if burst else self.BURST
+        # page num_pages-1 is the trash page for inactive batch slots
+        self.alloc = PageAllocator(num_pages - 1, page_size,
+                                   max_pages_per_seq)
+        self.width = self.alloc.max_pages_per_seq
+        self.trash_page = num_pages - 1
+        dt = model.parameters()[0].dtype
+        hk, d = cfg.num_key_value_heads, cfg.head_dim
+        # head-major [P, Hk, page, D] — the Pallas kernel's tiling layout
+        shape = (num_pages, hk, page_size, d)
+        self.k_pools = [Tensor(jnp.zeros(shape, jnp.dtype(str(dt))))
+                        for _ in range(cfg.num_hidden_layers)]
+        self.v_pools = [Tensor(jnp.zeros(shape, jnp.dtype(str(dt))))
+                        for _ in range(cfg.num_hidden_layers)]
+        self._live: dict[int, Request] = {}
+        self._next_id = 0
+        self._decode_static = None
+        self._prefill_static = None
+        self._burst_static: dict[int, object] = {}  # burst length -> program
+
+    def __state_tensors__(self):
+        """State-discovery override for ``to_static``: the KV pools are
+        explicit inputs/outputs of every compiled program (donated by the
+        burst path) and must NOT also be captured as closure state —
+        that would donate the same buffers twice. Model params enter via
+        ``state=[self.model]``."""
+        return []
+
+    # ------------------------------------------------------------------
+    # prefill
+    # ------------------------------------------------------------------
+    def _prefill_forward(self, ids, last_pos, page_ids, offs, k_pools,
+                         v_pools):
+        """Dense forward of a WAVE of prompts [max_batch, Sb]
+        (bucket-padded; causal attention keeps each padded tail from
+        touching the real prefix) that also scatters the post-rope K/V
+        into the page pools INSIDE the compiled program. Pad rows and
+        pad positions scatter to the trash page. One dispatch admits up
+        to max_batch requests — the reference serving stack's batched
+        context step (`block_multi_head_attention`) done the XLA way.
+        Returns (next token id [B, 1], new k_pools, new v_pools)."""
+        from ..tensor import creation, manipulation, search
+
+        m = self.model.model
+        cfg = self.model.config
+        b, s = ids.shape[0], ids.shape[1]
+        pos = creation.arange(0, s, dtype="int64").reshape([1, s]) \
+            .expand([b, s])
+        x = m.embed_tokens(ids)
+        new_k, new_v = [], []
+        for li, layer in enumerate(m.layers):
+            h = layer.input_layernorm(x)
+            att = layer.self_attn
+            q = att.q_proj(h).reshape([b, s, att.num_heads, att.head_dim])
+            k = att.k_proj(h).reshape([b, s, att.num_kv_heads, att.head_dim])
+            v = att.v_proj(h).reshape([b, s, att.num_kv_heads, att.head_dim])
+            q, k, v = FI.fused_rotary_position_embedding(
+                q, k, v, position_ids=pos, rotary_emb_base=cfg.rope_theta)
+            new_k.append(_page_write_seq(k_pools[li], k, page_ids, offs))
+            new_v.append(_page_write_seq(v_pools[li], v, page_ids, offs))
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            x = x + att.o_proj(out.reshape([b, s, -1]))
+            x = x + layer.mlp(layer.post_attention_layernorm(x))
+        x = m.norm(x)
+        h_last = manipulation.take_along_axis(
+            x, last_pos.astype("int64").reshape([b, 1, 1])
+            .expand([b, 1, x.shape[-1]]), 1)         # [B, 1, H]
+        logits = self.model._logits(h_last)
+        nxt = search.argmax(logits, axis=-1).astype("int64")
+        return nxt, new_k, new_v
+
+    PREFILL_BUCKET = 32
+
+    def _prefill_wave(self, reqs):
+        """Prefill 1..max_batch admitted requests in ONE compiled call."""
+        if not reqs:
+            return
+        b = self.max_batch
+        n_max = max(len(r.prompt_ids) for r in reqs)
+        # bucket the padded length so ragged prompts share compiled
+        # prefill programs (one per bucket, not one per length)
+        bucket = -(-n_max // self.PREFILL_BUCKET) * self.PREFILL_BUCKET
+        padded = np.zeros((b, bucket), np.int64)
+        page_ids = np.full((b, bucket), self.trash_page, np.int32)
+        offs = np.zeros((b, bucket), np.int32)
+        last_pos = np.zeros((b,), np.int32)
+        for i, r in enumerate(reqs):
+            n = len(r.prompt_ids)
+            padded[i, :n] = r.prompt_ids
+            rp, ro = self.alloc.page_positions(r.seq_id, 0, n)
+            page_ids[i, :n] = rp
+            offs[i, :n] = ro
+            last_pos[i] = n - 1
+        if self._prefill_static is None:
+            from ..jit import StaticFunction
+
+            # no lazy state (params exist, no optimizer): skip the eager
+            # warmup and compile directly; donate pools for in-place
+            # page writes
+            self._prefill_static = StaticFunction(
+                self._prefill_forward, state=[self.model], warmup="once",
+                donate_inputs=True)
+            self._prefill_static._warmed_any = True
+        with no_grad():
+            nxt, new_k, new_v = self._prefill_static(
+                Tensor(jnp.asarray(padded)),
+                Tensor(jnp.asarray(last_pos)),
+                Tensor(jnp.asarray(page_ids)), Tensor(jnp.asarray(offs)),
+                self.k_pools, self.v_pools)
+        self.k_pools, self.v_pools = list(new_k), list(new_v)
+        first = np.asarray(nxt._data).reshape(-1)
+        for i, r in enumerate(reqs):
+            self._emit(r, int(first[i]))
+
+    # ------------------------------------------------------------------
+    # decode
+    # ------------------------------------------------------------------
+    def _decode_step(self, tokens, tables, lens, k_pools, v_pools):
+        """Batched one-token decode: pure in its inputs so ``to_static``
+        compiles it once. tokens [B, 1] int64; tables [B, W]; lens [B]."""
+        from ..tensor import search
+
+        m = self.model.model
+        cfg = self.model.config
+        b = tokens.shape[0]
+        pos = (lens.astype("int64") - 1).reshape([b, 1])
+        page_ids = self._gather_tables(tables, lens)
+        offs = (lens - 1).astype("int32") % self.page_size
+        x = m.embed_tokens(tokens)
+        new_k, new_v = [], []
+        for li, layer in enumerate(m.layers):
+            h = layer.input_layernorm(x)
+            att = layer.self_attn
+            q = att.q_proj(h).reshape([b, 1, att.num_heads, att.head_dim])
+            k = att.k_proj(h).reshape([b, 1, att.num_kv_heads, att.head_dim])
+            v = att.v_proj(h).reshape([b, 1, att.num_kv_heads, att.head_dim])
+            q, k, v = FI.fused_rotary_position_embedding(
+                q, k, v, position_ids=pos, rotary_emb_base=cfg.rope_theta)
+            kp = _page_write(k_pools[li], k[:, 0], page_ids, offs)
+            vp = _page_write(v_pools[li], v[:, 0], page_ids, offs)
+            new_k.append(kp)
+            new_v.append(vp)
+            attn = paged_attention(q[:, 0], kp, vp, tables, lens)
+            x = x + att.o_proj(attn.reshape([b, 1, -1]))
+            x = x + layer.mlp(layer.post_attention_layernorm(x))
+        x = m.norm(x)
+        logits = self.model._logits(x)
+        nxt = search.argmax(logits, axis=-1).astype("int64")
+        return nxt, new_k, new_v
+
+    def _gather_tables(self, tables, lens):
+        """Page id holding each row's current token:
+        ``tables[b, (len-1) // page_size]``."""
+        page = self.page_size
+
+        def fn(tables, lens):
+            b = tables.shape[0]
+            idx = (lens.astype(jnp.int32) - 1) // page
+            return tables[jnp.arange(b), idx]
+
+        return run_op("paged_table_gather", fn, (tables, lens),
+                      differentiable=False)
+
+    # ------------------------------------------------------------------
+    # scheduling
+    # ------------------------------------------------------------------
+    def _admit(self, req):
+        if len(self._live) >= self.max_batch:
+            raise MemoryError(
+                f"engine full ({self.max_batch} live requests)")
+        req.seq_id = self._next_id
+        self._next_id += 1
+        self.alloc.admit(req.seq_id, len(req.prompt_ids))
+        self._live[req.seq_id] = req
+        return req.seq_id
+
+    def add_request(self, req):
+        """Admit a request (prefill immediately). Returns its seq_id."""
+        sid = self._admit(req)
+        self._prefill_wave([req])
+        return sid
+
+    def _emit(self, req, token):
+        req.output_ids.append(token)
+        if (req.eos_token_id is not None and token == req.eos_token_id) \
+                or len(req.output_ids) >= req.max_new_tokens:
+            req.done = True
+            self.alloc.release(req.seq_id)
+            del self._live[req.seq_id]
+
+    def _views_np(self, live):
+        """Padded (tokens?, tables, lens) numpy views for the full
+        [max_batch] slot layout — pure host work, ONE H2D per array."""
+        b = self.max_batch
+        tables = np.full((b, self.width), self.trash_page, np.int32)
+        lens = np.ones((b,), np.int32)
+        for i, r in enumerate(live):
+            t = self.alloc._tables[r.seq_id]
+            tables[i, :len(t)] = t
+            lens[i] = self.alloc._lens[r.seq_id]
+        return tables, lens
+
+    def _ensure_decode_compiled(self):
+        if self._decode_static is None:
+            from .. import jit
+            self._decode_static = jit.to_static(
+                self._decode_step, state=[self.model], warmup="once")
+        return self._decode_static
+
+    def step(self):
+        """Decode one token for every live request. Returns the number of
+        live requests served."""
+        live = [r for r in self._live.values() if not r.done]
+        if not live:
+            return 0
+        # account the new token BEFORE building views: the write offset
+        # and the kernel's context length both include it
+        for r in live:
+            self.alloc.extend(r.seq_id, 1)
+        tokens = np.zeros((self.max_batch, 1), np.int64)
+        for i, r in enumerate(live):
+            tokens[i, 0] = r.output_ids[-1] if r.output_ids \
+                else r.prompt_ids[-1]
+        tables, lens = self._views_np(live)
+        step = self._ensure_decode_compiled()
+        nxt, new_k, new_v = step(
+            Tensor(jnp.asarray(tokens)), Tensor(jnp.asarray(tables)),
+            Tensor(jnp.asarray(lens)), self.k_pools, self.v_pools)
+        self.k_pools, self.v_pools = list(new_k), list(new_v)
+        out = np.asarray(nxt._data).reshape(-1)
+        for i, r in enumerate(live):
+            self._emit(r, int(out[i]))
+        return len(live)
+
+    # ------------------------------------------------------------------
+    # burst decode: n steps = ONE compiled program (lax.scan)
+    # ------------------------------------------------------------------
+    def _decode_burst_fn(self, n):
+        """Build the n-step burst: ``lax.scan`` whose body is the SAME
+        Tensor-level :meth:`_decode_step` (traced, not re-implemented —
+        parity with the per-step program is by construction). The carry
+        is (tokens, lens, pools); tables are scan-invariant because
+        pages for the whole burst are reserved before launch."""
+        import jax
+
+        def fn(tokens, tables, lens, k_pools, v_pools):
+            tab = tables._data
+            kp = [t._data for t in k_pools]
+            vp = [t._data for t in v_pools]
+
+            def body(carry, _):
+                tok, lc, kc, vc = carry
+                nxt, nk, nv = self._decode_step(
+                    Tensor(tok), Tensor(tab), Tensor(lc),
+                    [Tensor(a) for a in kc], [Tensor(a) for a in vc])
+                nxt_arr = nxt._data.reshape(tok.shape).astype(tok.dtype)
+                return ((nxt_arr, lc + 1,
+                         [t._data for t in nk], [t._data for t in nv]),
+                        nxt_arr[:, 0])
+
+            (_, _, kf, vf), toks = jax.lax.scan(
+                body, (tokens._data, lens._data, kp, vp), None, length=n)
+            return (jnp.swapaxes(toks, 0, 1), *kf, *vf)
+
+        return fn
+
+    def _ensure_burst_compiled(self, n):
+        sf = self._burst_static.get(n)
+        if sf is None:
+            from ..jit import StaticFunction
+
+            sf = StaticFunction(self._decode_burst_fn(n),
+                                state=[self.model], warmup="once",
+                                donate_inputs=True)
+            # no lazy state to materialize (params exist; no optimizer):
+            # skip the eager warmup — n scanned steps of per-op dispatch
+            # would cost more than the compile it avoids
+            sf._warmed_any = True
+            self._burst_static[n] = sf
+        return sf
+
+    def _burst(self, n):
+        """Decode ``n`` tokens for every live request in one dispatch.
+        Pages for all n tokens are reserved up front; requests that
+        retire mid-burst (EOS / max_new_tokens) have their tail tokens
+        discarded at emit time — bounded waste, no correctness impact."""
+        live = [r for r in self._live.values() if not r.done]
+        if not live or n <= 0:
+            return 0
+        start_lens = {r.seq_id: self.alloc._lens[r.seq_id] for r in live}
+        for r in live:
+            self.alloc.extend(r.seq_id, n)
+        b = self.max_batch
+        tables = np.full((b, self.width), self.trash_page, np.int32)
+        lens = np.ones((b,), np.int32)
+        tokens = np.zeros((b, 1), np.int64)
+        for i, r in enumerate(live):
+            t = self.alloc._tables[r.seq_id]
+            tables[i, :len(t)] = t
+            lens[i] = start_lens[r.seq_id] + 1   # first new token included
+            tokens[i, 0] = r.output_ids[-1] if r.output_ids \
+                else r.prompt_ids[-1]
+        sf = self._ensure_burst_compiled(n)
+        with no_grad():
+            out = sf(
+                Tensor(jnp.asarray(tokens)), Tensor(jnp.asarray(tables)),
+                Tensor(jnp.asarray(lens)), self.k_pools, self.v_pools)
+        n_layers = len(self.k_pools)
+        toks = out[0]
+        self.k_pools = list(out[1:1 + n_layers])
+        self.v_pools = list(out[1 + n_layers:])
+        all_tokens = np.asarray(toks._data)          # one D2H
+        served = 0
+        for i, r in enumerate(live):
+            for t in range(n):
+                if r.done:
+                    break
+                self._emit(r, int(all_tokens[i, t]))
+                served += 1
+        return served
+
+    def _burst_fits(self, live, n):
+        """Largest burst <= n whose page reservations fit the pool."""
+        page = self.page_size
+        while n > 1:
+            need = sum(
+                max(0, -(-(self.alloc._lens[r.seq_id] + n) // page)
+                    - len(self.alloc._tables[r.seq_id]))
+                for r in live)
+            if need <= self.alloc.free_pages:
+                break
+            n //= 2
+        return n
+
+    def decode_many(self, n, exact=True):
+        """``n`` decode steps for the current live set, chunked into
+        compiled scans: full :attr:`burst`-length bursts, then
+        burst/4-length bursts, then single steps. With ``exact=False``
+        the tail may overshoot by up to burst/4 - 1 ticks — callers use
+        this when every live request retires by step ``n`` (the
+        overshot ticks are discarded at emit time), trading a few idle
+        ticks for never paying the per-step dispatch round trip.
+        Returns tokens served."""
+        served = 0
+        small = max(self.burst // 4, 2)
+        while n > 0:
+            live = [r for r in self._live.values() if not r.done]
+            if not live:
+                break
+            if n >= self.burst:
+                chunk = self._burst_fits(live, self.burst)
+            elif n >= small or not exact:
+                chunk = self._burst_fits(live, small)
+            else:
+                chunk = 1
+            if chunk > 1:
+                served += self._burst(chunk)
+                n -= chunk
+            else:
+                served += self.step()
+                n -= 1
+        return served
+
+    def generate(self, prompts, max_new_tokens=16, eos_token_id=None):
+        """Convenience batch API: admit all prompts (continuous batching
+        handles ragged finish times), run to completion, return output id
+        lists in order. Admissions happen in waves — every pending
+        request that fits prefills in ONE compiled call."""
+        reqs = [Request(p, max_new_tokens, eos_token_id) for p in prompts]
+        pending = list(reqs)
+        while pending or any(not r.done for r in reqs):
+            wave = []
+            while pending and len(self._live) < self.max_batch:
+                self._admit(pending[0])
+                wave.append(pending.pop(0))
+            self._prefill_wave(wave)
+            live = [r for r in self._live.values() if not r.done]
+            if live:
+                # burst until the earliest possible retirement; with EOS
+                # or pending admissions cap at the burst length so a
+                # retirement (and the admission it unblocks) is never
+                # far away. The tail may overshoot (exact=False): every
+                # live request retires by then, so overshot ticks are
+                # discarded, never mis-emitted.
+                burst = min(r.max_new_tokens - len(r.output_ids)
+                            for r in live)
+                if pending or eos_token_id is not None:
+                    burst = min(burst, self.burst)
+                self.decode_many(burst, exact=False)
+                continue
+            if not pending and all(r.done for r in reqs):
+                break
+        return [r.output_ids for r in reqs]

@@ -1,0 +1,41 @@
+"""``paddle_tpu.io`` — datasets, samplers, DataLoader.
+
+Reference: `python/paddle/io/__init__.py`.
+"""
+
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    SubsetRandomSampler, BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .token_feed import TokenFeed, PyTokenFeed  # noqa: F401
+
+
+class WorkerInfo:
+    """Reference `io/dataloader/worker.py:WorkerInfo`. The thread-pool
+    loader has no per-worker dataset copies, so a single-worker view is
+    always reported (id 0 of num_workers)."""
+
+    def __init__(self, id=0, num_workers=1, dataset=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    """Reference `paddle.io.get_worker_info`: None in the main process
+    (always, here — workers are threads sharing the dataset object)."""
+    return None
+
+__all__ = [
+    "TokenFeed", "PyTokenFeed",
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+    "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "SubsetRandomSampler", "BatchSampler", "DistributedBatchSampler",
+    "DataLoader", "default_collate_fn", "get_worker_info", "WorkerInfo",
+]

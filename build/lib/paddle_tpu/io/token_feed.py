@@ -1,0 +1,86 @@
+"""Token-corpus feed: native C++ prefetcher with a numpy fallback.
+
+``TokenFeed(path, sample_elems, batch_size)`` iterates ``[batch,
+sample_elems]`` numpy batches over a flat binary corpus of fixed-size
+samples — the host-side input path for pretraining recipes
+(`examples/llama_pretrain.py`). When the native library is available
+(`paddle_tpu/native/src/data_feed.cc` — the analog of the reference's
+C++ feed threads, `fluid/framework/data_feed.cc`), batches are filled by
+a C++ prefetch thread over an mmap; otherwise :class:`PyTokenFeed`
+serves the same contract from ``np.memmap`` synchronously.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import native as _native
+
+__all__ = ["TokenFeed", "PyTokenFeed"]
+
+
+class PyTokenFeed:
+    """Pure-numpy fallback with identical iteration semantics to
+    :class:`paddle_tpu.native.TokenFeed` (same per-epoch permutation is
+    NOT guaranteed — the native feed shuffles with C++ mt19937 — but the
+    visit-each-sample-once / drop-last contract is)."""
+
+    def __init__(self, path, sample_elems, batch_size, dtype=np.int32,
+                 shuffle=True, seed=0, prefetch_depth=4, epochs=-1):
+        self.dtype = np.dtype(dtype)
+        self.sample_elems = int(sample_elems)
+        self.batch_size = int(batch_size)
+        data = np.memmap(path, dtype=self.dtype, mode="r")
+        n = data.size // self.sample_elems
+        if n < self.batch_size:
+            raise ValueError(
+                f"TokenFeed: cannot open {path!r} (too small for one "
+                f"batch of {batch_size} x {sample_elems} {self.dtype})")
+        self._data = data[:n * self.sample_elems].reshape(
+            n, self.sample_elems)
+        self.shuffle, self.seed = shuffle, seed
+        self.epochs = epochs
+        self._epoch = 0
+        self._step = 0
+        self._order = self._epoch_order()
+
+    @property
+    def num_samples(self):
+        return self._data.shape[0]
+
+    @property
+    def batches_per_epoch(self):
+        return self.num_samples // self.batch_size
+
+    def _epoch_order(self):
+        if not self.shuffle:
+            return np.arange(self.num_samples)
+        return np.random.RandomState(
+            self.seed + self._epoch).permutation(self.num_samples)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._step >= self.batches_per_epoch:
+            self._epoch += 1
+            if self.epochs > 0 and self._epoch >= self.epochs:
+                raise StopIteration
+            self._step = 0
+            self._order = self._epoch_order()
+        idx = self._order[self._step * self.batch_size:
+                          (self._step + 1) * self.batch_size]
+        self._step += 1
+        return np.ascontiguousarray(self._data[idx])
+
+    def close(self):
+        pass
+
+
+def TokenFeed(path, sample_elems, batch_size, dtype=np.int32, shuffle=True,
+              seed=0, prefetch_depth=4, epochs=-1):
+    """Factory: the native prefetching feed when buildable, else the
+    numpy fallback. Both yield ``[batch_size, sample_elems]`` arrays."""
+    cls = _native.TokenFeed if _native.available() else PyTokenFeed
+    return cls(path, sample_elems, batch_size, dtype=dtype, shuffle=shuffle,
+               seed=seed, prefetch_depth=prefetch_depth, epochs=epochs)

@@ -1,0 +1,204 @@
+"""``jit.save`` / ``jit.load`` — portable compiled-model export.
+
+Reference: `python/paddle/jit/api.py` ``save``/``load`` +
+`jit/translated_layer.py` (``TranslatedLayer`` executing a serialized
+program). TPU-native format: the forward is traced to **StableHLO** via
+``jax.export`` (shape-polymorphic in every ``None`` dim of the
+InputSpec), serialized next to the parameters:
+
+    <path>.pdmodel    serialized StableHLO module (jax.export bytes)
+    <path>.pdiparams  parameter arrays (framework io pickle)
+    <path>.pdmeta     json: input specs, param names, output treedef
+
+``load`` returns a :class:`TranslatedLayer`: parameters are real Tensors
+(swappable / inspectable), and calls execute the deserialized program —
+no Python model code needed, the serving deployment path
+(reference capability: `fluid/inference/api/analysis_predictor.h:100`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from ..framework.tensor import Tensor, Parameter
+from ..framework import io as fio
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def _as_specs(input_spec):
+    """InputSpec/Tensor/array list -> jax.ShapeDtypeStruct list (None dims
+    become export symbols — all in ONE shared scope, since jax.export
+    rejects mixing scopes across arguments)."""
+    from ..static import InputSpec
+
+    specs = []
+    sym_id = 0
+    scope = jax_export.SymbolicScope()
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            dims = []
+            for d in s.shape:
+                if isinstance(d, str):
+                    dims.append(d)        # user-named: shared across inputs
+                elif d is None or (isinstance(d, int) and d < 0):
+                    dims.append(f"_d{sym_id}")
+                    sym_id += 1
+                else:
+                    dims.append(str(d))
+            shape = jax_export.symbolic_shape(",".join(dims), scope=scope) \
+                if any(not d.isdigit() for d in dims) \
+                else tuple(int(d) for d in dims)
+            specs.append(jax.ShapeDtypeStruct(shape, s.dtype))
+        elif isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(s._data.shape,
+                                              s._data.dtype))
+        else:
+            a = np.asarray(s)
+            specs.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+    return specs
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export ``layer``'s forward as StableHLO + params.
+
+    ``input_spec``: list of InputSpec/Tensors describing the forward's
+    positional inputs (required for Layers whose forward was never
+    shape-specialized).
+    """
+    from ..nn import Layer
+    from ..framework.tensor import no_grad
+
+    if isinstance(layer, Layer):
+        fn = type(layer).forward.__get__(layer)
+        params = list(layer.parameters())
+        # structured state_dict names so a loaded model's set_state_dict
+        # interoperates with the original layer's state_dict
+        id2name = {id(v): k for k, v in layer.state_dict().items()}
+        pnames = [id2name.get(id(p), p.name or f"p{i}")
+                  for i, p in enumerate(params)]
+    else:
+        fn = layer
+        params, pnames = [], []
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes/dtypes of "
+                         "the forward inputs)")
+
+    out_box = {}
+
+    def pure(param_arrays, *input_arrays):
+        saved = [(p._data, p._node) for p in params]
+        try:
+            for p, a in zip(params, param_arrays):
+                p._data = a
+                p._node = None
+            with no_grad():
+                ins = [Tensor(a) for a in input_arrays]
+                out = fn(*ins)
+            flat, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            out_box["treedef"] = treedef
+            return tuple(o._data if isinstance(o, Tensor) else o
+                         for o in flat)
+        finally:
+            for p, (d, n) in zip(params, saved):
+                p._data, p._node = d, n
+
+    pspecs = [jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
+              for p in params]
+    ispecs = _as_specs(input_spec)
+    exported = jax_export.export(jax.jit(pure))(pspecs, *ispecs)
+
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    payload = {n: p for n, p in zip(pnames, params)}
+    # output pytree structure (dict/nested returns) rides along so load
+    # reconstructs the original return shape, not a bare tuple
+    payload["__output_treedef__"] = out_box.get("treedef")
+    fio.save(payload, path + ".pdiparams")
+    meta = {
+        "param_names": pnames,
+        "inputs": [{"shape": [d if isinstance(d, int) else None
+                              for d in getattr(s, "shape", [])],
+                    "dtype": str(s.dtype)} for s in ispecs],
+        "n_outputs": len(exported.out_avals),
+    }
+    with open(path + ".pdmeta", "w") as f:
+        json.dump(meta, f)
+    return path
+
+
+class TranslatedLayer:
+    """A loaded exported model (reference translated_layer.py). Call it
+    like the original layer; ``parameters()`` exposes the loaded params."""
+
+    def __init__(self, exported, params, pnames, meta):
+        self._exported = exported
+        self._params = params
+        self._pnames = pnames
+        self._meta = meta
+
+    def parameters(self, include_sublayers=True):
+        return list(self._params)
+
+    def state_dict(self):
+        return {n: p for n, p in zip(self._pnames, self._params)}
+
+    def set_state_dict(self, state):
+        matched = 0
+        for n, p in zip(self._pnames, self._params):
+            if n in state:
+                src = state[n]
+                p._data = src._data if isinstance(src, Tensor) \
+                    else jnp.asarray(src)
+                matched += 1
+        if state and not matched:
+            raise KeyError(
+                "set_state_dict matched no parameters; expected keys like "
+                f"{self._pnames[:3]}..., got {list(state)[:3]}...")
+
+    def forward(self, *inputs):
+        arrays = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        outs = self._exported.call([p._data for p in self._params],
+                                   *arrays)
+        outs = [Tensor(o, stop_gradient=True) for o in outs]
+        treedef = self._meta.get("out_treedef")
+        if treedef is not None:
+            return jax.tree_util.tree_unflatten(treedef, outs)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    __call__ = forward
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer is an inference program (the exported "
+            "StableHLO has no backward); rebuild the python model to "
+            "fine-tune")
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + ".pdmeta") as f:
+        meta = json.load(f)
+    state = fio.load(path + ".pdiparams")
+    meta["out_treedef"] = state.pop("__output_treedef__", None)
+    pnames = meta["param_names"]
+    params = []
+    for n in pnames:
+        t = state[n]
+        params.append(t if isinstance(t, Tensor) else Tensor(t))
+    return TranslatedLayer(exported, params, pnames, meta)

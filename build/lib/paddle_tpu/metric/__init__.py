@@ -1,0 +1,196 @@
+"""``paddle.metric`` — streaming evaluation metrics.
+
+Reference: `python/paddle/metric/metrics.py` (``Metric`` base with
+compute/update/reset/accumulate, ``Accuracy``, ``Precision``, ``Recall``,
+``Auc``). Metrics accumulate on host in numpy — they sit outside the
+compiled step, fed by its outputs, so they never force a retrace.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return x.numpy()
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Base metric (reference metrics.py Metric)."""
+
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__.lower()
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    def name(self):
+        return self._name
+
+    def compute(self, *args):
+        """Optional pre-processing of (pred, label) before ``update``;
+        default passthrough (reference: Metric.compute)."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference metrics.py Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        super().__init__(name or "acc")
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = _np(pred)
+        label = _np(label)
+        maxk = max(self.topk)
+        order = np.argsort(-pred, axis=-1)[..., :maxk]
+        if label.ndim == pred.ndim:
+            if label.shape[-1] == 1:       # paddle's [B, 1] index labels
+                label = label[..., 0]
+            else:                          # one-hot / soft labels
+                label = label.argmax(-1)
+        correct = (order == label[..., None]).astype(np.float32)
+        return correct
+
+    def update(self, correct, *args):
+        correct = _np(correct)
+        flat = correct.reshape(-1, correct.shape[-1])
+        res = []
+        for k in self.topk:
+            hit = flat[:, :k].sum(-1).clip(max=1.0)
+            self.total[self.topk.index(k)] += float(hit.sum())
+            self.count[self.topk.index(k)] += hit.shape[0]
+            res.append(float(hit.mean()))
+        return res[0] if len(res) == 1 else res
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / c if c else 0.0 for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision (reference metrics.py Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "precision")
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        labels = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(Metric):
+    """Binary recall (reference metrics.py Recall)."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "recall")
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        labels = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """Binned ROC-AUC (reference metrics.py Auc, trapezoid over
+    ``num_thresholds`` bins)."""
+
+    def __init__(self, num_thresholds=4095, name=None):
+        self.num_thresholds = num_thresholds
+        super().__init__(name or "auc")
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = _np(labels).reshape(-1).astype(np.int64)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64),
+                      0, self.num_thresholds)
+        np.add.at(self._stat_pos, idx, labels == 1)
+        np.add.at(self._stat_neg, idx, labels == 0)
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.float64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.float64)
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if not tot_pos or not tot_neg:
+            return 0.0
+        # sweep thresholds high->low, trapezoid on the ROC curve
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy of a prediction batch (reference op `accuracy`,
+    `phi/kernels/gpu/accuracy_kernel.cu`): input [N, C] scores, label
+    [N, 1] or [N]; returns a 0-d fraction tensor."""
+    import jax.numpy as jnp
+
+    from ..framework.tensor import run_op
+
+    kk = int(k)
+
+    def fn(inp, lbl):
+        topk = jnp.argsort(-inp, axis=1)[:, :kk]
+        lbl = lbl.reshape(-1, 1)
+        hit = jnp.any(topk == lbl, axis=1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return run_op("accuracy", fn, (input, label), differentiable=False)

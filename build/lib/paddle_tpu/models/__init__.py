@@ -1,0 +1,28 @@
+"""``paddle_tpu.models`` — flagship model families.
+
+The reference keeps its LLM recipes out-of-tree (PaddleNLP), but the
+BASELINE north star is Llama-3-8B pretraining MFU, so the decoder family
+lives in-tree here, built on the incubate fused ops + Pallas GQA flash
+attention.
+"""
+
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaMLP, LlamaAttention, LlamaDecoderLayer, LlamaModel,
+    LlamaForCausalLM, shard_llama, llama3_8b_config, tiny_llama_config,
+)
+from .llama_pipe import LlamaForCausalLMPipe  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForSequenceClassification,
+    BertForTokenClassification, ErnieModel,
+    ErnieForSequenceClassification, ernie_base_config, tiny_bert_config,
+)
+
+__all__ = [
+    "LlamaConfig", "LlamaMLP", "LlamaAttention", "LlamaDecoderLayer",
+    "LlamaModel", "LlamaForCausalLM", "shard_llama", "llama3_8b_config",
+    "tiny_llama_config", "LlamaForCausalLMPipe",
+    "BertConfig", "BertModel", "BertForSequenceClassification",
+    "BertForTokenClassification", "ErnieModel",
+    "ErnieForSequenceClassification", "ernie_base_config",
+    "tiny_bert_config",
+]

@@ -1,0 +1,159 @@
+"""BERT/ERNIE-style encoder family (the BASELINE.md transformer-encoder
+path: "ERNIE-3.0-base finetune functional parity").
+
+Reference capability: the reference trains ERNIE via PaddleNLP on its
+`nn.TransformerEncoder` (`python/paddle/nn/layer/transformer.py`) —
+this module is the in-tree TPU-native recipe on the same layers:
+embeddings (word + position + token type) -> pre/post-LN encoder stack ->
+pooler, with task heads for sequence and token classification.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..nn.initializer import Normal
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForTokenClassification", "ErnieModel",
+           "ErnieForSequenceClassification", "ernie_base_config",
+           "tiny_bert_config"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+
+
+def ernie_base_config():
+    """ERNIE-3.0-base shape (12L, 768H, 12 heads)."""
+    return BertConfig(vocab_size=40000, max_position_embeddings=2048,
+                      type_vocab_size=4)
+
+
+def tiny_bert_config(**kw):
+    base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=64,
+                max_position_embeddings=64, type_vocab_size=2,
+                hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    base.update(kw)
+    return BertConfig(**base)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        wa = Normal(std=cfg.initializer_range)
+        self.word_embeddings = nn.Embedding(cfg.vocab_size,
+                                            cfg.hidden_size, weight_attr=wa)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size, weight_attr=wa)
+        self.token_type_embeddings = nn.Embedding(
+            cfg.type_vocab_size, cfg.hidden_size, weight_attr=wa)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from ..tensor import creation
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = creation.arange(0, s, dtype="int64") \
+                .reshape([1, s])
+        if token_type_ids is None:
+            token_type_ids = creation.zeros_like(input_ids)
+        x = self.word_embeddings(input_ids) \
+            + self.position_embeddings(position_ids) \
+            + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(nn.Layer):
+    """Embeddings -> TransformerEncoder -> (sequence_output, pooled)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        layer = nn.TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            act_dropout=0.0, normalize_before=False,
+            layer_norm_eps=config.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(layer,
+                                             config.num_hidden_layers)
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, S] 1/0 padding mask -> additive [B, 1, 1, S]
+            m = (1.0 - attention_mask.astype("float32")) * -1e4
+            attention_mask = m.reshape(
+                [attention_mask.shape[0], 1, 1, attention_mask.shape[1]])
+        seq = self.encoder(x, src_mask=attention_mask)
+        pooled = F.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits.astype("float32"),
+                               labels.reshape([-1]))
+        return loss, logits
+
+
+class BertForTokenClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        seq, _ = self.bert(input_ids, token_type_ids, position_ids,
+                           attention_mask)
+        logits = self.classifier(self.dropout(seq))
+        if labels is None:
+            return logits
+        n = logits.shape[-1]
+        loss = F.cross_entropy(
+            logits.reshape([-1, n]).astype("float32"),
+            labels.reshape([-1]), ignore_index=-100)
+        return loss, logits
+
+
+# ERNIE shares the architecture; the difference is pretraining data/task
+ErnieModel = BertModel
+ErnieForSequenceClassification = BertForSequenceClassification

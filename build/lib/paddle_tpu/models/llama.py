@@ -1,0 +1,486 @@
+"""Llama decoder family (the BASELINE.md north-star model).
+
+Capability reference: the reference framework trains Llama via PaddleNLP on
+top of the fused ops in `python/paddle/incubate/nn/functional/` (swiglu,
+fused_rms_norm, fused_rotary_position_embedding) and flash attention
+(`python/paddle/nn/functional/flash_attention.py:147`). This module is the
+TPU-native recipe built on the same in-tree pieces:
+
+- pre-norm decoder blocks: RMSNorm -> GQA attention (+rope) -> RMSNorm ->
+  SwiGLU MLP, all through the eager tape so one definition serves eager
+  debugging and ``jit.to_static`` whole-step compilation;
+- attention dispatches to the Pallas GQA flash kernel when shapes allow
+  (`paddle_tpu/ops/flash_attention.py`), XLA fallback otherwise;
+- :func:`shard_llama` annotates every weight with (tp, fsdp) placements
+  over a ``ProcessMesh`` — GSPMD inserts the Megatron collectives
+  (column/row linear all-gather + psum, vocab-parallel embedding) from the
+  layout alone, the TPU analog of the reference's
+  `fleet/layers/mpu/mp_layers.py`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..framework.tensor import Tensor
+from ..incubate.nn import functional as FI
+from ..nn.initializer import Normal
+
+__all__ = ["LlamaConfig", "LlamaMLP", "LlamaAttention", "LlamaDecoderLayer",
+           "LlamaModel", "LlamaForCausalLM", "shard_llama",
+           "llama3_8b_config", "tiny_llama_config"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama3_8b_config():
+    """Llama-3-8B: GQA 32q/8kv, 128k vocab, rope theta 500k."""
+    return LlamaConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+        max_position_embeddings=8192, rms_norm_eps=1e-5, rope_theta=500000.0)
+
+
+def tiny_llama_config(**kw):
+    """A few-thousand-param config for tests and dry runs."""
+    base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=256,
+                rope_theta=10000.0)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def _winit(cfg):
+    return Normal(mean=0.0, std=cfg.initializer_range)
+
+
+def _kv_cache_update(buf, new, start):
+    """Write ``new`` [B, s, Hk, D] into ``buf`` [B, max_len, Hk, D] at
+    sequence offset ``start`` (a scalar int Tensor, traced-safe)."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.tensor import run_op
+
+    s, max_len = new.shape[1], buf.shape[1]
+    start_arr = start._data if hasattr(start, "_data") else start
+    if not isinstance(start_arr, jax.core.Tracer) \
+            and int(start_arr) + s > max_len:
+        # dynamic_update_slice would silently clamp the start and corrupt
+        # the newest cached positions — refuse instead
+        raise ValueError(
+            f"KV cache overflow: writing {s} tokens at offset "
+            f"{int(start_arr)} exceeds the static buffer ({max_len})")
+
+    def fn(b, n, st):
+        zero = jnp.zeros((), jnp.int32)
+        return jax.lax.dynamic_update_slice(
+            b, n.astype(b.dtype), (zero, jnp.asarray(st, jnp.int32),
+                                   zero, zero))
+
+    return run_op("kv_cache_update", fn, (buf, new, start))
+
+
+def _decode_mask(length, s, max_len):
+    """Bool [1, 1, s, max_len]: query i (absolute pos length+i) sees key j
+    iff j <= length + i — causal over the valid prefix of a static
+    buffer."""
+    import jax.numpy as jnp
+    from ..framework.tensor import run_op
+
+    def fn(ln):
+        qpos = jnp.asarray(ln, jnp.int32) + jnp.arange(s, dtype=jnp.int32)
+        kpos = jnp.arange(max_len, dtype=jnp.int32)
+        return (kpos[None, :] <= qpos[:, None])[None, None]
+
+    return run_op("decode_mask", fn, (length,), differentiable=False)
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU MLP: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        wa = _winit(config)
+        self.gate_proj = nn.Linear(config.hidden_size,
+                                   config.intermediate_size,
+                                   weight_attr=wa, bias_attr=False)
+        self.up_proj = nn.Linear(config.hidden_size,
+                                 config.intermediate_size,
+                                 weight_attr=wa, bias_attr=False)
+        self.down_proj = nn.Linear(config.intermediate_size,
+                                   config.hidden_size,
+                                   weight_attr=wa, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(FI.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaAttention(nn.Layer):
+    """GQA attention with rotary embeddings; [B, S, H, D] layout throughout
+    so the Pallas flash kernel path needs no relayout."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
+        wa = _winit(config)
+        self.q_proj = nn.Linear(config.hidden_size, h * d, weight_attr=wa,
+                                bias_attr=False)
+        self.k_proj = nn.Linear(config.hidden_size, hk * d, weight_attr=wa,
+                                bias_attr=False)
+        self.v_proj = nn.Linear(config.hidden_size, hk * d, weight_attr=wa,
+                                bias_attr=False)
+        self.o_proj = nn.Linear(h * d, config.hidden_size, weight_attr=wa,
+                                bias_attr=False)
+
+    def forward(self, x, position_ids=None, cache=None, cache_len=None,
+                attn_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
+        q = self.q_proj(x).reshape([b, s, h, d])
+        k = self.k_proj(x).reshape([b, s, hk, d])
+        v = self.v_proj(x).reshape([b, s, hk, d])
+        if cache is not None and cache_len is None:
+            raise ValueError(
+                "cache_len (scalar int Tensor) is required when a KV "
+                "cache is passed — the static buffer needs the write "
+                "offset")
+        if position_ids is None and cache is not None:
+            # direct layer use: rope continues after the cached prefix
+            # (LlamaModel.forward precomputes this; keep the layer correct
+            # standalone too)
+            from ..tensor import creation
+            position_ids = creation.arange(
+                0, s, dtype="int64").reshape([1, s]) \
+                + cache_len.astype("int64")
+        q, k, v = FI.fused_rotary_position_embedding(
+            q, k, v, position_ids=position_ids,
+            rotary_emb_base=self.config.rope_theta)
+        if cache is not None:
+            # decode path: write into the static [B, max_len, Hk, D] buffer
+            # at cache_len (the TPU idiom — no shape growth, one compile for
+            # all decode steps; reference capability:
+            # phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu)
+            k_buf = _kv_cache_update(cache[0], k, cache_len)
+            v_buf = _kv_cache_update(cache[1], v, cache_len)
+            if attn_mask is None:
+                attn_mask = _decode_mask(cache_len, s, k_buf.shape[1])
+            out = F.scaled_dot_product_attention(q, k_buf, v_buf,
+                                                 attn_mask=attn_mask)
+            out = self.o_proj(out.reshape([b, s, h * d]))
+            return out, (k_buf, v_buf)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.o_proj(out.reshape([b, s, h * d]))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, position_ids=None, cache=None, cache_len=None,
+                attn_mask=None):
+        h = self.input_layernorm(x)
+        if cache is not None:
+            attn, cache = self.self_attn(h, position_ids, cache, cache_len,
+                                         attn_mask)
+        else:
+            attn = self.self_attn(h, position_ids)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        if cache is not None:
+            return x, cache
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size,
+                                         weight_attr=_winit(config))
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cache_len=None):
+        x = self.embed_tokens(input_ids)
+        new_caches = [] if caches is not None else None
+        attn_mask = None
+        if caches is not None:
+            if cache_len is None:
+                raise ValueError(
+                    "cache_len is required when caches are passed")
+            s = input_ids.shape[1]
+            if position_ids is None:
+                # rope positions continue after the cached prefix
+                # (cache_len is a traced scalar: one program per shape)
+                from ..tensor import creation
+                position_ids = creation.arange(
+                    0, s, dtype="int64").reshape([1, s]) \
+                    + cache_len.astype("int64")
+            # identical for every layer — build once, not per layer
+            attn_mask = _decode_mask(cache_len, s, caches[0][0].shape[1])
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                x, c = layer(x, position_ids, caches[i], cache_len,
+                             attn_mask)
+                new_caches.append(c)
+            else:
+                x = layer(x, position_ids)
+        x = self.norm(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class LlamaForCausalLM(nn.Layer):
+    """Decoder LM. ``forward(input_ids, labels=None)`` returns logits, or
+    ``(loss, logits)`` when next-token labels are given (labels are the
+    input shifted by the caller, ignore_index=-100)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     weight_attr=_winit(config),
+                                     bias_attr=False)
+
+    def _logits(self, hidden):
+        if self.lm_head is not None:
+            return self.lm_head(hidden)
+        from ..tensor import linalg
+        return linalg.matmul(hidden, self.model.embed_tokens.weight,
+                             transpose_y=True)
+
+    def forward(self, input_ids, labels=None, position_ids=None):
+        hidden = self.model(input_ids, position_ids)
+        logits = self._logits(hidden)
+        if labels is None:
+            return logits
+        v = self.config.vocab_size
+        loss = F.cross_entropy(
+            logits.reshape([-1, v]).astype("float32"),
+            labels.reshape([-1]), ignore_index=-100)
+        return loss, logits
+
+    def num_params(self):
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+    def flops_per_token(self, seq_len):
+        """Approximate training FLOPs/token: 6*N_matmul_params + attention
+        term (the standard MFU accounting). The embedding lookup is a
+        gather, not a matmul, so its params are excluded — unless the
+        embedding is tied and doubles as the output projection."""
+        cfg = self.config
+        n = self.num_params()
+        if not cfg.tie_word_embeddings:
+            n -= cfg.vocab_size * cfg.hidden_size  # embed_tokens lookup
+        attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+        return 6 * n + attn
+
+    @staticmethod
+    def _pick_token(logits, rng_key, sampler):
+        """next-token rule on [B, 1, V] logits. ``sampler`` is a static
+        (do_sample, top_k, top_p, temperature) tuple — each distinct
+        config compiles its own decode program."""
+        from ..framework.tensor import run_op
+        from ..tensor import search
+
+        do_sample, top_k, top_p, temperature = sampler
+        if not do_sample:
+            return search.argmax(logits, axis=-1).astype("int64")
+
+        def fn(logits, key):
+            lg = logits[:, 0, :].astype(jnp.float32)
+            lg = lg / max(float(temperature), 1e-6)
+            if top_k:  # None or 0 disables the filter (HF/paddle convention)
+                k = min(int(top_k), lg.shape[-1])
+                kth = jnp.sort(lg, axis=-1)[:, -k][:, None]
+                lg = jnp.where(lg >= kth, lg, -1e30)
+            if top_p is not None:
+                # nucleus over the (possibly top-k-restricted) softmax
+                probs = jax.nn.softmax(lg, axis=-1)
+                order = jnp.argsort(-probs, axis=-1)
+                sp = jnp.take_along_axis(probs, order, axis=-1)
+                cum_before = jnp.cumsum(sp, axis=-1) - sp
+                keep_sorted = cum_before < float(top_p)
+                keep = jnp.zeros_like(keep_sorted).at[
+                    jnp.arange(lg.shape[0])[:, None], order].set(
+                    keep_sorted)
+                lg = jnp.where(keep, lg, -1e30)
+            return jax.random.categorical(key, lg, axis=-1)[:, None]
+
+        return run_op("sample_next_token", fn, (logits, rng_key),
+                      differentiable=False).astype("int64")
+
+    def _decode_step(self, tokens, cache_len, caches, rng_key=None,
+                     sampler=(False, None, None, 1.0)):
+        """One generation step: (next_token, new_cache_len, new_caches).
+        Pure in (tokens, cache_len, caches, rng_key) so ``to_static``
+        compiles it ONCE per shape — the static KV buffers keep every
+        decode step the same program, and with input donation XLA updates
+        them in place."""
+        hidden, caches = self.model(tokens, None, caches, cache_len)
+        logits = self._logits(hidden[:, -1:])
+        nxt = self._pick_token(logits, rng_key, sampler)
+        new_len = cache_len + tokens.shape[1]
+        return nxt, new_len, caches
+
+    def generate(self, input_ids, max_new_tokens=16, max_length=None,
+                 do_sample=False, top_k=None, top_p=None, temperature=1.0,
+                 seed=None):
+        """Decode over a static KV cache: one compile for the prefill
+        shape + one for the single-token decode shape, reused for every
+        subsequent step and every same-shape call. Greedy by default;
+        ``do_sample=True`` samples inside the compiled step (temperature
+        -> top-k -> top-p nucleus -> categorical), deterministic under
+        ``seed``. Inputs of the compiled step are donated (the caches
+        alias in place on device), so nothing passed to one step is
+        touched after it. The buffer length is bucketed (multiple of 64)
+        so prompts of different lengths share the same decode executable."""
+        from ..framework.tensor import Tensor, no_grad
+        from ..framework import random as frandom
+        from ..tensor import manipulation as M
+        from .. import jit
+        import jax.numpy as jnp
+
+        sampler = (bool(do_sample), top_k, top_p, float(temperature))
+        # the compiled step pins parameter objects + the sampler config;
+        # rebuild if either changed (e.g. shard_llama swapped Parameters)
+        param_key = (tuple(id(p) for p in self.parameters()), sampler)
+        if getattr(self, "_decode_static", None) is None \
+                or self._decode_param_key != param_key:
+            def step_fn(tokens, cache_len, caches, rng_key):
+                return self._decode_step(tokens, cache_len, caches,
+                                         rng_key, sampler)
+            self._decode_static = jit.StaticFunction(
+                step_fn, state=[self], warmup="once", donate_inputs=True)
+            self._decode_param_key = param_key
+        step = self._decode_static
+        base_key = jax.random.key(seed) if seed is not None \
+            else frandom.next_key()
+        with no_grad():
+            b, s = input_ids.shape[0], input_ids.shape[1]
+            need = s + max_new_tokens
+            max_len = max_length if max_length is not None \
+                else ((need + 63) // 64) * 64
+            if max_len < need:
+                raise ValueError(
+                    f"max_length={max_len} < prompt + max_new_tokens "
+                    f"({need})")
+            caches = self._empty_caches(b, max_len)
+            cache_len = Tensor(jnp.asarray(0, jnp.int32))
+            # clone: the step donates its inputs, and the caller's
+            # input_ids must survive
+            tokens = Tensor(jnp.array(input_ids._data))
+            new_tokens = []
+            for i in range(max_new_tokens):
+                key = Tensor(jax.random.fold_in(base_key, i))
+                nxt, cache_len, caches = step(tokens, cache_len, caches,
+                                              key)
+                tokens = nxt.reshape([b, 1])
+                # copy: `tokens` itself is donated into the next step, but
+                # the appended value must survive until the final concat
+                new_tokens.append(Tensor(jnp.array(tokens._data)))
+            return M.concat([input_ids] + new_tokens, axis=1)
+
+    def _empty_caches(self, batch, max_len):
+        from ..tensor import creation
+        cfg = self.config
+        dt = self.model.embed_tokens.weight.dtype  # match model dtype
+        return [
+            (creation.zeros([batch, max_len, cfg.num_key_value_heads,
+                             cfg.head_dim], dtype=dt),
+             creation.zeros([batch, max_len, cfg.num_key_value_heads,
+                             cfg.head_dim], dtype=dt))
+            for _ in range(cfg.num_hidden_layers)]
+
+
+# ---------------------------------------------------------------------------
+# sharding recipe: (tp, fsdp) placements per weight — the Megatron layout
+# expressed as GSPMD annotations (reference: fleet/layers/mpu/mp_layers.py)
+# ---------------------------------------------------------------------------
+def shard_llama(model: LlamaForCausalLM, mesh, tp_axis="mp",
+                fsdp_axis=None):
+    """Annotate a LlamaForCausalLM's weights over ``mesh``.
+
+    - attention q/k/v and mlp gate/up: column-parallel (out-dim on tp)
+    - attention o and mlp down: row-parallel (in-dim on tp)
+    - embedding + lm_head: vocab-parallel
+    - fsdp_axis (optional) shards the *other* matrix dim, giving the
+      ZeRO-3 layout; norms shard on fsdp only.
+    """
+    from ..distributed import shard_tensor, Shard, Replicate
+
+    tp_dim = mesh.dim_names.index(tp_axis) if tp_axis else None
+    fs_dim = mesh.dim_names.index(fsdp_axis) if fsdp_axis else None
+
+    def place(t, tp_tensor_dim, fsdp_tensor_dim):
+        p = [Replicate()] * mesh.ndim
+        if tp_dim is not None and tp_tensor_dim is not None:
+            p[tp_dim] = Shard(tp_tensor_dim)
+        if fs_dim is not None and fsdp_tensor_dim is not None:
+            p[fs_dim] = Shard(fsdp_tensor_dim)
+        return shard_tensor(t, mesh, p)
+
+    m = model.model
+    m.embed_tokens.weight = place(m.embed_tokens.weight, 0, 1)
+    if model.lm_head is not None:
+        model.lm_head.weight = place(model.lm_head.weight, 1, 0)
+    for layer in m.layers:
+        a, mlp = layer.self_attn, layer.mlp
+        a.q_proj.weight = place(a.q_proj.weight, 1, 0)
+        a.k_proj.weight = place(a.k_proj.weight, 1, 0)
+        a.v_proj.weight = place(a.v_proj.weight, 1, 0)
+        a.o_proj.weight = place(a.o_proj.weight, 0, 1)
+        mlp.gate_proj.weight = place(mlp.gate_proj.weight, 1, 0)
+        mlp.up_proj.weight = place(mlp.up_proj.weight, 1, 0)
+        mlp.down_proj.weight = place(mlp.down_proj.weight, 0, 1)
+        layer.input_layernorm.weight = place(
+            layer.input_layernorm.weight, None, 0)
+        layer.post_attention_layernorm.weight = place(
+            layer.post_attention_layernorm.weight, None, 0)
+    m.norm.weight = place(m.norm.weight, None, 0)
+    return model

@@ -1,0 +1,22 @@
+"""``paddle_tpu.nn`` — model authoring surface.
+
+Reference: `python/paddle/nn/__init__.py` re-exporting Layer + the layer
+zoo + functional + initializer + clip.
+"""
+
+from .layer.layers import Layer, ParamAttr  # noqa: F401
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.container import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,16 @@
+"""``paddle_tpu.nn.functional`` — flat functional namespace.
+
+Reference: `python/paddle/nn/functional/__init__.py`.
+"""
+
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+
+# ops that live on the tensor surface but are also exposed via F in the
+# reference
+from ...tensor.manipulation import pad, squeeze, unsqueeze  # noqa: F401

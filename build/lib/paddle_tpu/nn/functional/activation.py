@@ -1,0 +1,215 @@
+"""Activation functionals.
+
+Reference: `python/paddle/nn/functional/activation.py`. Each op is a single
+pure jnp function registered through ``@defop`` so the eager tape records one
+grad node per activation and XLA fuses it into neighbors under ``jit``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.registry import defop
+from ...framework.tensor import Tensor, run_op
+from ...framework import random as frandom
+
+__all__ = [
+    "relu", "relu6", "gelu", "silu", "sigmoid", "tanh", "softmax",
+    "log_softmax", "leaky_relu", "elu", "selu", "celu", "prelu",
+    "hardshrink", "hardsigmoid", "hardswish", "hardtanh", "softplus",
+    "softshrink", "softsign", "swish", "mish", "tanhshrink",
+    "thresholded_relu", "log_sigmoid", "glu", "gumbel_softmax", "maxout",
+    "rrelu", "tanh_shrink",
+]
+
+
+@defop(method=True, inplace_method="relu_")
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+@defop()
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+@defop(method=True)
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+@defop()
+def silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+@defop(method=True)
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@defop(name="nn_tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@defop(method=True)
+def softmax(x, axis=-1, dtype=None):
+    out = jax.nn.softmax(x.astype(dtype) if dtype is not None else x,
+                         axis=int(axis))
+    return out
+
+
+@defop()
+def log_softmax(x, axis=-1, dtype=None):
+    return jax.nn.log_softmax(x.astype(dtype) if dtype is not None else x,
+                              axis=int(axis))
+
+
+@defop()
+def leaky_relu(x, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+@defop()
+def elu(x, alpha=1.0):
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defop()
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defop()
+def celu(x, alpha=1.0):
+    return jnp.maximum(x, 0) + jnp.minimum(0, alpha * jnp.expm1(x / alpha))
+
+
+@defop()
+def prelu(x, weight, data_format="NCHW"):
+    w = weight
+    if w.ndim == 1 and w.shape[0] != 1 and x.ndim > 1:
+        # per-channel slope; broadcast across spatial dims
+        if data_format.startswith("NC") or x.ndim <= 2:
+            shape = [1, -1] + [1] * (x.ndim - 2)
+        else:
+            shape = [1] * (x.ndim - 1) + [-1]
+        w = w.reshape(shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+@defop()
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0)
+
+
+@defop()
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(slope * x + offset, 0, 1)
+
+
+@defop()
+def hardswish(x):
+    return x * jnp.clip(x + 3, 0, 6) / 6
+
+
+@defop()
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@defop(name="nn_softplus")
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x,
+                     jnp.logaddexp(x * beta, 0) / beta)
+
+
+@defop()
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0))
+
+
+@defop()
+def softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+@defop()
+def swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+@defop()
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@defop()
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+tanh_shrink = tanhshrink
+
+
+@defop()
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+@defop()
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@defop()
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=int(axis))
+    return a * jax.nn.sigmoid(b)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    """Reference: nn/functional/activation.py gumbel_softmax. Gumbel noise is
+    drawn from the framework generator so it is traceable under jit."""
+    key = frandom.next_key()
+
+    def fn(x_, key_):
+        g = jax.random.gumbel(key_, x_.shape, dtype=x_.dtype)
+        y = jax.nn.softmax((x_ + g) / temperature, axis=int(axis))
+        if hard:
+            idx = jnp.argmax(y, axis=int(axis), keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=int(axis),
+                                        inplace=False)
+            # straight-through estimator
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return run_op("gumbel_softmax", fn, (x, key))
+
+
+@defop()
+def maxout(x, groups, axis=1):
+    axis = int(axis)
+    if axis < 0:
+        axis += x.ndim
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True):
+    if not training:
+        return leaky_relu(x, (lower + upper) / 2)
+    key = frandom.next_key()
+
+    def fn(x_, key_):
+        a = jax.random.uniform(key_, x_.shape, dtype=x_.dtype,
+                               minval=lower, maxval=upper)
+        return jnp.where(x_ >= 0, x_, a * x_)
+
+    return run_op("rrelu", fn, (x, key))

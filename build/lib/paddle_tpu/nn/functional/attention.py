@@ -1,0 +1,129 @@
+"""Attention functionals.
+
+Reference: `python/paddle/nn/functional/flash_attention.py` —
+``scaled_dot_product_attention`` (:442) and ``flash_attention`` (:147).
+Layout follows the reference: [batch, seq_len, num_heads, head_dim].
+
+Dispatch seam: when ``FLAGS_use_pallas_kernels`` is set and a Pallas flash
+kernel is registered (paddle_tpu.ops.flash_attention), it is used; otherwise
+the naive composition lowers to XLA (which already fuses well on TPU for
+moderate sequence lengths).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, run_op
+from ...framework import random as frandom
+from ... import flags
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "sdp_kernel"]
+
+
+def _naive_attention(q, k, v, mask, dropout_p, is_causal, key, scale=None):
+    # [B, S, H, D] -> [B, H, S, D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    if kh.shape[1] != qh.shape[1]:
+        # GQA fallback: broadcast the kv heads across their query group
+        # (XLA keeps this as a broadcast feeding the einsum, no HBM copy)
+        group = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, group, axis=1)
+        vh = jnp.repeat(vh, group, axis=1)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # fp32 softmax accumulation (TPU numerics idiom)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) * s
+    if is_causal:
+        ql, kl = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((ql, kl), dtype=bool), k=kl - ql)
+        scores = jnp.where(causal, scores, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -jnp.inf)
+        else:
+            scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    probs = probs.astype(qh.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Reference: flash_attention.py:442. Inputs [B, S, H, D]."""
+    use_pallas = flags.flag("use_pallas_kernels")
+    if use_pallas and dropout_p == 0.0:
+        from ...ops import flash_attention as fa
+        if fa.supported(query, key, value, attn_mask, is_causal):
+            from ...incubate import autotune
+            if autotune.get_config()["kernel"]["enable"]:
+                # measure-once-then-cache (the reference's exhaustive
+                # kernel search, phi/kernels/autotune) per shape+causal
+                qd = getattr(query, "_data", query)
+                kd = getattr(key, "_data", key)
+                shape_key = ("sdpa", tuple(qd.shape), tuple(kd.shape),
+                             str(qd.dtype), bool(is_causal))
+                _, best = autotune.kernel_choice(shape_key, {
+                    "pallas": lambda q, k, v: fa.flash_attention(
+                        q, k, v, causal=is_causal),
+                    "xla": lambda q, k, v: run_op(
+                        "scaled_dot_product_attention",
+                        lambda q_, k_, v_: _naive_attention(
+                            q_, k_, v_, None, 0.0, is_causal, None),
+                        (q, k, v)),
+                }, (query, key, value))
+                return best(query, key, value)
+            return fa.flash_attention(query, key, value, attn_mask=attn_mask,
+                                      causal=is_causal)
+    rng_key = frandom.next_key() if (dropout_p > 0.0 and training) else None
+    p = dropout_p if training else 0.0
+
+    def fn(q, k, v, m, rk):
+        return _naive_attention(q, k, v, m, p, is_causal, rk)
+
+    return run_op("scaled_dot_product_attention", fn,
+                  (query, key, value, attn_mask, rng_key))
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """Reference: flash_attention.py:147. Returns (out, softmax_lse-like
+    placeholder) to match the reference's (result, softmax) tuple shape."""
+    out = scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                       dropout_p=dropout, is_causal=causal,
+                                       training=training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+class sdp_kernel:
+    """Context manager selecting attention backends (API parity with the
+    reference's sdp kernel switches; the real switch is the Pallas flag)."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        self.enable_flash = enable_flash
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = flags.flag("use_pallas_kernels")
+        flags.set_flags({"use_pallas_kernels": bool(self.enable_flash)})
+        return self
+
+    def __exit__(self, *exc):
+        flags.set_flags({"use_pallas_kernels": self._prev})
+        return False

@@ -1,0 +1,523 @@
+"""Common NN functionals: linear, dropout, embedding, normalize, ...
+
+Reference: `python/paddle/nn/functional/common.py`, `input.py`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.registry import defop
+from ...framework.tensor import Tensor, run_op
+from ...framework import random as frandom
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "normalize", "cosine_similarity", "bilinear",
+    "label_smooth", "interpolate", "upsample", "pixel_shuffle",
+    "pixel_unshuffle", "channel_shuffle", "unfold", "fold", "one_hot",
+    "grid_sample", "affine_grid", "linear_interp", "bilinear_interp",
+    "nearest_interp", "bicubic_interp", "trilinear_interp",
+    "class_center_sample", "pad3d", "fused_softmax_mask",
+    "fused_softmax_mask_upper_triangle"]
+
+
+@defop()
+def linear(x, weight, bias=None):
+    """y = x @ W (+ b). W is [in_features, out_features] — the reference's
+    Linear convention (`python/paddle/nn/layer/common.py` Linear)."""
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """Reference: nn/functional/common.py dropout. RNG comes from the
+    framework generator (named-state aware for model parallelism)."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x.scale(1 - p) if hasattr(x, "scale") else x * (1 - p)
+        return x
+    if p == 1.0:
+        return x * 0 if isinstance(x, Tensor) else Tensor(jnp.zeros_like(x))
+    key = frandom.next_key()
+
+    def fn(x_, key_):
+        shape = list(x_.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key_, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, x_ / (1.0 - p), 0).astype(x_.dtype)
+        return jnp.where(keep, x_, 0).astype(x_.dtype)
+
+    return run_op("dropout", fn, (x, key))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (reference common.py alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    key = frandom.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(x_, key_):
+        keep = jax.random.bernoulli(key_, 1.0 - p, x_.shape)
+        a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, x_, alpha_p) + b).astype(x_.dtype)
+
+    return run_op("alpha_dropout", fn, (x, key))
+
+
+@defop()
+def embedding(x, weight, padding_idx=None, sparse=False):
+    """Lookup rows of ``weight`` by integer ids ``x``.
+
+    Reference: nn/functional/input.py embedding — with ``padding_idx`` the
+    output row is zero and no gradient flows to that row.
+    """
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = jnp.where(mask, out, 0).astype(out.dtype)
+    return out
+
+
+@defop()
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=int(axis), keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+@defop()
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=int(axis))
+    n1 = jnp.linalg.norm(x1, axis=int(axis))
+    n2 = jnp.linalg.norm(x2, axis=int(axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@defop()
+def bilinear(x1, x2, weight, bias=None):
+    """out[n,o] = x1[n,i] W[o,i,j] x2[n,j] (+ b). Reference common.py
+    bilinear."""
+    y = jnp.einsum("ni,oij,nj->no", x1, weight, x2)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@defop()
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    c = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / c
+
+
+def one_hot(x, num_classes, name=None):
+    from ...tensor import creation  # reuse registered op if present
+    def fn(x_):
+        return jax.nn.one_hot(x_, num_classes, dtype=jnp.float32)
+    return run_op("one_hot", fn, (x,), differentiable=False)
+
+
+@defop()
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = int(upscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+@defop()
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = int(downscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+@defop()
+def channel_shuffle(x, groups, data_format="NCHW"):
+    g = int(groups)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, g, c // g, h, w)
+        x = jnp.transpose(x, (0, 2, 1, 3, 4))
+        return x.reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, g, c // g)
+    x = jnp.transpose(x, (0, 1, 2, 4, 3))
+    return x.reshape(n, h, w, c)
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(e) for e in v)
+    return (int(v),) * n
+
+
+@defop()
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference common.py unfold): NCHW -> [N, C*kh*kw, L]."""
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    if isinstance(paddings, (list, tuple)) and len(paddings) == 4:
+        p = tuple(int(e) for e in paddings)  # (top, bottom, left, right)
+    else:
+        ph, pw = _pair(paddings, 2)
+        p = (ph, ph, pw, pw)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])))
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, out_h, out_w]
+    return patches.reshape(n, c * kh * kw, -1)
+
+
+@defop()
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im, the adjoint of unfold (reference common.py fold)."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = _pair(paddings, 2)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    out_h = (oh + 2 * p[0] - dh * (kh - 1) - 1) // sh + 1
+    out_w = (ow + 2 * p[1] - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(n, c, kh, kw, out_h, out_w)
+    padded = jnp.zeros((n, c, oh + 2 * p[0], ow + 2 * p[1]), dtype=x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            padded = padded.at[:, :, hi:hi + sh * out_h:sh,
+                               wj:wj + sw * out_w:sw].add(cols[:, :, i, j])
+    return padded[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+
+
+def _interp_coords(out_size, in_size, align_corners, align_mode):
+    """Source coordinate of each output index for the linear/cubic
+    families (reference `phi/kernels/funcs/interpolate_function.h`:
+    align_corners -> i*(in-1)/(out-1); else align_mode 0 -> half-pixel,
+    align_mode 1 -> i*scale)."""
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners:
+        return i * (in_size - 1) / max(out_size - 1, 1)
+    if align_mode == 1:
+        return i * in_size / out_size
+    return (i + 0.5) * in_size / out_size - 0.5
+
+
+def _axis_weights(w, axis, ndim, out_size):
+    shape = [1] * ndim
+    shape[axis] = out_size
+    return w.reshape(shape)
+
+
+def _interp_axis_linear(x, axis, coords):
+    """Separable 2-tap lerp along ``axis`` at float ``coords``."""
+    n = x.shape[axis]
+    c = jnp.clip(coords, 0, n - 1)
+    i0 = jnp.clip(jnp.floor(c).astype(jnp.int32), 0, n - 1)
+    i1 = jnp.clip(i0 + 1, 0, n - 1)
+    w = (c - i0).astype(x.dtype)
+    w = _axis_weights(w, axis, x.ndim, coords.shape[0])
+    return jnp.take(x, i0, axis) * (1 - w) + jnp.take(x, i1, axis) * w
+
+
+def _cubic_kernel(t, a=-0.75):
+    """Keys cubic convolution weights for the 4 taps at offsets
+    (-1, 0, 1, 2) given fractional position t (reference
+    `phi/kernels/funcs/interpolate_function.h:cubic_interp`)."""
+    def w1(d):   # |d| <= 1
+        return (a + 2) * d ** 3 - (a + 3) * d ** 2 + 1
+
+    def w2(d):   # 1 < |d| < 2
+        return a * d ** 3 - 5 * a * d ** 2 + 8 * a * d - 4 * a
+
+    return [w2(t + 1), w1(t), w1(1 - t), w2(2 - t)]
+
+
+def _interp_axis_cubic(x, axis, coords):
+    n = x.shape[axis]
+    f = jnp.floor(coords)
+    t = (coords - f).astype(jnp.float32)
+    base = f.astype(jnp.int32)
+    out = 0
+    for k, wk in enumerate(_cubic_kernel(t)):
+        idx = jnp.clip(base + (k - 1), 0, n - 1)
+        w = _axis_weights(wk.astype(x.dtype), axis, x.ndim, coords.shape[0])
+        out = out + jnp.take(x, idx, axis) * w
+    return out
+
+
+def _interp_axis_nearest(x, axis, out_size, align_corners):
+    n = x.shape[axis]
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners:
+        idx = jnp.round(i * (n - 1) / max(out_size - 1, 1))
+    else:
+        idx = jnp.floor(i * n / out_size)
+    return jnp.take(x, jnp.clip(idx.astype(jnp.int32), 0, n - 1), axis)
+
+
+@defop()
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW"):
+    """Resize (reference `nn/functional/common.py:interpolate`; CUDA
+    kernels `phi/kernels/gpu/interpolate_kernel.cu`). TPU-native:
+    separable per-axis gather + lerp/cubic taps that XLA fuses — all
+    five modes honor align_corners / align_mode exactly; `area`
+    delegates to adaptive average pooling."""
+    channel_last = not data_format.startswith("NC")
+    spatial_axes = list(range(1, x.ndim - 1)) if channel_last \
+        else list(range(2, x.ndim))
+    spatial = [x.shape[a] for a in spatial_axes]
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("one of size/scale_factor is required")
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * len(spatial)
+        size = [int(s * float(f)) for s, f in zip(spatial, sf)]
+    else:
+        size = [int(s) for s in
+                (size if isinstance(size, (list, tuple)) else [size])]
+    if len(size) != len(spatial):
+        raise ValueError(
+            f"size has {len(size)} dims but input has {len(spatial)} "
+            "spatial dims")
+    if mode == "area":
+        from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,
+                              adaptive_avg_pool3d)
+        pool = {1: adaptive_avg_pool1d, 2: adaptive_avg_pool2d,
+                3: adaptive_avg_pool3d}[len(size)]
+        if channel_last:
+            x = jnp.moveaxis(x, -1, 1)
+        out = pool(x, size)
+        out = getattr(out, "_data", out)
+        return jnp.moveaxis(out, 1, -1) if channel_last else out
+    if mode == "nearest":
+        for a, s in zip(spatial_axes, size):
+            x = _interp_axis_nearest(x, a, s, align_corners)
+        return x
+    if mode in ("linear", "bilinear", "trilinear"):
+        fn = _interp_axis_linear
+    elif mode == "bicubic":
+        fn = _interp_axis_cubic
+    else:
+        raise ValueError(f"unsupported mode {mode!r}")
+    for a, s in zip(spatial_axes, size):
+        coords = _interp_coords(s, x.shape[a], align_corners,
+                                0 if mode == "bicubic" else align_mode)
+        x = fn(x, a, coords)
+    return x
+
+
+def _interp_family(op_name, mode, ndim):
+    @defop(name=op_name)
+    def op(x, size=None, scale_factor=None, align_corners=False,
+           align_mode=0, data_format="NCHW"):
+        if x.ndim != ndim:
+            raise ValueError(f"{op_name} expects {ndim}-D input")
+        # reuse the raw-jax interpolate body (x is already an array here)
+        return interpolate.__wrapped__(
+            x, size=size, scale_factor=scale_factor, mode=mode,
+            align_corners=align_corners, align_mode=align_mode,
+            data_format=data_format)
+    op.__name__ = op_name
+    op.__doc__ = (f"Reference op `{op_name}` "
+                  "(`paddle/phi/api/yaml/legacy_ops.yaml`): the "
+                  f"{mode} resize kernel behind F.interpolate.")
+    return op
+
+
+linear_interp = _interp_family("linear_interp", "linear", 3)
+bilinear_interp = _interp_family("bilinear_interp", "bilinear", 4)
+nearest_interp = _interp_family("nearest_interp", "nearest", 4)
+bicubic_interp = _interp_family("bicubic_interp", "bicubic", 4)
+trilinear_interp = _interp_family("trilinear_interp", "trilinear", 5)
+
+
+@defop()
+def affine_grid(theta, out_shape, align_corners=True):
+    """Sampling grid for a batch of affine transforms (reference op
+    `affine_grid`, `phi/kernels/impl/affine_grid_kernel_impl.h`).
+    theta [N,2,3] -> grid [N,H,W,2]; theta [N,3,4] -> [N,D,H,W,3]."""
+    out_shape = [int(s) for s in out_shape]
+    spatial = out_shape[2:]
+
+    def axis_coords(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        # half-pixel centers: (2i + 1)/n - 1
+        return (2 * jnp.arange(n, dtype=jnp.float32) + 1) / n - 1
+
+    coords = [axis_coords(n) for n in spatial]
+    mesh = jnp.meshgrid(*coords, indexing="ij")     # D,H,W order
+    # grid coordinate order is (x, y[, z]) = reversed spatial
+    base = jnp.stack(list(reversed(mesh)) + [jnp.ones_like(mesh[0])],
+                     axis=-1)                       # [*spatial, ndim+1]
+    base = base.astype(theta.dtype)
+    return jnp.einsum("...i,nji->n...j", base, theta)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW", name=None):
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       align_corners=align_corners, data_format=data_format)
+
+
+@defop(differentiable=True)
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Sample ``x [N, C, H, W]`` at normalized ``grid [N, Ho, Wo, 2]``
+    coordinates in [-1, 1] (reference `nn/functional/vision.py:grid_sample`,
+    CUDA kernel `phi/kernels/gpu/grid_sample_kernel.cu`). TPU-native:
+    the bilinear taps are four gathers + a weighted sum XLA fuses."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode must be bilinear/nearest, got {mode!r}")
+    if padding_mode not in ("zeros", "border"):
+        raise ValueError(
+            f"padding_mode must be zeros/border, got {padding_mode!r}")
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+
+    def gather(yi, xi):
+        yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        flat = (yi_c * w + xi_c).reshape(n, 1, -1)       # [N, 1, Ho*Wo]
+        xf = x.reshape(n, c, h * w)
+        out = jnp.take_along_axis(
+            xf, jnp.broadcast_to(flat, (n, c, flat.shape[-1])), axis=-1)
+        return out.reshape(n, c, *gx.shape[1:])
+
+    def in_bounds(yi, xi):
+        if padding_mode == "border":
+            return jnp.ones_like(yi, dtype=x.dtype)
+        return ((yi >= 0) & (yi <= h - 1) & (xi >= 0)
+                & (xi <= w - 1)).astype(x.dtype)
+
+    if mode == "nearest":
+        yi = jnp.round(fy)
+        xi = jnp.round(fx)
+        return gather(yi, xi) * in_bounds(yi, xi)[:, None]
+
+    y0 = jnp.floor(fy)
+    x0 = jnp.floor(fx)
+    wy1 = fy - y0
+    wx1 = fx - x0
+    out = 0.0
+    for (yy, xx, wgt) in [
+            (y0, x0, (1 - wy1) * (1 - wx1)),
+            (y0, x0 + 1, (1 - wy1) * wx1),
+            (y0 + 1, x0, wy1 * (1 - wx1)),
+            (y0 + 1, x0 + 1, wy1 * wx1)]:
+        out = out + gather(yy, xx) * (wgt * in_bounds(yy, xx))[:, None]
+    return out
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """PartialFC class-center sampling (reference op
+    `class_center_sample`, `phi/kernels/gpu/class_center_sample_kernel.cu`
+    — `nn/functional/common.py:2104`): keep every positive class, fill
+    up to ``num_samples`` with random negatives, remap labels into the
+    sampled index space. Sampling is host-side bookkeeping (the result
+    feeds a partial FC layer); returns (remapped_label,
+    sampled_class_center)."""
+    import numpy as _np
+
+    from ...framework.tensor import Tensor as _T
+
+    lbl = _np.asarray(getattr(label, "_data", label)).reshape(-1)
+    pos = _np.unique(lbl)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = _np.setdiff1d(_np.arange(num_classes), pos,
+                                 assume_unique=True)
+        extra = _np.random.permutation(neg_pool)[:num_samples - len(pos)]
+        sampled = _np.sort(_np.concatenate([pos, extra]))
+    remap = _np.full((num_classes,), -1, _np.int64)
+    remap[sampled] = _np.arange(len(sampled))
+    return (_T(jnp.asarray(remap[lbl])),
+            _T(jnp.asarray(sampled.astype(_np.int64))))
+
+
+@defop()
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    """5-D padding (reference op `pad3d`,
+    `phi/kernels/gpu/pad3d_kernel.cu`). ``paddings`` is
+    (left, right, top, bottom, front, back) on the spatial dims."""
+    pl, pr, pt, pb, pf, pbk = (int(p) for p in paddings)
+    if data_format == "NCDHW":
+        cfg = ((0, 0), (0, 0), (pf, pbk), (pt, pb), (pl, pr))
+    else:
+        cfg = ((0, 0), (pf, pbk), (pt, pb), (pl, pr), (0, 0))
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@defop()
+def fused_softmax_mask(x, mask):
+    """softmax(x + mask) in one op (reference fused op
+    `fused_softmax_mask`, `phi/kernels/fusion/gpu/`) — XLA fuses the
+    add into the softmax; the op exists for API parity."""
+    return jax.nn.softmax(x.astype(jnp.float32) + mask.astype(jnp.float32),
+                          axis=-1).astype(x.dtype)
+
+
+@defop()
+def fused_softmax_mask_upper_triangle(x):
+    """Causal-masked softmax (reference
+    `fused_softmax_mask_upper_triangle`): positions above the diagonal
+    are -inf before the softmax."""
+    s = x.shape[-1]
+    mask = jnp.triu(jnp.full((s, s), -1e9, jnp.float32), k=1)
+    return jax.nn.softmax(x.astype(jnp.float32) + mask, axis=-1) \
+        .astype(x.dtype)

@@ -1,0 +1,172 @@
+"""Convolution functionals over ``lax.conv_general_dilated``.
+
+Reference: `python/paddle/nn/functional/conv.py` (conv1d/2d/3d and
+transpose variants). TPU-first: one XLA convolution per call — the MXU path —
+with NCHW/NHWC handled by dimension numbers, groups by feature_group_count.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.registry import defop
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(e) for e in v)
+    return (int(v),) * n
+
+
+def _dim_numbers(ndim, channel_last):
+    if ndim == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if ndim == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _norm_padding(padding, nd):
+    """Paddle padding forms: int, 'SAME'/'VALID', [p]*nd, or explicit pairs."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd and all(isinstance(p, int) for p in padding):
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    # list of pairs (possibly including batch/channel dims — strip those)
+    pairs = [tuple(int(e) for e in p) for p in padding]
+    if len(pairs) == nd + 2:
+        pairs = pairs[2:]
+    return pairs
+
+
+def _weight_to_io(w, nd, channel_last):
+    """Paddle weights are [out_c, in_c/groups, *k]; lax channel-last specs
+    want [*k, in_c/groups, out_c]."""
+    if not channel_last:
+        return w
+    perm = tuple(range(2, 2 + nd)) + (1, 0)
+    return jnp.transpose(w, perm)
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nd,
+          data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    dn = _dim_numbers(nd, channel_last)
+    out = jax.lax.conv_general_dilated(
+        x, _weight_to_io(weight, nd, channel_last),
+        window_strides=_tuple(stride, nd),
+        padding=_norm_padding(padding, nd),
+        rhs_dilation=_tuple(dilation, nd),
+        dimension_numbers=dn,
+        feature_group_count=int(groups),
+        preferred_element_type=None)
+    if bias is not None:
+        if channel_last:
+            out = out + bias
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@defop()
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+@defop()
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+@defop()
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, nd, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    strides = _tuple(stride, nd)
+    dilations = _tuple(dilation, nd)
+    pad = _norm_padding(padding, nd)
+    opad = _tuple(output_padding, nd) if output_padding is not None else (0,) * nd
+    # paddle transpose-conv weight is [in_c, out_c/groups, *k]
+    k = weight.shape[2:]
+    if isinstance(pad, str):
+        if pad == "VALID":
+            pad = [(0, 0)] * nd
+        else:  # SAME
+            pad = [((dilations[i] * (k[i] - 1)) // 2,) * 2 for i in range(nd)]
+    # conv_transpose as input-dilated conv: lhs_dilation=strides,
+    # padding adjusted: p' = d*(k-1) - p
+    eff = [dilations[i] * (k[i] - 1) for i in range(nd)]
+    tpad = [(eff[i] - pad[i][0], eff[i] - pad[i][1] + opad[i])
+            for i in range(nd)]
+    dn = _dim_numbers(nd, channel_last)
+    g = int(groups)
+    # weight [in_c, out_c/g, *k] -> flip spatial, swap to [out_c, in_c/g, *k]
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if g == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        in_c = w.shape[0]
+        w = w.reshape((g, in_c // g) + w.shape[1:])
+        w = jnp.swapaxes(w, 1, 2)  # [g, out/g, in/g, *k]
+        w = w.reshape((-1, in_c // g) + w.shape[3:])
+    out = jax.lax.conv_general_dilated(
+        x, _weight_to_io(w, nd, channel_last),
+        window_strides=(1,) * nd,
+        padding=tpad,
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=dn,
+        feature_group_count=g)
+    if bias is not None:
+        if channel_last:
+            out = out + bias
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@defop()
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL"):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, fmt)
+
+
+@defop()
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW", output_size=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format)
+
+
+@defop()
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW", output_size=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format)

@@ -1,0 +1,457 @@
+"""Loss functionals.
+
+Reference: `python/paddle/nn/functional/loss.py`.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.registry import defop
+from ...framework.tensor import Tensor, run_op
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
+           "mse_loss", "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
+           "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+           "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
+           "square_error_cost", "log_loss", "sigmoid_focal_loss",
+           "triplet_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
+           "multi_label_soft_margin_loss", "margin_cross_entropy",
+           "huber_loss", "identity_loss", "hsigmoid_loss", "edit_distance"]
+
+
+def _reduce(x, reduction):
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    return x
+
+
+@defop()
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    """Reference: nn/functional/loss.py cross_entropy. ``input`` is logits
+    (or probabilities when use_softmax=False); hard labels are class ids."""
+    axis = int(axis)
+    c = input.shape[axis]
+    if use_softmax:
+        logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(input.astype(jnp.float32), 1e-15, 1.0))
+    if soft_label:
+        soft = label.astype(jnp.float32)
+        if label_smoothing > 0.0:
+            soft = (1 - label_smoothing) * soft + label_smoothing / c
+        loss = -jnp.sum(soft * logp, axis=axis)
+        if weight is not None:
+            wshape = [1] * logp.ndim
+            wshape[axis] = -1
+            loss = loss * jnp.sum(soft * weight.reshape(wshape), axis=axis)
+        return _reduce(loss, reduction)
+    lbl = label
+    if lbl.ndim == logp.ndim:  # [N, 1] style labels
+        lbl = jnp.squeeze(lbl, axis=axis)
+    lbl = lbl.astype(jnp.int32)
+    valid = (lbl != ignore_index)
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None] if axis in (-1, logp.ndim - 1)
+                                 else jnp.expand_dims(safe, axis), axis=axis)
+    picked = jnp.squeeze(picked, axis=axis)
+    if label_smoothing > 0.0:
+        smooth_term = jnp.mean(logp, axis=axis)
+        nll = -(1 - label_smoothing) * picked - label_smoothing * smooth_term
+    else:
+        nll = -picked
+    nll = jnp.where(valid, nll, 0.0)
+    if weight is not None:
+        w = jnp.take(weight.astype(jnp.float32), safe, axis=0)
+        w = jnp.where(valid, w, 0.0)
+        nll = nll * w
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return jnp.sum(nll) / denom
+    return _reduce(nll, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+@defop()
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    lbl = label.astype(jnp.int32)
+    valid = (lbl != ignore_index)
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(input, safe[:, None], axis=1)[:, 0]
+    loss = -jnp.where(valid, picked, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, safe, axis=0)
+        w = jnp.where(valid, w, 0.0)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    return _reduce(loss, reduction)
+
+
+@defop()
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@defop()
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@defop()
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@defop()
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    x = jnp.clip(input.astype(jnp.float32), 1e-12, 1 - 1e-7)
+    loss = -(label * jnp.log(x) + (1 - label) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@defop()
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    z = logit.astype(jnp.float32)
+    lbl = label.astype(jnp.float32)
+    # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight on y term
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * lbl + 1
+        loss = (1 - lbl) * z + log_w * (jnp.logaddexp(0, -jnp.abs(z))
+                                        + jnp.maximum(-z, 0))
+    else:
+        loss = jnp.maximum(z, 0) - z * lbl + jnp.logaddexp(0, -jnp.abs(z))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@defop()
+def kl_div(input, label, reduction="mean", log_target=False):
+    """input is log-probabilities (paddle convention)."""
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        safe = jnp.where(label > 0, label, 1.0)
+        loss = jnp.where(label > 0, label * (jnp.log(safe) - input), 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@defop()
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+@defop()
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1, input, jnp.maximum(0, margin - input))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@defop()
+def log_loss(input, label, epsilon=1e-4):
+    x = jnp.clip(input, epsilon, 1 - epsilon)
+    return -(label * jnp.log(x) + (1 - label) * jnp.log(1 - x))
+
+
+@defop()
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label \
+        + jnp.logaddexp(0, -jnp.abs(logit))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@defop()
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p),
+                                 axis=-1), 1.0 / p)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    return _reduce(jnp.maximum(0, d_pos - d_neg + margin), reduction)
+
+
+@defop()
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(label + epsilon) - label \
+            + 0.5 * jnp.log(2 * jnp.pi * (label + epsilon))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax's implementation (XLA-friendly dynamic programming).
+
+    Reference: nn/functional/loss.py ctc_loss (warpctc). Input layout is
+    paddle's [T, N, C] unless already [N, T, C]."""
+    import optax
+
+    def fn(lp, lbl, in_len, lbl_len):
+        logits = jnp.transpose(lp, (1, 0, 2)) if lp.ndim == 3 else lp
+        n, t, c = logits.shape
+        logit_pad = (jnp.arange(t)[None, :] >= in_len[:, None]).astype(jnp.float32)
+        max_l = lbl.shape[1]
+        label_pad = (jnp.arange(max_l)[None, :] >= lbl_len[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits, logit_pad, lbl, label_pad,
+                                 blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(per_seq / jnp.maximum(lbl_len, 1))
+        if reduction == "sum":
+            return jnp.sum(per_seq)
+        return per_seq
+
+    return run_op("ctc_loss", fn,
+                  (log_probs, labels, input_lengths, label_lengths))
+
+
+@defop()
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    """Gaussian negative log likelihood (reference
+    `nn/functional/loss.py:gaussian_nll_loss`): 0.5*(log(var) +
+    (input-label)^2/var), variance clamped at ``epsilon``; ``full`` adds
+    the 0.5*log(2*pi) constant."""
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * jnp.pi, loss.dtype))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    """Multi-label one-vs-all soft margin (reference
+    `nn/functional/loss.py:multi_label_soft_margin_loss`): per-class
+    sigmoid BCE averaged over classes."""
+    logsig = jax.nn.log_sigmoid
+    per_class = -(label * logsig(input) + (1 - label) * logsig(-input))
+    if weight is not None:
+        per_class = per_class * weight
+    loss = jnp.mean(per_class, axis=-1)
+    return _reduce(loss, reduction)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family combined margin softmax (reference
+    `nn/functional/loss.py:margin_cross_entropy`, CUDA kernel
+    `phi/kernels/gpu/margin_cross_entropy_kernel.cu`): the target
+    class's logit cos(theta) becomes cos(margin1*theta + margin2) -
+    margin3 before scaled softmax CE. The reference's model-parallel
+    ``group`` is GSPMD's job here — shard the class dim of ``logits``
+    and the same code compiles to the sharded softmax."""
+    from ...framework.tensor import run_op
+
+    m1, m2, m3, s = (float(margin1), float(margin2), float(margin3),
+                     float(scale))
+
+    def fn(logits, label):
+        n, c = logits.shape
+        cos = jnp.clip(logits.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target_cos = jnp.cos(m1 * theta + m2) - m3
+        onehot = jax.nn.one_hot(label.reshape(-1), c, dtype=jnp.float32)
+        adjusted = jnp.where(onehot > 0, target_cos, cos) * s
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        if reduction == "mean":
+            loss_out = jnp.mean(loss)
+        elif reduction == "sum":
+            loss_out = jnp.sum(loss)
+        else:
+            loss_out = loss
+        return loss_out, jnp.exp(logp)
+
+    loss, softmax = run_op("margin_cross_entropy", fn, (logits, label))
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+@defop()
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    """Huber loss (reference op `huber_loss`,
+    `phi/kernels/impl/huber_loss_kernel_impl.h`): quadratic within
+    ``delta`` of the target, linear beyond."""
+    d = float(delta)
+    r = jnp.abs(input - label)
+    loss = jnp.where(r <= d, 0.5 * r * r, d * (r - 0.5 * d))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def identity_loss(x, reduction="none"):
+    """Pass-through loss head (reference op `identity_loss`) — reduces
+    its input and marks it as the optimization target."""
+    if isinstance(reduction, int):
+        reduction = {0: "sum", 1: "mean", 2: "none"}[reduction]
+    return _reduce(x, reduction)
+
+
+@defop()
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None):
+    """Hierarchical sigmoid loss (reference op `hsigmoid_loss`,
+    `phi/kernels/cpu/hsigmoid_loss_kernel.cc`). Default mode walks a
+    complete binary tree over ``num_classes`` leaves (internal nodes
+    0..C-2, leaf of class c at c + C - 1); custom mode takes explicit
+    ``path_table``/``path_code``. Cost per sample is the summed
+    BCE-with-logits of each branch decision on the path:
+    sum(softplus(z) - code * z), z = x . w_node + b_node."""
+    x = jnp.asarray(input)
+    lbl = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    n = x.shape[0]
+    if path_table is not None:
+        tbl = jnp.asarray(path_table).astype(jnp.int32)   # [N, L]
+        code = jnp.asarray(path_code).astype(x.dtype)     # [N, L]
+        valid = tbl >= 0
+        tbl = jnp.maximum(tbl, 0)
+    else:
+        c = int(num_classes)
+        depth = max(int(math.ceil(math.log2(max(c, 2)))), 1)
+        # walk leaf -> root in the complete binary tree, then reverse
+        leaf = lbl + (c - 1)
+        steps = []
+        node = leaf
+        for _ in range(depth + 1):
+            parent = (node - 1) // 2
+            is_right = (node == 2 * parent + 2)
+            at_root = node <= 0
+            steps.append((jnp.where(at_root, -1, parent),
+                          is_right.astype(x.dtype),
+                          ~at_root))
+            node = jnp.maximum(parent, 0)
+        tbl = jnp.stack([s[0] for s in steps], axis=1)
+        code = jnp.stack([s[1] for s in steps], axis=1)
+        valid = jnp.stack([s[2] for s in steps], axis=1) & (tbl >= 0)
+        tbl = jnp.maximum(tbl, 0)
+    w = jnp.asarray(weight)                               # [C-1, D]
+    z = jnp.einsum("nd,nld->nl", x, w[tbl])
+    if bias is not None:
+        z = z + jnp.asarray(bias).reshape(-1)[tbl]
+    per = jax.nn.softplus(z) - code * z
+    cost = jnp.sum(jnp.where(valid, per, 0.0), axis=1, keepdims=True)
+    return cost
+
+
+def _edit_distance_one(hyp, ref, hlen, rlen):
+    """Levenshtein DP as nested scans: the outer scan walks hypothesis
+    tokens (rows frozen past hlen), the inner scan threads the
+    left-neighbor dependency along the reference axis."""
+    s2 = ref.shape[0]
+    row0 = jnp.arange(s2 + 1, dtype=jnp.float32)
+
+    def outer(prev, i):
+        first = prev[0] + 1
+
+        def inner(left, j):
+            cost = jnp.where(hyp[i] == ref[j], 0.0, 1.0)
+            val = jnp.minimum(jnp.minimum(prev[j + 1] + 1, left + 1),
+                              prev[j] + cost)
+            return val, val
+
+        _, rest = jax.lax.scan(inner, first, jnp.arange(s2))
+        new = jnp.concatenate([first[None], rest])
+        return jnp.where(i < hlen, new, prev), None
+
+    last, _ = jax.lax.scan(outer, row0, jnp.arange(hyp.shape[0]))
+    return jnp.take(last, rlen)
+
+
+@defop(differentiable=False)
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance per sequence pair (reference op
+    `edit_distance`, `phi/kernels/impl/edit_distance_kernel_impl.h`).
+    Returns (distance [B, 1], sequence_num [1])."""
+    hyp = jnp.asarray(input)
+    ref = jnp.asarray(label)
+    if hyp.ndim == 3:
+        hyp = hyp[..., 0]
+    if ref.ndim == 3:
+        ref = ref[..., 0]
+    b = hyp.shape[0]
+    hlen = (jnp.asarray(input_length).reshape(-1) if input_length is not None
+            else jnp.full((b,), hyp.shape[1]))
+    rlen = (jnp.asarray(label_length).reshape(-1) if label_length is not None
+            else jnp.full((b,), ref.shape[1]))
+    if ignored_tokens:
+        # compact each row: drop ignored tokens, shift survivors left
+        def compact(seq, ln):
+            keep = jnp.ones(seq.shape, bool)
+            for t in ignored_tokens:
+                keep &= seq != t
+            keep &= jnp.arange(seq.shape[0]) < ln
+            order = jnp.argsort(~keep, stable=True)
+            return seq[order], jnp.sum(keep.astype(jnp.int32))
+
+        hyp, hlen = jax.vmap(compact)(hyp, hlen)
+        ref, rlen = jax.vmap(compact)(ref, rlen)
+    hlen = hlen.astype(jnp.int32)
+    rlen = rlen.astype(jnp.int32)
+    dist = jax.vmap(_edit_distance_one)(hyp, ref, hlen, rlen)
+    if normalized:
+        dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+    return dist[:, None], jnp.asarray([b], jnp.int32)

@@ -1,0 +1,197 @@
+"""Normalization functionals.
+
+Reference: `python/paddle/nn/functional/norm.py` (layer_norm, batch_norm,
+instance_norm, group_norm, local_response_norm) plus the fused
+``rms_norm`` from `python/paddle/incubate/nn/functional/fused_rms_norm.py`.
+All are single fused jnp expressions — XLA folds them into neighboring
+matmuls on TPU; a Pallas path can override via the kernels registry later.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.registry import defop
+from ...framework.tensor import Tensor, run_op, no_grad
+
+__all__ = ["layer_norm", "rms_norm", "batch_norm", "instance_norm",
+           "group_norm", "local_response_norm", "spectral_norm"]
+
+
+@defop()
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    # reduce in fp32 for bf16 stability (TPU norm idiom)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@defop()
+def rms_norm(x, weight=None, epsilon=1e-6, bias=None, axis=-1):
+    """RMSNorm (reference: incubate fused_rms_norm). fp32 accumulation.
+    ``axis`` may be an int or tuple (incubate's begin_norm_axis maps to
+    ``tuple(range(begin_norm_axis, ndim))``)."""
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    out = (xf * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None):
+    """Reference: nn/functional/norm.py batch_norm.
+
+    In training mode batch statistics are used and the running buffers are
+    updated in place (the update itself is untracked, like the reference's
+    in-place running-stat op). ``momentum`` follows paddle's convention:
+    running = momentum * running + (1 - momentum) * batch.
+    """
+    channel_axis = 1 if data_format.startswith("NC") or x.ndim <= 2 else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    use_batch = training and not use_global_stats
+
+    if use_batch:
+        def fn(x_, w_, b_):
+            xf = x_.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            var = jnp.var(xf, axis=reduce_axes)
+            shape = [1] * x_.ndim
+            shape[channel_axis] = -1
+            out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(
+                var.reshape(shape) + epsilon)
+            out = out.astype(x_.dtype)
+            if w_ is not None:
+                out = out * w_.reshape(shape)
+            if b_ is not None:
+                out = out + b_.reshape(shape)
+            return out, mean, var
+
+        out, mean, var = run_op("batch_norm", fn, (x, weight, bias))
+        with no_grad():
+            n = 1
+            for i in reduce_axes:
+                n *= x.shape[i]
+            unbiased = var._data * (n / max(n - 1, 1))
+            rm_dt = running_mean._data.dtype
+            rv_dt = running_var._data.dtype
+            running_mean._data = (momentum * running_mean._data
+                                  + (1 - momentum) * mean._data).astype(rm_dt)
+            running_var._data = (momentum * running_var._data
+                                 + (1 - momentum) * unbiased).astype(rv_dt)
+        return out
+
+    def fn(x_, rm_, rv_, w_, b_):
+        shape = [1] * x_.ndim
+        shape[channel_axis] = -1
+        xf = x_.astype(jnp.float32)
+        out = (xf - rm_.reshape(shape).astype(jnp.float32)) * jax.lax.rsqrt(
+            rv_.reshape(shape).astype(jnp.float32) + epsilon)
+        out = out.astype(x_.dtype)
+        if w_ is not None:
+            out = out * w_.reshape(shape)
+        if b_ is not None:
+            out = out + b_.reshape(shape)
+        return out
+
+    return run_op("batch_norm_infer", fn,
+                  (x, running_mean, running_var, weight, bias))
+
+
+@defop()
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5,
+                  data_format="NCHW"):
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(2, x.ndim)) \
+        if channel_axis == 1 else tuple(range(1, x.ndim - 1))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=reduce_axes, keepdims=True)
+    var = jnp.var(xf, axis=reduce_axes, keepdims=True)
+    out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[channel_axis] = -1
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop()
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    g = int(num_groups)
+    if data_format.startswith("NC"):
+        n, c = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        xg = x.reshape((n, g, c // g) + spatial)
+        axes = tuple(range(2, xg.ndim))
+        shape = [1, -1] + [1] * len(spatial)
+    else:
+        n, c = x.shape[0], x.shape[-1]
+        spatial = x.shape[1:-1]
+        xg = x.reshape((n,) + spatial + (g, c // g))
+        axes = tuple(range(1, len(spatial) + 1)) + (xg.ndim - 1,)
+        shape = [1] * (len(spatial) + 1) + [-1]
+    xf = xg.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    out = out.reshape(x.shape)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop()
+def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    c = x.shape[channel_axis]
+    half = size // 2
+    pads = [(0, 0)] * x.ndim
+    pads[channel_axis] = (half, size - half - 1)
+    sq = jnp.pad(sq, pads)
+    window = [1] * x.ndim
+    window[channel_axis] = size
+    # scalar init keeps the (init, op) monoid recognizable to JAX autodiff
+    acc = jax.lax.reduce_window(sq, 0.0, jax.lax.add,
+                                tuple(window), (1,) * x.ndim, "VALID")
+    # reference normalizes by the window *mean* (avg_pool), not the sum
+    return x / jnp.power(k + alpha * acc / size, beta)
+
+
+@defop()
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12):
+    """Normalize ``weight`` by its largest singular value, estimated by
+    power iteration (reference op `spectral_norm`,
+    `phi/kernels/impl/spectral_norm_kernel_impl.h`)."""
+    w = jnp.moveaxis(weight, int(dim), 0)
+    mat = w.reshape(w.shape[0], -1)
+    u = jnp.ones((mat.shape[0],), mat.dtype)
+    v = jnp.ones((mat.shape[1],), mat.dtype)
+    for _ in range(max(int(power_iters), 1)):
+        v = mat.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+        u = mat @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    sigma = u @ mat @ v
+    return weight / jnp.maximum(sigma, eps)

@@ -1,0 +1,485 @@
+"""Pooling functionals via ``lax.reduce_window``.
+
+Reference: `python/paddle/nn/functional/pooling.py`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.registry import defop
+
+__all__ = ["max_pool1d", "max_pool2d", "max_pool3d",
+           "avg_pool1d", "avg_pool2d", "avg_pool3d",
+           "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+           "max_pool2d_with_index", "max_pool3d_with_index",
+           "fractional_max_pool2d", "fractional_max_pool3d",
+           "max_unpool1d", "max_unpool2d", "max_unpool3d", "pool2d", "pool3d"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(e) for e in v)
+    return (int(v),) * n
+
+
+def _pool_pad(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if all(isinstance(p, int) for p in padding):
+        if len(padding) == nd:
+            return [(p, p) for p in padding]
+        if len(padding) == 2 * nd:
+            return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    return [tuple(int(e) for e in p) for p in padding]
+
+
+def _reduce_init(reduce_fn, dtype):
+    """Identity element for a reduce_window monoid, as a Python/numpy
+    scalar — array-wrapped inits defeat JAX's monoid recognition and lose
+    the op's autodiff rule under jit."""
+    if reduce_fn is jax.lax.add:
+        return 0.0
+    if jnp.issubdtype(dtype, jnp.floating):
+        return float("-inf")
+    return np.dtype(dtype).type(jnp.iinfo(dtype).min)
+
+
+def _reduce_pool(x, kernel, stride, padding, nd, channel_last, init, op,
+                 ceil_mode=False):
+    k = _tuple(kernel, nd)
+    s = _tuple(stride if stride is not None else kernel, nd)
+    p = _pool_pad(padding, nd)
+    if channel_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = ([(0, 0)] + p + [(0, 0)]) if isinstance(p, list) else p
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = ([(0, 0), (0, 0)] + p) if isinstance(p, list) else p
+    # init must stay a Python scalar: JAX recognizes the (init, op) monoid
+    # (sum/max/min) only for literal identities — wrapping it in an array
+    # defeats the detection and the op loses its autodiff rule under jit.
+    if isinstance(pads, list) and ceil_mode:
+        # grow right-pad so the last partial window is included
+        spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+        base = 1 if channel_last else 2
+        pads = list(pads)
+        for i in range(nd):
+            size = spatial[i] + pads[base + i][0] + pads[base + i][1]
+            rem = (size - k[i]) % s[i]
+            if rem != 0:
+                lo, hi = pads[base + i]
+                pads[base + i] = (lo, hi + (s[i] - rem))
+    return jax.lax.reduce_window(x, init, op, window, strides, pads), \
+        (window, strides, pads)
+
+
+def _max_pool(x, kernel, stride, padding, nd, data_format, ceil_mode):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    neg = _reduce_init(jax.lax.max, x.dtype)
+    out, _ = _reduce_pool(x, kernel, stride, padding, nd, channel_last,
+                          neg, jax.lax.max, ceil_mode)
+    return out
+
+
+def _avg_pool(x, kernel, stride, padding, nd, data_format, exclusive,
+              ceil_mode):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    summed, (window, strides, pads) = _reduce_pool(
+        x, kernel, stride, padding, nd, channel_last, 0.0, jax.lax.add,
+        ceil_mode)
+    if exclusive and not isinstance(pads, str):
+        ones = jnp.ones(x.shape, dtype=x.dtype)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                       window, strides, pads)
+        return summed / counts
+    return summed / float(np.prod(_tuple(kernel, nd)))
+
+
+@defop()
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCL"):
+    if return_mask:
+        k = _tuple(kernel_size, 1)
+        st = _tuple(stride, 1) if stride is not None else k
+        dims = _fixed_window_dims(x.shape[2:], k, st, _tuple(padding, 1),
+                                  ceil_mode)
+        return _windowed_max(x, dims, True)
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _max_pool(x, kernel_size, stride, padding, 1, fmt, ceil_mode)
+
+
+@defop()
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    if return_mask:
+        k = _tuple(kernel_size, 2)
+        st = _tuple(stride, 2) if stride is not None else k
+        dims = _fixed_window_dims(x.shape[2:], k, st, _tuple(padding, 2),
+                                  ceil_mode)
+        return _windowed_max(x, dims, True)
+    return _max_pool(x, kernel_size, stride, padding, 2, data_format,
+                     ceil_mode)
+
+
+@defop()
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    if return_mask:
+        k = _tuple(kernel_size, 3)
+        st = _tuple(stride, 3) if stride is not None else k
+        dims = _fixed_window_dims(x.shape[2:], k, st, _tuple(padding, 3),
+                                  ceil_mode)
+        return _windowed_max(x, dims, True)
+    return _max_pool(x, kernel_size, stride, padding, 3, data_format,
+                     ceil_mode)
+
+
+@defop()
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL"):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _avg_pool(x, kernel_size, stride, padding, 1, fmt, exclusive,
+                     ceil_mode)
+
+
+@defop()
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCHW"):
+    return _avg_pool(x, kernel_size, stride, padding, 2, data_format,
+                     exclusive, ceil_mode)
+
+
+@defop()
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCDHW"):
+    return _avg_pool(x, kernel_size, stride, padding, 3, data_format,
+                     exclusive, ceil_mode)
+
+
+def _adaptive_windows(in_size, out_size):
+    """start/end indices per output cell, paddle/torch adaptive convention."""
+    starts = [int(np.floor(i * in_size / out_size)) for i in range(out_size)]
+    ends = [int(np.ceil((i + 1) * in_size / out_size)) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, nd, data_format, reduce_fn):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    out_sizes = _tuple(output_size, nd)
+    spatial_base = 1 if channel_last else 2
+    # uniform case lowers to one strided reduce_window (fast path)
+    in_sizes = x.shape[spatial_base:spatial_base + nd]
+    if all(i % o == 0 for i, o in zip(in_sizes, out_sizes)):
+        k = tuple(i // o for i, o in zip(in_sizes, out_sizes))
+        if channel_last:
+            window = (1,) + k + (1,)
+        else:
+            window = (1, 1) + k
+        init = _reduce_init(reduce_fn, x.dtype)
+        out = jax.lax.reduce_window(x, init, reduce_fn, window, window,
+                                    "VALID")
+        if reduce_fn is jax.lax.add:
+            out = out / float(np.prod(k))
+        return out
+    # general case: gather per-cell slices (static loop, still one XLA graph)
+    for d in range(nd):
+        axis = spatial_base + d
+        starts, ends = _adaptive_windows(x.shape[axis], out_sizes[d])
+        pieces = []
+        for s, e in zip(starts, ends):
+            sl = jax.lax.slice_in_dim(x, s, e, axis=axis)
+            if reduce_fn is jax.lax.add:
+                pieces.append(jnp.mean(sl, axis=axis, keepdims=True))
+            else:
+                pieces.append(jnp.max(sl, axis=axis, keepdims=True))
+        x = jnp.concatenate(pieces, axis=axis)
+    return x
+
+
+@defop()
+def adaptive_avg_pool1d(x, output_size, data_format="NCL"):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _adaptive_pool(x, output_size, 1, fmt, jax.lax.add)
+
+
+@defop()
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, data_format, jax.lax.add)
+
+
+@defop()
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, data_format, jax.lax.add)
+
+
+@defop()
+def adaptive_max_pool1d(x, output_size, return_mask=False, data_format="NCL"):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _adaptive_pool(x, output_size, 1, fmt, jax.lax.max)
+
+
+@defop()
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, data_format, jax.lax.max)
+
+
+@defop()
+def adaptive_max_pool3d(x, output_size, return_mask=False,
+                        data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, data_format, jax.lax.max)
+
+
+# -- with-index / fractional / unpool family (reference ops
+#    max_pool2d_with_index, max_pool3d_with_index, fractional_max_pool2d/3d,
+#    unpool, unpool3d — `phi/kernels/funcs/pooling.h`) ----------------------
+def _window_positions(in_size, starts, ends):
+    """Static (numpy) gather positions for variable windows: returns
+    pos [out, kmax] clipped and valid [out, kmax] masks."""
+    starts = np.asarray(starts, np.int64)
+    ends = np.asarray(ends, np.int64)
+    kmax = int((ends - starts).max())
+    a = np.arange(kmax)[None, :]
+    pos = starts[:, None] + a
+    valid = (pos < ends[:, None]) & (pos >= 0) & (pos < in_size)
+    return np.clip(pos, 0, in_size - 1), valid
+
+
+def _windowed_max(x, dims, with_index):
+    """Max (and argmax flat index) over per-output-cell windows.
+
+    ``x`` is [N, C, *spatial]; ``dims`` is a list of (pos, valid) pairs
+    from :func:`_window_positions`, one per spatial dim. One
+    outer-product gather builds [N, C, O1, k1, O2, k2, ...]; a masked
+    max (+ take-along argmax) reduces the k axes. The flat index is in
+    the reference's convention: row-major over the unpadded spatial
+    volume."""
+    nd = len(dims)
+    idx_arrays, valid, absidx = [], None, None
+    spatial = x.shape[2:]
+    for d, (pos, v) in enumerate(dims):
+        shape = [1] * (2 * nd)
+        shape[2 * d], shape[2 * d + 1] = pos.shape
+        idx_arrays.append(jnp.asarray(pos.reshape(shape)))
+        v = v.reshape(shape)
+        valid = v if valid is None else (valid & v)
+        p = pos.reshape(shape)
+        # row-major flat index over the unpadded volume
+        absidx = p if absidx is None else absidx * spatial[d] + p
+    win = x[(Ellipsis, *idx_arrays)]          # [N, C, O1, k1, O2, k2, ...]
+    inter = win.shape[2:]
+    # interleaved -> grouped: [N, C, O1..On, k1..kn]
+    perm_sp = [2 * d for d in range(nd)] + [2 * d + 1 for d in range(nd)]
+    win = jnp.transpose(win, [0, 1] + [2 + p for p in perm_sp])
+    vmask = jnp.asarray(
+        np.transpose(np.broadcast_to(valid, inter), perm_sp))
+    neg = jnp.asarray(-np.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                      else np.iinfo(np.dtype(x.dtype).name).min, x.dtype)
+    win = jnp.where(vmask, win, neg)
+    flat = win.reshape(win.shape[:2 + nd] + (-1,))   # [N,C,O...,K]
+    out = jnp.max(flat, axis=-1)
+    if not with_index:
+        return out, None
+    absflat = np.transpose(np.broadcast_to(absidx, inter), perm_sp)
+    absflat = jnp.asarray(absflat.reshape(absflat.shape[:nd] + (-1,)))
+    arg = jnp.argmax(flat, axis=-1)
+    idx = jnp.take_along_axis(jnp.broadcast_to(absflat, flat.shape),
+                              arg[..., None], axis=-1)[..., 0]
+    return out, idx.astype(jnp.int32)
+
+
+def _fixed_window_dims(spatial, kernel, stride, padding, ceil_mode):
+    dims = []
+    for s, k, st, p in zip(spatial, kernel, stride, padding):
+        n_out = (s + 2 * p - k + (st - 1 if ceil_mode else 0)) // st + 1
+        starts = np.arange(n_out) * st - p
+        dims.append(_window_positions(s, starts, starts + k))
+    return dims
+
+
+@defop()
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False,
+                          ceil_mode=False):
+    """Reference op `max_pool2d_with_index`: max pool returning the
+    flat (h*W + w) argmax per window."""
+    k = _tuple(kernel_size, 2)
+    st = _tuple(stride, 2) if stride is not None else k
+    p = _tuple(padding, 2)
+    if global_pooling:
+        k, st, p = x.shape[2:], x.shape[2:], (0, 0)
+    dims = _fixed_window_dims(x.shape[2:], k, st, p, ceil_mode)
+    return _windowed_max(x, dims, True)
+
+
+@defop()
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False,
+                          ceil_mode=False):
+    """Reference op `max_pool3d_with_index` (flat d*H*W + h*W + w)."""
+    k = _tuple(kernel_size, 3)
+    st = _tuple(stride, 3) if stride is not None else k
+    p = _tuple(padding, 3)
+    if global_pooling:
+        k, st, p = x.shape[2:], x.shape[2:], (0, 0, 0)
+    dims = _fixed_window_dims(x.shape[2:], k, st, p, ceil_mode)
+    return _windowed_max(x, dims, True)
+
+
+def _fractional_dims(spatial, out_sizes, kernel, u):
+    """Reference fractional windows (`phi/kernels/funcs/pooling.h`
+    FractionalStartIndex/EndIndex + FractionalRationalU)."""
+    dims = []
+    for d, (s, o) in enumerate(zip(spatial, out_sizes)):
+        alpha = s / o
+        ks = 0 if kernel is None else kernel[d]
+        if ks > 0:
+            uu = u
+        else:
+            base = s // o
+            u_max1 = (base + 2) / alpha - 1
+            u_max2 = (s + 1 - base) / alpha - (o - 1)
+            uu = u * min(u_max1, u_max2)
+        i = np.arange(o)
+        starts = ((i + uu) * alpha).astype(np.int64) - int(uu * alpha)
+        if ks > 0:
+            ends = starts + ks
+        else:
+            ends = ((i + 1 + uu) * alpha).astype(np.int64) - int(uu * alpha)
+        dims.append(_window_positions(s, starts, np.minimum(ends, s)))
+    return dims
+
+
+@defop()
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False):
+    """Fractional max pooling (Graham 2015; reference op
+    `fractional_max_pool2d`). ``random_u`` fixes the pseudo-random
+    offset; otherwise one is drawn per call."""
+    o = _tuple(output_size, 2)
+    k = _tuple(kernel_size, 2) if kernel_size is not None else None
+    u = float(random_u) if random_u is not None \
+        else float(np.random.uniform(0.1, 0.9))
+    dims = _fractional_dims(x.shape[2:], o, k, u)
+    out, idx = _windowed_max(x, dims, return_mask)
+    return (out, idx) if return_mask else out
+
+
+@defop()
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False):
+    """3-D fractional max pooling (reference op
+    `fractional_max_pool3d`)."""
+    o = _tuple(output_size, 3)
+    k = _tuple(kernel_size, 3) if kernel_size is not None else None
+    u = float(random_u) if random_u is not None \
+        else float(np.random.uniform(0.1, 0.9))
+    dims = _fractional_dims(x.shape[2:], o, k, u)
+    out, idx = _windowed_max(x, dims, return_mask)
+    return (out, idx) if return_mask else out
+
+
+def _unpool(x, indices, out_spatial):
+    """Scatter pooled values back at their argmax positions."""
+    n, c = x.shape[:2]
+    flat_len = int(np.prod(out_spatial))
+    xf = x.reshape(n, c, -1)
+    idxf = indices.reshape(n, c, -1).astype(jnp.int32)
+    out = jnp.zeros((n, c, flat_len), x.dtype)
+    out = out.at[jnp.arange(n)[:, None, None],
+                 jnp.arange(c)[None, :, None], idxf].set(xf)
+    return out.reshape((n, c) + tuple(out_spatial))
+
+
+def _unpool_out_size(in_spatial, kernel, stride, padding, output_size):
+    if output_size is not None:
+        out = [int(s) for s in output_size]
+        return out[-len(in_spatial):] if len(out) > len(in_spatial) else out
+    return [(s - 1) * st - 2 * p + k
+            for s, k, st, p in zip(in_spatial, kernel, stride, padding)]
+
+
+@defop(name="unpool")
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    """Inverse of max_pool2d(return_mask=True) (reference op `unpool`,
+    `phi/kernels/gpu/unpool_kernel.cu`)."""
+    k = _tuple(kernel_size, 2)
+    st = _tuple(stride, 2) if stride is not None else k
+    p = _tuple(padding, 2)
+    return _unpool(x, indices,
+                   _unpool_out_size(x.shape[2:], k, st, p, output_size))
+
+
+@defop(name="unpool3d")
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW"):
+    """Inverse of max_pool3d_with_index (reference op `unpool3d`)."""
+    k = _tuple(kernel_size, 3)
+    st = _tuple(stride, 3) if stride is not None else k
+    p = _tuple(padding, 3)
+    return _unpool(x, indices,
+                   _unpool_out_size(x.shape[2:], k, st, p, output_size))
+
+
+@defop()
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL"):
+    """Inverse of max_pool1d(return_mask=True) (reference
+    `nn/functional/pooling.py:max_unpool1d`)."""
+    k = _tuple(kernel_size, 1)
+    st = _tuple(stride, 1) if stride is not None else k
+    p = _tuple(padding, 1)
+    return _unpool(x, indices,
+                   _unpool_out_size(x.shape[2:], k, st, p, output_size))
+
+
+@defop()
+def pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+           exclusive=True, data_format="NCHW", pooling_type="max",
+           global_pooling=False, adaptive=False):
+    """Legacy unified pooling op (reference legacy op `pool2d`)."""
+    if global_pooling:
+        kernel_size = x.shape[2:] if data_format == "NCHW" else x.shape[1:3]
+        stride, padding = kernel_size, 0
+    if adaptive:
+        fn = (adaptive_max_pool2d if pooling_type == "max"
+              else adaptive_avg_pool2d)
+        out = fn(x, kernel_size, data_format=data_format)
+        return getattr(out, "_data", out)
+    if pooling_type == "max":
+        return _max_pool(x, kernel_size, stride, padding, 2, data_format,
+                         ceil_mode)
+    return _avg_pool(x, kernel_size, stride, padding, 2, data_format,
+                     exclusive, ceil_mode)
+
+
+@defop()
+def pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+           exclusive=True, data_format="NCDHW", pooling_type="max",
+           global_pooling=False, adaptive=False):
+    """Legacy unified pooling op (reference legacy op `pool3d`)."""
+    if global_pooling:
+        kernel_size = x.shape[2:] if data_format == "NCDHW" \
+            else x.shape[1:4]
+        stride, padding = kernel_size, 0
+    if adaptive:
+        fn = (adaptive_max_pool3d if pooling_type == "max"
+              else adaptive_avg_pool3d)
+        out = fn(x, kernel_size, data_format=data_format)
+        return getattr(out, "_data", out)
+    if pooling_type == "max":
+        return _max_pool(x, kernel_size, stride, padding, 3, data_format,
+                         ceil_mode)
+    return _avg_pool(x, kernel_size, stride, padding, 3, data_format,
+                     exclusive, ceil_mode)

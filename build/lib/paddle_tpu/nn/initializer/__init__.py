@@ -1,0 +1,228 @@
+"""Weight initializers.
+
+Reference: `python/paddle/nn/initializer/` (Constant/Normal/Uniform/Xavier/
+Kaiming/TruncatedNormal/Assign). TPU-native design: an initializer is a pure
+function of (PRNG key, shape, dtype) -> jax array — keys come from the
+framework Generator so initialization is reproducible and, under ``jit``
+tracing, fully functional.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as frandom
+from ...framework.tensor import Tensor
+from ...framework import dtype as dtypes
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+    "set_global_initializer",
+]
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    """Reference: `python/paddle/nn/initializer/initializer.py` gain table."""
+    table = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0), "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity in table:
+        return table[nonlinearity]
+    raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+
+
+def _fan_in_fan_out(shape):
+    """Fan computation matching the reference's convention: for a 2-D weight
+    of shape [in, out] (paddle Linear stores W as [in_features, out_features]),
+    fan_in = shape[0]; conv weights are [out_c, in_c, *k]."""
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        dtype = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        key = frandom.next_key()
+        return self._generate(key, tuple(int(s) for s in shape), dtype)
+
+    def _generate(self, key, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _generate(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _generate(self, key, shape, dtype):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * self.std
+                + self.mean).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    """Truncated to [mean - a*std, mean + b*std] (default 2 std)."""
+
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def _generate(self, key, shape, dtype):
+        x = jax.random.truncated_normal(key, self.a, self.b, shape, jnp.float32)
+        return (x * self.std + self.mean).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def _generate(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, jnp.float32,
+                                  minval=self.low, maxval=self.high).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, key, shape, dtype):
+        fi, fo = _fan_in_fan_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, key, shape, dtype):
+        fi, fo = _fan_in_fan_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, jnp.float32,
+                                  minval=-limit, maxval=limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, key, shape, dtype):
+        fi, _ = _fan_in_fan_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) \
+            if self.nonlinearity == "leaky_relu" else calculate_gain(self.nonlinearity)
+        std = gain / math.sqrt(fi)
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, key, shape, dtype):
+        fi, _ = _fan_in_fan_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) \
+            if self.nonlinearity == "leaky_relu" else calculate_gain(self.nonlinearity)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(key, shape, jnp.float32,
+                                  minval=-limit, maxval=limit).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self.value = np.asarray(value)
+
+    def _generate(self, key, shape, dtype):
+        v = jnp.asarray(self.value, dtype=dtype)
+        if tuple(v.shape) != tuple(shape):
+            raise ValueError(
+                f"Assign initializer shape {v.shape} != parameter shape {shape}")
+        return v
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def _generate(self, key, shape, dtype):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal init needs >=2 dims")
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        q = jax.random.orthogonal(key, max(rows, cols), dtype=jnp.float32)
+        q = q[:rows, :cols]
+        return (self.gain * q.reshape(shape)).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference nn/initializer/dirac.py)."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def _generate(self, key, shape, dtype):
+        if len(shape) < 3:
+            raise ValueError("Dirac init needs a conv weight (>=3 dims)")
+        out_c, in_c = shape[0], shape[1]
+        w = np.zeros(shape, dtype=np.float32)
+        centers = [s // 2 for s in shape[2:]]
+        min_c = min(out_c // self.groups, in_c)
+        for g in range(self.groups):
+            for i in range(min_c):
+                idx = (g * (out_c // self.groups) + i, i) + tuple(centers)
+                w[idx] = 1.0
+        return jnp.asarray(w, dtype=dtype)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference: `python/paddle/nn/initializer/__init__.py`
+    set_global_initializer."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _default_weight_init():
+    return _global_weight_init or XavierNormal()
+
+
+def _default_bias_init():
+    return _global_bias_init or Constant(0.0)

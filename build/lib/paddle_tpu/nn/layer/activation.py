@@ -1,0 +1,94 @@
+"""Activation layers. Reference: `python/paddle/nn/layer/activation.py`."""
+
+from __future__ import annotations
+
+from .layers import Layer
+from .. import functional as F
+from ..initializer import Constant
+
+__all__ = ["ReLU", "ReLU6", "GELU", "SiLU", "Sigmoid", "Tanh", "Softmax",
+           "LogSoftmax", "LeakyReLU", "ELU", "SELU", "CELU", "PReLU",
+           "Hardshrink", "Hardsigmoid", "Hardswish", "Hardtanh", "Softplus",
+           "Softshrink", "Softsign", "Swish", "Mish", "Tanhshrink",
+           "ThresholdedReLU", "LogSigmoid", "GLU", "Maxout", "RReLU"]
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = dict(fixed)
+            sig_names = _sigs.get(fn_name, [])
+            for n, v in zip(sig_names, args):
+                self._kwargs[n] = v
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+    _Act.__name__ = fn_name
+    return _Act
+
+
+_sigs = {
+    "relu": [], "relu6": [], "silu": [], "sigmoid": [], "tanh": [],
+    "gelu": ["approximate"],
+    "softmax": ["axis"], "log_softmax": ["axis"],
+    "leaky_relu": ["negative_slope"], "elu": ["alpha"], "selu": [],
+    "celu": ["alpha"], "hardshrink": ["threshold"], "hardsigmoid": [],
+    "hardswish": [], "hardtanh": ["min", "max"],
+    "softplus": ["beta", "threshold"], "softshrink": ["threshold"],
+    "softsign": [], "swish": [], "mish": [], "tanhshrink": [],
+    "thresholded_relu": ["threshold", "value"], "log_sigmoid": [],
+    "glu": ["axis"], "maxout": ["groups", "axis"],
+}
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+GELU = _simple("gelu")
+SiLU = _simple("silu")
+Sigmoid = _simple("sigmoid")
+Tanh = _simple("tanh")
+Softmax = _simple("softmax")
+LogSoftmax = _simple("log_softmax")
+LeakyReLU = _simple("leaky_relu")
+ELU = _simple("elu")
+SELU = _simple("selu")
+CELU = _simple("celu")
+Hardshrink = _simple("hardshrink")
+Hardsigmoid = _simple("hardsigmoid")
+Hardswish = _simple("hardswish")
+Hardtanh = _simple("hardtanh")
+Softplus = _simple("softplus")
+Softshrink = _simple("softshrink")
+Softsign = _simple("softsign")
+Swish = _simple("swish")
+Mish = _simple("mish")
+Tanhshrink = _simple("tanhshrink")
+ThresholdedReLU = _simple("thresholded_relu")
+LogSigmoid = _simple("log_sigmoid")
+GLU = _simple("glu")
+Maxout = _simple("maxout")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

@@ -1,0 +1,397 @@
+"""The ``Layer`` base class — the model-authoring surface.
+
+Reference: `python/paddle/nn/layer/layers.py:332` (``Layer``): parameter /
+buffer / sublayer registries, hooks, ``state_dict``/``set_state_dict``,
+train/eval mode, ``apply``, ``to``. TPU-native notes: parameters are eager
+``Parameter`` tensors whose payloads are ``jax.Array``s; under
+``paddle_tpu.jit`` tracing the same objects carry tracers, so one Layer
+definition serves both the eager debug path and the compiled XLA path.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterator
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, Parameter
+from ...framework import dtype as dtypes
+from ..initializer import (Initializer, Constant, _default_weight_init,
+                           _default_bias_init)
+
+__all__ = ["Layer", "ParamAttr"]
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: `python/paddle/base/param_attr.py`)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"invalid ParamAttr: {attr!r}")
+
+
+class HookRemoveHelper:
+    next_hook_id = 0
+
+    def __init__(self, hooks):
+        self._hooks = hooks
+        self._hook_id = HookRemoveHelper.next_hook_id
+        HookRemoveHelper.next_hook_id += 1
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    """Base class for all NN layers (reference Layer, layers.py:332)."""
+
+    def __init__(self, name_scope=None, dtype=None):
+        self.training = True
+        self._dtype = dtype or dtypes.get_default_dtype()
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._init_in_dynamic_mode = True
+
+    # -- forward ------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    # -- registration -------------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """Reference: layers.py create_parameter."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype
+        init = attr.initializer or default_initializer or \
+            (_default_bias_init() if is_bias else _default_weight_init())
+        data = init(shape, dtype)
+        p = Parameter(data, name=attr.name, trainable=attr.trainable)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def create_tensor(self, name=None, persistable=False, dtype=None):
+        t = Tensor(jnp.zeros([], dtype=dtypes.convert_dtype(dtype or self._dtype)))
+        t.name = name
+        t.persistable = persistable
+        return t
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError(f"add_parameter expects Parameter, got "
+                            f"{type(parameter).__name__}")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        if sublayer is not None and not isinstance(sublayer, Layer):
+            raise TypeError(f"add_sublayer expects Layer, got "
+                            f"{type(sublayer).__name__}")
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        """Reference: layers.py register_buffer — non-parameter state that
+        joins state_dict when persistable (e.g. BN running stats)."""
+        if tensor is not None and not isinstance(tensor, Tensor):
+            raise TypeError("register_buffer expects a Tensor")
+        self._buffers[name] = tensor
+        if persistable:
+            self._non_persistable_buffer_names.discard(name)
+        else:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- attribute magic ----------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() first")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() first")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            elif isinstance(value, Tensor):
+                params[name].set_value(value)
+            else:
+                raise TypeError(f"cannot assign {type(value).__name__} to "
+                                f"parameter '{name}'")
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                buffers[name].set_value(value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = []
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d:
+                extra.extend(d.keys())
+        return list(super().__dir__()) + extra
+
+    # -- traversal ----------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        memo = set()
+        for name, layer in self.named_sublayers(prefix=prefix,
+                                                include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in memo:
+                    continue
+                memo.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        memo = set()
+        for name, layer in self.named_sublayers(prefix=prefix,
+                                                include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in memo:
+                    continue
+                memo.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+            if not include_sublayers:
+                break
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        memo = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in memo:
+                memo.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            if l is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from l.named_sublayers(prefix=sub_prefix, include_self=True,
+                                         layers_set=layers_set)
+
+    def apply(self, fn: Callable[["Layer"], None]):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def full_name(self):
+        return self._name_scope
+
+    # -- mode ---------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        helper = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[helper._hook_id] = hook
+        return helper
+
+    def register_forward_post_hook(self, hook):
+        helper = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[helper._hook_id] = hook
+        return helper
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        """Reference: layers.py state_dict — parameters + persistable
+        buffers keyed by structured names."""
+        destination = destination if destination is not None \
+            else collections.OrderedDict()
+        for name, p in self.named_parameters():
+            destination[structured_name_prefix + name] = p
+        for lname, layer in self.named_sublayers(include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                key = structured_name_prefix + \
+                    (f"{lname}.{bname}" if lname else bname)
+                destination[key] = b
+        return destination
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Reference: layers.py set_state_dict. Returns (missing, unexpected)
+        like the reference's match info."""
+        own = self.state_dict()
+        missing, matched = [], set()
+        for key, target in own.items():
+            if key in state_dict:
+                value = state_dict[key]
+                arr = value._data if isinstance(value, Tensor) else \
+                    jnp.asarray(np.asarray(value))
+                if tuple(arr.shape) != tuple(target._data.shape):
+                    raise ValueError(
+                        f"shape mismatch for '{key}': loaded {list(arr.shape)}"
+                        f" vs parameter {list(target._data.shape)}")
+                target._data = arr.astype(target._data.dtype)
+                matched.add(key)
+            else:
+                missing.append(key)
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- dtype / device movement -------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        def convert(t):
+            if t is None:
+                return t
+            out = t
+            if dtype is not None and jnp.issubdtype(out._data.dtype,
+                                                    jnp.floating):
+                out._data = out._data.astype(dtypes.convert_dtype(dtype))
+            if device is not None:
+                from ...device import _resolve_device
+                import jax
+                out._data = jax.device_put(out._data,
+                                           _resolve_device(str(device)))
+            return t
+
+        for _, p in self.named_parameters():
+            convert(p)
+        for _, b in self.named_buffers():
+            convert(b)
+        if dtype is not None:
+            self._dtype = dtypes.convert_dtype(dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    # -- misc ---------------------------------------------------------------
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self._sub_layers.items():
+            mod_str = repr(l)
+            mod_str = "\n  ".join(mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str}")
+        main = self.__class__.__name__ + "("
+        if extra:
+            main += extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"

@@ -1,0 +1,146 @@
+"""nn.utils — parameter vectorization + clip utilities.
+
+Reference: `python/paddle/nn/utils/`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+__all__ = ["parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_", "weight_norm",
+           "remove_weight_norm", "spectral_norm"]
+
+
+def parameters_to_vector(parameters):
+    return Tensor(jnp.concatenate(
+        [p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters):
+    offset = 0
+    for p in parameters:
+        n = 1
+        for s in p._data.shape:
+            n *= s
+        p._data = vec._data[offset:offset + n].reshape(p._data.shape) \
+            .astype(p._data.dtype)
+        offset += n
+
+
+def _norm_except(v, dim, eps=1e-12):
+    """L2 norm of ``v`` over every axis except ``dim`` (keepdims), the
+    reference's norm_except_dim (`nn/utils/weight_norm_hook.py`)."""
+    from ...tensor import math as tmath
+    if dim is None:
+        axes = None
+    else:
+        axes = [i for i in range(v.ndim) if i != dim]
+    sq = (v * v).sum(axis=axes, keepdim=True)
+    return (sq + eps).sqrt()
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as g * v / ||v|| (reference:
+    `python/paddle/nn/utils/weight_norm_hook.py` ``weight_norm``).
+    ``g`` and ``v`` become the trainable parameters; the effective weight
+    is recomputed (on the tape) before every forward."""
+    from ...framework.tensor import Parameter
+
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    g0 = _norm_except(w, dim)
+    v = Parameter(w._data)
+    g = Parameter(g0._data)
+    del layer._parameters[name]
+    setattr(layer, name + "_v", v)
+    setattr(layer, name + "_g", g)
+
+    def compute(lyr):
+        vv = getattr(lyr, name + "_v")
+        gg = getattr(lyr, name + "_g")
+        wv = vv * (gg / _norm_except(vv, dim))
+        object.__setattr__(lyr, name, wv)
+
+    def hook(lyr, inputs):
+        compute(lyr)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_weight_norm_hooks"):
+        layer._weight_norm_hooks = {}
+    layer._weight_norm_hooks[name] = (handle, dim)
+    compute(layer)   # weight exists even before the first forward
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain parameter and drop the hook."""
+    from ...framework.tensor import Parameter
+
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"{name!r} is not weight-normalized")
+    handle, dim = hooks.pop(name)
+    handle.remove()
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    w = (v * (g / _norm_except(v, dim))).detach()
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    layer.__dict__.pop(name + "_v", None)
+    layer.__dict__.pop(name + "_g", None)
+    layer.__dict__.pop(name, None)
+    setattr(layer, name, Parameter(w._data))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization hook (reference:
+    `python/paddle/nn/utils/spectral_norm_hook.py`): divides the weight by
+    its largest singular value, estimated by power iteration on a
+    persistent ``u`` vector."""
+    import numpy as np
+    from ...framework.tensor import Parameter, Tensor
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 1 if type(layer).__name__.endswith("Transpose") else 0
+    mat = jnp.moveaxis(w._data, dim, 0)
+    rows = mat.shape[0]
+    orig = Parameter(w._data)
+    del layer._parameters[name]
+    setattr(layer, name + "_orig", orig)
+    u0 = np.random.RandomState(0).randn(rows).astype("float32")
+    layer.register_buffer(name + "_u",
+                          Tensor(jnp.asarray(u0 / np.linalg.norm(u0))))
+
+    def compute(lyr):
+        wo = getattr(lyr, name + "_orig")
+        u = getattr(lyr, name + "_u")
+        w2 = jnp.moveaxis(wo._data, dim, 0).reshape(rows, -1)
+        uu = u._data
+        for _ in range(n_power_iterations):
+            vv = w2.T @ uu
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            uu = w2 @ vv
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        u._data = uu                      # persistent power-iteration state
+        # u/v are constants but sigma = u^T W v stays ON the tape, so
+        # backward carries the -W·(u v^T)/sigma^2 term (reference
+        # spectral_norm_hook keeps sigma in the graph)
+        perm = [dim] + [i for i in range(wo.ndim) if i != dim]
+        from ...tensor import manipulation as M
+        w2_t = M.transpose(wo, perm).reshape([rows, -1])
+        uv = Tensor(uu[:, None] * vv[None, :])
+        sigma = (w2_t * uv).sum()
+        object.__setattr__(lyr, name, wo / sigma)
+
+    layer.register_forward_pre_hook(lambda lyr, inputs: compute(lyr))
+    compute(layer)
+    return layer

@@ -1,0 +1,25 @@
+"""``paddle.onnx`` (reference: `python/paddle/onnx/export.py` — thin
+wrapper over the external ``paddle2onnx`` converter).
+
+Faithful gating: like the reference, ``export`` requires the external
+converter and raises ImportError when it is absent (this zero-egress
+build cannot install it). The TPU-native export path is
+``paddle_tpu.jit.save`` (StableHLO), which XLA-capable runtimes load
+directly — preferred over ONNX on TPU serving stacks.
+"""
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError:
+        raise ImportError(
+            "paddle2onnx is required for ONNX export but is not "
+            "installed. On TPU prefer paddle_tpu.jit.save(layer, path, "
+            "input_spec=...) — StableHLO export, loadable by any "
+            "XLA-capable runtime.")
+    raise NotImplementedError(
+        "paddle2onnx found, but its converter consumes the reference's "
+        "Program IR; wire it through jit.save's exported program")

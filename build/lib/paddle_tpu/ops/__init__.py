@@ -1,0 +1,9 @@
+"""``paddle_tpu.ops`` — Pallas TPU kernels (the analog of the reference's
+hand-fused kernel zoo `paddle/phi/kernels/fusion/`).
+
+Kernels register behind ``FLAGS_use_pallas_kernels``; every op has an XLA
+fallback in the functional layer, so this package is a pure acceleration
+seam.
+"""
+
+from . import flash_attention  # noqa: F401

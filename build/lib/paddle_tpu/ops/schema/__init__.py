@@ -1,0 +1,138 @@
+"""Single-source op schema (the reference's `paddle/phi/api/yaml/ops.yaml`).
+
+``ops.yaml`` is the machine-readable inventory of every registered op:
+name, defining module, full Python signature (parameter names, kinds,
+default reprs), differentiability, and Tensor-method attachments. Two
+consumers keep it honest:
+
+- :mod:`paddle_tpu._C_ops` is *generated* from it at import — the
+  reference's generated dispatch surface (`python/paddle/_C_ops.py:20`)
+  — so an op missing from the YAML is not reachable via ``_C_ops``.
+- ``validate_against_registry()`` (run in tests) diffs the YAML against
+  the live ``@defop`` registry in both directions, including signatures
+  and flags, so schema and implementation cannot drift apart — the
+  discipline the reference enforces by generating C++ from the YAML
+  (SURVEY §2.2 "codegen from day one or drown").
+
+Regenerate after adding ops: ``python -m paddle_tpu.ops.schema --update``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+
+import yaml
+
+__all__ = ["load_schema", "snapshot_registry", "validate_against_registry",
+           "SCHEMA_PATH"]
+
+SCHEMA_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "ops.yaml")
+
+_cache = None
+
+
+def load_schema():
+    """Parse ops.yaml → {op_name: entry dict}."""
+    global _cache
+    if _cache is None:
+        with open(SCHEMA_PATH) as f:
+            entries = yaml.safe_load(f)
+        _cache = {e["op"]: e for e in entries}
+        if len(_cache) != len(entries):
+            seen, dups = set(), []
+            for e in entries:
+                if e["op"] in seen:
+                    dups.append(e["op"])
+                seen.add(e["op"])
+            raise ValueError(f"duplicate ops in ops.yaml: {dups}")
+    return _cache
+
+
+def _signature_entry(fn):
+    """Serialize a Python signature to a stable, comparable form."""
+    params = []
+    for p in inspect.signature(fn).parameters.values():
+        entry = {"name": p.name}
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            entry["kind"] = "*args"
+        elif p.kind == inspect.Parameter.VAR_KEYWORD:
+            entry["kind"] = "**kwargs"
+        elif p.kind == inspect.Parameter.KEYWORD_ONLY:
+            entry["kind"] = "kwonly"
+        if p.default is not inspect.Parameter.empty:
+            entry["default"] = repr(p.default)
+        params.append(entry)
+    return params
+
+
+def _import_op_surface():
+    """Import every op-bearing module so the registry is complete.
+
+    The top-level package keeps heavy subpackages (vision, text,
+    geometric) lazy; the schema is the inventory of ALL ops, so the
+    snapshot/validation path must load them deterministically."""
+    import importlib
+
+    for mod in ("paddle_tpu", "paddle_tpu.vision.ops", "paddle_tpu.text",
+                "paddle_tpu.geometric", "paddle_tpu.signal",
+                "paddle_tpu.incubate.nn.functional",
+                "paddle_tpu.ops.schema.surface"):
+        importlib.import_module(mod)
+
+
+def snapshot_registry():
+    """The live @defop registry in schema form (sorted by op name)."""
+    from paddle_tpu.tensor.registry import OPS
+
+    _import_op_surface()
+    if not OPS:
+        raise RuntimeError("op registry empty — import paddle_tpu first")
+    out = []
+    for name in sorted(OPS):
+        info = OPS[name]
+        entry = {
+            "op": name,
+            "module": info["module"],
+            "args": _signature_entry(info["fn"]),
+            "differentiable": bool(info["differentiable"]),
+        }
+        if info.get("method"):
+            entry["method"] = info["method"]
+        if info.get("inplace"):
+            entry["inplace"] = info["inplace"]
+        out.append(entry)
+    return out
+
+
+def validate_against_registry():
+    """Return a list of human-readable drift errors (empty == in sync)."""
+    schema = load_schema()
+    live = {e["op"]: e for e in snapshot_registry()}
+    errors = []
+    for name in sorted(set(schema) - set(live)):
+        errors.append(f"ops.yaml lists '{name}' but no @defop registers it")
+    for name in sorted(set(live) - set(schema)):
+        errors.append(f"op '{name}' ({live[name]['module']}) is registered "
+                      "but missing from ops.yaml — run "
+                      "`python -m paddle_tpu.ops.schema --update`")
+    for name in sorted(set(live) & set(schema)):
+        for key in ("module", "args", "differentiable", "method", "inplace"):
+            want, got = schema[name].get(key), live[name].get(key)
+            if want != got:
+                errors.append(
+                    f"op '{name}' drifted in '{key}': "
+                    f"ops.yaml={want!r} registry={got!r}")
+    return errors
+
+
+def write_schema(path=None):
+    entries = snapshot_registry()
+    with open(path or SCHEMA_PATH, "w") as f:
+        f.write("# Generated op inventory — the single-source schema.\n"
+                "# Regenerate: python -m paddle_tpu.ops.schema --update\n"
+                "# (tests fail if this file and the @defop registry "
+                "disagree)\n")
+        yaml.safe_dump(entries, f, sort_keys=False, width=79)
+    return len(entries)

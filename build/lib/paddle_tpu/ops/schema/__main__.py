@@ -1,0 +1,31 @@
+"""CLI: ``python -m paddle_tpu.ops.schema --update|--check``."""
+
+import argparse
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--update", action="store_true",
+                   help="rewrite ops.yaml from the live registry")
+    g.add_argument("--check", action="store_true",
+                   help="exit 1 if ops.yaml drifted from the registry")
+    args = ap.parse_args()
+
+    import paddle_tpu  # noqa: F401  — registers every op
+    from . import validate_against_registry, write_schema
+
+    if args.update:
+        n = write_schema()
+        print(f"wrote {n} ops")
+        return 0
+    errors = validate_against_registry()
+    for e in errors:
+        print(e, file=sys.stderr)
+    print(f"{'DRIFTED' if errors else 'OK'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+"""Inventory of reference ops implemented as plain public functions.
+
+The reference's `phi/api/yaml/ops.yaml` + `legacy_ops.yaml` list these
+as ops; in this framework they are public functions that wrap ``run_op``
+directly (variadic inputs, eager RNG draws, tuple returns — shapes that
+don't fit the ``@defop`` template). Importing this module records each
+one in the registry so the single-source schema (and the generated
+``_C_ops`` surface) covers the full op inventory. Dispatch goes through
+the same public autograd-aware function.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from ...tensor.registry import OPS, register_existing
+
+#: (reference op name, module, attribute, records-grad)
+_EXISTING = [
+    ("add_n", "paddle_tpu.tensor.math", "add_n", True),
+    ("amax", "paddle_tpu.tensor.math", "amax", True),
+    ("amin", "paddle_tpu.tensor.math", "amin", True),
+    ("remainder", "paddle_tpu.tensor.math", "remainder", True),
+    ("scale", "paddle_tpu.tensor", "scale", True),
+    ("arange", "paddle_tpu.tensor.creation", "arange", False),
+    ("linspace", "paddle_tpu.tensor.creation", "linspace", False),
+    ("logspace", "paddle_tpu.tensor.creation", "logspace", False),
+    ("eye", "paddle_tpu.tensor.creation", "eye", False),
+    ("empty", "paddle_tpu.tensor.creation", "empty", False),
+    ("empty_like", "paddle_tpu.tensor.creation", "empty_like", False),
+    ("zeros", "paddle_tpu.tensor.creation", "zeros", False),
+    ("zeros_like", "paddle_tpu.tensor.creation", "zeros_like", False),
+    ("ones", "paddle_tpu.tensor.creation", "ones", False),
+    ("ones_like", "paddle_tpu.tensor.creation", "ones_like", False),
+    ("full", "paddle_tpu.tensor.creation", "full", False),
+    ("full_like", "paddle_tpu.tensor.creation", "full_like", False),
+    ("meshgrid", "paddle_tpu.tensor.creation", "meshgrid", True),
+    ("tril_indices", "paddle_tpu.tensor.creation", "tril_indices", False),
+    ("triu_indices", "paddle_tpu.tensor.creation", "triu_indices", False),
+    ("concat", "paddle_tpu.tensor.manipulation", "concat", True),
+    ("stack", "paddle_tpu.tensor.manipulation", "stack", True),
+    ("unstack", "paddle_tpu.tensor.manipulation", "unstack", True),
+    ("broadcast_tensors", "paddle_tpu.tensor.manipulation",
+     "broadcast_tensors", True),
+    ("as_strided", "paddle_tpu.tensor.manipulation", "as_strided", True),
+    ("unique", "paddle_tpu.tensor.manipulation", "unique", False),
+    ("unique_consecutive", "paddle_tpu.tensor.manipulation",
+     "unique_consecutive", False),
+    ("topk", "paddle_tpu.tensor.search", "topk", True),
+    ("kthvalue", "paddle_tpu.tensor.search", "kthvalue", True),
+    ("mode", "paddle_tpu.tensor.search", "mode", True),
+    ("nonzero", "paddle_tpu.tensor.search", "nonzero", False),
+    ("top_p_sampling", "paddle_tpu.tensor.search", "top_p_sampling", False),
+    ("multi_dot", "paddle_tpu.tensor.linalg", "multi_dot", True),
+    ("is_empty", "paddle_tpu.tensor.logic", "is_empty", False),
+    ("numel", "paddle_tpu.tensor.attribute", "numel", False),
+    ("shape", "paddle_tpu.tensor.attribute", "shape", False),
+    ("bernoulli", "paddle_tpu.tensor.random", "bernoulli", False),
+    ("binomial", "paddle_tpu.tensor.random", "binomial", False),
+    ("multinomial", "paddle_tpu.tensor.random", "multinomial", False),
+    ("poisson", "paddle_tpu.tensor.random", "poisson", False),
+    ("randint", "paddle_tpu.tensor.random", "randint", False),
+    ("randperm", "paddle_tpu.tensor.random", "randperm", False),
+    ("uniform", "paddle_tpu.tensor.random", "uniform", False),
+    ("gaussian", "paddle_tpu.tensor.random", "gaussian", False),
+    ("standard_gamma", "paddle_tpu.tensor.random", "standard_gamma", False),
+    ("exponential_", "paddle_tpu.tensor.random", "exponential_", False),
+    ("batch_norm", "paddle_tpu.nn.functional.norm", "batch_norm", True),
+    ("dropout", "paddle_tpu.nn.functional.common", "dropout", True),
+    ("gumbel_softmax", "paddle_tpu.nn.functional.activation",
+     "gumbel_softmax", True),
+    ("rrelu", "paddle_tpu.nn.functional.activation", "rrelu", True),
+    ("softplus", "paddle_tpu.nn.functional.activation", "softplus", True),
+    ("tanh_shrink", "paddle_tpu.nn.functional.activation", "tanhshrink",
+     True),
+    ("logsigmoid", "paddle_tpu.nn.functional.activation", "log_sigmoid",
+     True),
+    ("margin_cross_entropy", "paddle_tpu.nn.functional.loss",
+     "margin_cross_entropy", True),
+    ("nms", "paddle_tpu.vision.ops", "nms", False),
+    ("roi_align", "paddle_tpu.vision.ops", "roi_align", True),
+    ("roi_pool", "paddle_tpu.vision.ops", "roi_pool", True),
+    ("frame", "paddle_tpu.signal", "frame", True),
+    ("overlap_add", "paddle_tpu.signal", "overlap_add", True),
+    ("send_u_recv", "paddle_tpu.geometric", "send_u_recv", True),
+    ("send_ue_recv", "paddle_tpu.geometric", "send_ue_recv", True),
+    ("send_uv", "paddle_tpu.geometric", "send_uv", True),
+    ("swiglu", "paddle_tpu.incubate.nn.functional", "swiglu", True),
+    ("class_center_sample", "paddle_tpu.nn.functional.common",
+     "class_center_sample", False),
+    ("reverse", "paddle_tpu.tensor.manipulation", "reverse", True),
+    ("inverse", "paddle_tpu.tensor.linalg", "inv", True),
+    ("kldiv_loss", "paddle_tpu.nn.functional.loss", "kl_div", True),
+    ("bce_loss", "paddle_tpu.nn.functional.loss", "binary_cross_entropy",
+     True),
+    ("sigmoid_cross_entropy_with_logits", "paddle_tpu.nn.functional.loss",
+     "binary_cross_entropy_with_logits", True),
+    ("cross_entropy_with_softmax", "paddle_tpu.nn.functional.loss",
+     "softmax_with_cross_entropy", True),
+    ("warpctc", "paddle_tpu.nn.functional.loss", "ctc_loss", True),
+    ("deformable_conv", "paddle_tpu.vision.ops", "deform_conv2d", True),
+    ("flash_attn", "paddle_tpu.ops.flash_attention", "flash_attention",
+     True),
+    ("matrix_rank_tol", "paddle_tpu.tensor.linalg", "matrix_rank", False),
+    ("segment_pool", "paddle_tpu.geometric", "segment_pool", True),
+    ("accuracy", "paddle_tpu.metric", "accuracy", False),
+    ("truncated_gaussian_random", "paddle_tpu.tensor.random",
+     "truncated_gaussian_random", False),
+    ("dirichlet", "paddle_tpu.tensor.random", "dirichlet", False),
+]
+
+
+def register_surface():
+    for op_name, mod_name, attr, diff in _EXISTING:
+        if op_name in OPS:
+            continue
+        fn = getattr(importlib.import_module(mod_name), attr)
+        register_existing(fn, op_name, differentiable=diff)
+
+
+register_surface()

@@ -1,0 +1,13 @@
+"""``paddle_tpu.optimizer`` — optimizers + LR schedules.
+
+Reference: `python/paddle/optimizer/__init__.py`.
+"""
+
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Momentum, Adagrad, Adam, AdamW, Adamax, RMSProp, Adadelta, Lamb,
+)
+from . import lr  # noqa: F401
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW",
+           "Adamax", "RMSProp", "Adadelta", "Lamb", "lr"]

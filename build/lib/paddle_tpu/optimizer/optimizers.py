@@ -1,0 +1,307 @@
+"""The optimizer zoo.
+
+Reference: `python/paddle/optimizer/{sgd,momentum,adagrad,adam,adamw,adamax,
+rmsprop,adadelta,lamb}.py`. Update rules match the reference's kernels
+(`paddle/phi/kernels/*_kernel.h` semantics); all math is pure jnp so each
+``step`` compiles into the train-step XLA computation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adagrad", "Adam", "AdamW", "Adamax",
+           "RMSProp", "Adadelta", "Lamb"]
+
+
+class SGD(Optimizer):
+    def _single_update(self, p, g, lr, value):
+        return value - jnp.asarray(lr, value.dtype) * g.astype(value.dtype)
+
+
+class Momentum(Optimizer):
+    """Reference: `python/paddle/optimizer/momentum.py` (velocity form)."""
+
+    _accum_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _single_update(self, p, g, lr, value):
+        v = self._get_accumulator("velocity", p)._data
+        g = g.astype(v.dtype)
+        mu = jnp.asarray(self._momentum, v.dtype)
+        v_new = mu * v + g
+        self._set_accumulator("velocity", p, v_new)
+        lr = jnp.asarray(lr, value.dtype)
+        if self._use_nesterov:
+            return value - lr * (g + mu * v_new).astype(value.dtype)
+        return value - lr * v_new.astype(value.dtype)
+
+
+class Adagrad(Optimizer):
+    _accum_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._initial_accumulator_value = initial_accumulator_value
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("moment", p,
+                                  fill_value=self._initial_accumulator_value)
+
+    def _single_update(self, p, g, lr, value):
+        m = self._get_accumulator("moment", p)._data
+        g = g.astype(m.dtype)
+        m_new = m + g * g
+        self._set_accumulator("moment", p, m_new)
+        upd = g / (jnp.sqrt(m_new) + self._epsilon)
+        return value - jnp.asarray(lr, value.dtype) * upd.astype(value.dtype)
+
+
+class Adam(Optimizer):
+    """Reference: `python/paddle/optimizer/adam.py` — bias-corrected via
+    beta-power accumulators, exactly the phi adam kernel recurrence."""
+
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            if self._amsgrad:
+                self._add_accumulator("moment2_max", p)
+            self._add_accumulator("beta1_pow_acc", p, dtype="float32",
+                                  fill_value=1.0, shape=())
+            self._add_accumulator("beta2_pow_acc", p, dtype="float32",
+                                  fill_value=1.0, shape=())
+
+    def _adam_moments(self, p, g):
+        m = self._get_accumulator("moment1", p)._data
+        v = self._get_accumulator("moment2", p)._data
+        b1p = self._get_accumulator("beta1_pow_acc", p)._data * self._beta1
+        b2p = self._get_accumulator("beta2_pow_acc", p)._data * self._beta2
+        g = g.astype(m.dtype)
+        m_new = self._beta1 * m + (1 - self._beta1) * g
+        v_new = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_accumulator("moment1", p, m_new)
+        self._set_accumulator("moment2", p, v_new)
+        self._set_accumulator("beta1_pow_acc", p, b1p)
+        self._set_accumulator("beta2_pow_acc", p, b2p)
+        if self._amsgrad:
+            v_max = jnp.maximum(
+                self._get_accumulator("moment2_max", p)._data, v_new)
+            self._set_accumulator("moment2_max", p, v_max)
+            v_new = v_max
+        return m_new, v_new, b1p, b2p
+
+    def _single_update(self, p, g, lr, value):
+        m_new, v_new, b1p, b2p = self._adam_moments(p, g)
+        lr_t = jnp.asarray(lr, jnp.float32) * jnp.sqrt(1 - b2p) / (1 - b1p)
+        # epsilon scales with sqrt(1-beta2^t) exactly like the reference phi
+        # kernel (adam_functors.h:225): m / (sqrt(v) + eps*sqrt(1-beta2_pow))
+        upd = m_new / (jnp.sqrt(v_new)
+                       + self._epsilon * jnp.sqrt(1 - b2p))
+        return value - (lr_t.astype(value.dtype)
+                        * upd.astype(value.dtype))
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference `adamw.py:40`): decay applies to the
+    parameter directly, not through the gradient."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._coeff = float(weight_decay) if not hasattr(weight_decay, "coeff") \
+            else weight_decay.coeff
+        self._lr_ratio = lr_ratio
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_regularization(self, p, g):
+        return g  # decay is decoupled
+
+    def _single_update(self, p, g, lr, value):
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        with_decay = True
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            with_decay = False
+        coeff = self._coeff
+        if self._group_weight_decay is not None:
+            gw = self._group_weight_decay
+            coeff = float(getattr(gw, "coeff", gw))
+        if with_decay and coeff != 0.0:
+            value = value * (1.0 - jnp.asarray(lr, jnp.float32)
+                             * coeff).astype(value.dtype)
+        return super()._single_update(p, g, lr, value)
+
+
+class Adamax(Optimizer):
+    _accum_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, dtype="float32",
+                                  fill_value=1.0, shape=())
+
+    def _single_update(self, p, g, lr, value):
+        m = self._get_accumulator("moment", p)._data
+        u = self._get_accumulator("inf_norm", p)._data
+        b1p = self._get_accumulator("beta1_pow_acc", p)._data * self._beta1
+        g = g.astype(m.dtype)
+        m_new = self._beta1 * m + (1 - self._beta1) * g
+        u_new = jnp.maximum(self._beta2 * u, jnp.abs(g) + self._epsilon)
+        self._set_accumulator("moment", p, m_new)
+        self._set_accumulator("inf_norm", p, u_new)
+        self._set_accumulator("beta1_pow_acc", p, b1p)
+        lr_t = jnp.asarray(lr, jnp.float32) / (1 - b1p)
+        return value - (lr_t * (m_new / u_new)).astype(value.dtype)
+
+
+class RMSProp(Optimizer):
+    _accum_names = ("mean_square", "mean_grad", "momentum_acc")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _single_update(self, p, g, lr, value):
+        ms = self._get_accumulator("mean_square", p)._data
+        mom = self._get_accumulator("momentum_acc", p)._data
+        g = g.astype(ms.dtype)
+        ms_new = self._rho * ms + (1 - self._rho) * g * g
+        self._set_accumulator("mean_square", p, ms_new)
+        denom = ms_new
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", p)._data
+            mg_new = self._rho * mg + (1 - self._rho) * g
+            self._set_accumulator("mean_grad", p, mg_new)
+            denom = ms_new - mg_new * mg_new
+        lr = jnp.asarray(lr, ms.dtype)
+        mom_new = self._momentum * mom + lr * g / jnp.sqrt(
+            denom + self._epsilon)
+        self._set_accumulator("momentum_acc", p, mom_new)
+        return value - mom_new.astype(value.dtype)
+
+
+class Adadelta(Optimizer):
+    _accum_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _single_update(self, p, g, lr, value):
+        ag = self._get_accumulator("avg_squared_grad", p)._data
+        au = self._get_accumulator("avg_squared_update", p)._data
+        g = g.astype(ag.dtype)
+        ag_new = self._rho * ag + (1 - self._rho) * g * g
+        upd = jnp.sqrt(au + self._epsilon) / jnp.sqrt(
+            ag_new + self._epsilon) * g
+        au_new = self._rho * au + (1 - self._rho) * upd * upd
+        self._set_accumulator("avg_squared_grad", p, ag_new)
+        self._set_accumulator("avg_squared_update", p, au_new)
+        return value - jnp.asarray(lr, value.dtype) * upd.astype(value.dtype)
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference `python/paddle/optimizer/lamb.py`)."""
+
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, dtype="float32",
+                                  fill_value=1.0, shape=())
+            self._add_accumulator("beta2_pow_acc", p, dtype="float32",
+                                  fill_value=1.0, shape=())
+
+    def _single_update(self, p, g, lr, value):
+        m = self._get_accumulator("moment1", p)._data
+        v = self._get_accumulator("moment2", p)._data
+        b1p = self._get_accumulator("beta1_pow_acc", p)._data * self._beta1
+        b2p = self._get_accumulator("beta2_pow_acc", p)._data * self._beta2
+        g = g.astype(jnp.float32)
+        m_new = self._beta1 * m + (1 - self._beta1) * g
+        v_new = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_accumulator("moment1", p, m_new)
+        self._set_accumulator("moment2", p, v_new)
+        self._set_accumulator("beta1_pow_acc", p, b1p)
+        self._set_accumulator("beta2_pow_acc", p, b2p)
+        m_hat = m_new / (1 - b1p)
+        v_hat = v_new / (1 - b2p)
+        wd = self._lamb_weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        val32 = value.astype(jnp.float32)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + wd * val32
+        w_norm = jnp.linalg.norm(val32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (val32 - jnp.asarray(lr, jnp.float32) * trust * r).astype(
+            value.dtype)

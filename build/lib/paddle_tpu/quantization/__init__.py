@@ -1,0 +1,332 @@
+"""``paddle.quantization`` — QAT / PTQ.
+
+Reference: `python/paddle/quantization/` (``QuantConfig``, ``QAT.quantize``
+fake-quant wrapping, ``PTQ`` observer calibration, ``convert`` to the
+deployed int8 form) with observers in `quantization/observers/` and
+quanters in `quanters/`.
+
+TPU-native mechanics: fake-quantization is a pure jnp round-to-grid with
+a straight-through estimator (``jax.custom_vjp`` identity gradient), so
+QAT steps stay one fused XLA program; ``convert`` stores int8 weights +
+fp scales and dequantizes on the fly (int8 x bf16 upcasts ride the MXU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..framework.tensor import Parameter, Tensor, run_op
+
+__all__ = ["BaseObserver", "AbsmaxObserver", "PerChannelAbsmaxObserver",
+           "FakeQuanterWithAbsMax", "QuantConfig", "QAT", "PTQ",
+           "QuantedLinear", "quant_dequant"]
+
+
+# ---------------------------------------------------------------------------
+# fake quant with straight-through estimator
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=8)
+def _ste_fn(bits):
+    qmax = float(2 ** (bits - 1) - 1)
+
+    @jax.custom_vjp
+    def fq(x, scale):
+        s = jnp.maximum(scale, 1e-9)
+        return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+
+    def fwd(x, scale):
+        return fq(x, scale), None
+
+    def bwd(_, g):
+        return g, None          # straight-through: d(fq)/dx ~= 1
+
+    fq.defvjp(fwd, bwd)
+    return fq
+
+
+def quant_dequant(x, scale, bits=8):
+    """Tape-integrated fake quantization (STE gradient)."""
+    return run_op("quant_dequant",
+                  lambda a, s: _ste_fn(bits)(a, s), (x, scale))
+
+
+# ---------------------------------------------------------------------------
+# observers (reference observers/abs_max.py)
+# ---------------------------------------------------------------------------
+class BaseObserver:
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def observe(self, x):
+        raise NotImplementedError
+
+    def scale(self):
+        if self._scale is None:
+            raise RuntimeError("observer has seen no data")
+        return self._scale
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running per-tensor absmax."""
+
+    def observe(self, x):
+        arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        m = float(np.abs(arr).max()) if arr.size else 0.0
+        self._scale = m if self._scale is None else max(self._scale, m)
+        return self._scale
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    """Per-output-channel absmax (weights; channel axis = last)."""
+
+    def __init__(self, quant_bits=8, channel_axis=-1):
+        super().__init__(quant_bits)
+        self.channel_axis = channel_axis
+
+    def observe(self, x):
+        arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        axes = tuple(i for i in range(arr.ndim)
+                     if i != (self.channel_axis % arr.ndim))
+        m = np.abs(arr).max(axis=axes)
+        self._scale = m if self._scale is None \
+            else np.maximum(self._scale, m)
+        return self._scale
+
+
+class FakeQuanterWithAbsMax:
+    """Quanter factory used by QuantConfig (reference
+    quanters/abs_max.py): per-call absmax scale during QAT."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+
+    def __call__(self, x):
+        def fn(a):
+            s = jnp.max(jnp.abs(a))
+            return _ste_fn(self.quant_bits)(a, s)
+
+        return run_op("fake_quant_absmax", fn, (x,))
+
+
+# ---------------------------------------------------------------------------
+# config + quantized layers
+# ---------------------------------------------------------------------------
+class QuantConfig:
+    """Reference quantization/config.py. ``activation``/``weight`` are
+    quanter factories applied to every matched layer."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or FakeQuanterWithAbsMax(8)
+        self.weight = weight or FakeQuanterWithAbsMax(8)
+        self._types = (nn.Linear,)
+        self._per_type = {}   # layer type -> (activation, weight)
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        for t in layer_types:
+            if not (isinstance(t, type) and issubclass(t, nn.Linear)):
+                raise NotImplementedError(
+                    f"quantization of {getattr(t, '__name__', t)} is not "
+                    "supported yet (only Linear-family layers); the "
+                    "QuantedLinear wrapper computes F.linear")
+            self._per_type[t] = (activation, weight)
+        self._types = tuple(set(self._types) | set(layer_types))
+
+    def quanters_for(self, layer):
+        for t, (a, w) in self._per_type.items():
+            if isinstance(layer, t):
+                return (a or self.activation, w or self.weight)
+        return (self.activation, self.weight)
+
+
+class QuantedLinear(nn.Layer):
+    """Linear with fake-quantized weights + activations (QAT form)."""
+
+    def __init__(self, linear, config):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self._act_q, self._w_q = config.quanters_for(linear)
+
+    def forward(self, x):
+        xq = self._act_q(x)
+        wq = self._w_q(self.weight)
+        from ..nn import functional as F
+        return F.linear(xq, wq, self.bias)
+
+
+class ConvertedLinear(nn.Layer):
+    """Deployed int8 form: int8 weights + fp32 scale, dequant on use.
+    With a calibrated ``act_scale`` (PTQ), inputs are snapped to the int8
+    grid too, matching the deployed runtime's numerics."""
+
+    def __init__(self, weight_i8, scale, bias, act_scale=None):
+        super().__init__()
+        self.register_buffer("weight_int8", Tensor(weight_i8))
+        self.register_buffer("weight_scale", Tensor(scale))
+        self.bias = bias
+        self.act_scale = None if act_scale is None \
+            else Tensor(np.float32(act_scale))
+
+    def forward(self, x):
+        act_scale = self.act_scale
+
+        def fn(xa, wi8, s, b, a_s):
+            if a_s is not None:
+                xa = _ste_fn(8)(xa, a_s)
+            w = wi8.astype(jnp.float32) * (s / 127.0)
+            y = xa @ w
+            return y + b if b is not None else y
+
+        return run_op("int8_linear", fn,
+                      (x, self.weight_int8, self.weight_scale, self.bias,
+                       act_scale))
+
+
+def _replace_sublayers(model, predicate, build):
+    for name, sub in list(model._sub_layers.items()):
+        if predicate(sub):
+            model._sub_layers[name] = build(sub)
+        else:
+            _replace_sublayers(sub, predicate, build)
+    return model
+
+
+def _maybe_copy(model, inplace):
+    if inplace:
+        return model
+    import copy
+    return copy.deepcopy(model)
+
+
+class QAT:
+    """Quantization-aware training driver (reference qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        cfg = self.config
+        return _replace_sublayers(
+            _maybe_copy(model, inplace),
+            lambda l: isinstance(l, cfg._types),
+            lambda l: QuantedLinear(l, cfg))
+
+    def convert(self, model, inplace=False):
+        return _convert(_maybe_copy(model, inplace))
+
+
+class PTQ:
+    """Post-training quantization: calibrate observers, then convert —
+    convert() bakes each observed layer's activation scale into its
+    deployed form."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+        self._observers = {}
+
+    def quantize(self, model, inplace=False):
+        model = _maybe_copy(model, inplace)
+        # attach activation observers via forward hooks
+        for name, sub in model.named_sublayers(include_self=False):
+            if isinstance(sub, self.config._types):
+                obs = AbsmaxObserver()
+                self._observers[name] = obs
+
+                def hook(lyr, inputs, o=obs):
+                    o.observe(inputs[0])
+                    return None   # observe only — never replace inputs
+
+                sub.register_forward_pre_hook(hook)
+        return model
+
+    def convert(self, model, inplace=False):
+        model = _maybe_copy(model, inplace)
+        scales = {}
+        for name, obs in self._observers.items():
+            try:
+                scales[name] = float(obs.scale())
+            except RuntimeError:
+                pass  # never calibrated: weight-only for this layer
+        return _convert(model, act_scales=scales)
+
+
+def _convert(model, act_scales=None):
+    act_scales = act_scales or {}
+    names = {id(sub): name
+             for name, sub in model.named_sublayers(include_self=False)}
+
+    def build(l):
+        w = l.weight.numpy()
+        scale = np.abs(w).max() or 1.0
+        wi8 = np.clip(np.round(w / scale * 127.0), -127, 127) \
+            .astype(np.int8)
+        return ConvertedLinear(wi8, np.float32(scale), l.bias,
+                               act_scale=act_scales.get(names.get(id(l))))
+
+    return _replace_sublayers(
+        model, lambda l: isinstance(l, (nn.Linear, QuantedLinear)), build)
+
+
+# -- weight-only quant ops (reference ops `weight_quantize`,
+#    `weight_dequantize`, `weight_only_linear`, `llm_int8_linear` —
+#    `phi/kernels/gpu/weight_only_linear_kernel.cu`) ------------------------
+from ..tensor.registry import defop as _defop
+
+
+@_defop(name="weight_quantize", differentiable=False)
+def weight_quantize(x, algo="weight_only_int8"):
+    """Per-out-channel abs-max int8 quantization of a [in, out] weight.
+    Returns (int8 weight, float scale [out])."""
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise ValueError(f"unsupported algo {algo!r}")
+    scale = jnp.max(jnp.abs(x), axis=0) / 127.0
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-12)), -127, 127) \
+        .astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+@_defop(name="weight_dequantize", differentiable=False)
+def weight_dequantize(x, scale, algo="weight_only_int8"):
+    return x.astype(jnp.float32) * scale[None, :]
+
+
+@_defop(name="weight_only_linear")
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8"):
+    """y = x @ dequant(W) (+ b): weights stay int8 in HBM (half the
+    bandwidth of bf16 — the decode bottleneck), dequantized on the fly
+    in the matmul's epilogue (XLA fuses the scale multiply)."""
+    w = weight.astype(x.dtype)
+    if weight_scale is not None:
+        y = jnp.matmul(x, w) * weight_scale[None, :].astype(x.dtype)
+    else:
+        y = jnp.matmul(x, w)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@_defop(name="llm_int8_linear")
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """LLM.int8() linear (reference op `llm_int8_linear`): columns of
+    ``x`` with outlier magnitude > threshold run in full precision,
+    the rest through the int8 path."""
+    w = weight.astype(jnp.float32)
+    if weight_scale is not None:
+        w = w * weight_scale[None, :]
+    # With the weight dequantized to fp32 the reference's outlier split
+    # (int8 path for calm columns, fp path for outliers) is numerically
+    # a single matmul — one MXU pass, same result.
+    y = jnp.matmul(x.astype(jnp.float32), w).astype(x.dtype)
+    if bias is not None:
+        y = y + bias
+    return y

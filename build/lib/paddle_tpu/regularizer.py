@@ -1,0 +1,28 @@
+"""Weight-decay regularizers.
+
+Reference: `python/paddle/regularizer.py` (L1Decay / L2Decay). Consumed by
+``Optimizer._apply_regularization`` — L2 folds ``coeff * param`` into the
+gradient, L1 folds ``coeff * sign(param)``.
+"""
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    _l1 = True
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"
+
+
+class L2Decay:
+    _l1 = False
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"

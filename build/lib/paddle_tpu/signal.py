@@ -1,0 +1,158 @@
+"""``paddle.signal`` — STFT / ISTFT (reference: `python/paddle/signal.py`
+stft:246, istft:423; CUDA frame/overlap-add kernels in
+`phi/kernels/gpu/{frame,overlap_add}_*`).
+
+TPU-native: framing is a strided gather XLA folds into the FFT's input
+layout; the FFT itself is XLA's native (MXU-accelerated for the matmul
+decomposition sizes). ISTFT overlap-add is a scatter-add over frame
+positions plus the standard squared-window normalization.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.tensor import run_op
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice ``x`` into overlapping frames along ``axis`` (reference
+    `signal.py:frame`). For axis=-1, [..., N] -> [..., frame_length,
+    num_frames]; for axis=0, [N, ...] -> [num_frames, frame_length, ...]."""
+    if axis not in (-1, 0):
+        raise ValueError("frame: axis must be 0 or -1")
+
+    def fn(x):
+        xx = jnp.moveaxis(x, 0, -1) if axis == 0 else x
+        n = xx.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        out = xx[..., idx]                       # [..., num, frame_length]
+        out = jnp.swapaxes(out, -1, -2)          # [..., frame_length, num]
+        if axis == 0:
+            # [..., frame_length, num] -> [num, frame_length, ...]
+            out = jnp.moveaxis(out, (-1, -2), (0, 1))
+        return out
+
+    return run_op("frame", fn, (x,))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of :func:`frame` (reference `signal.py:overlap_add`):
+    axis=-1 takes [..., frame_length, num_frames] -> [..., N]; axis=0
+    takes [num_frames, frame_length, ...] -> [N, ...]."""
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add: axis must be 0 or -1")
+
+    def fn(x):
+        # axis=0 input layout is [num, frame_length, ...]; bring it to the
+        # canonical [..., frame_length, num] before the scatter-add.
+        xx = jnp.moveaxis(x, (0, 1), (-1, -2)) if axis == 0 else x
+        fl, num = xx.shape[-2], xx.shape[-1]
+        n = (num - 1) * hop_length + fl
+        starts = jnp.arange(num) * hop_length
+        idx = (starts[None, :] + jnp.arange(fl)[:, None])  # [fl, num]
+        out = jnp.zeros(xx.shape[:-2] + (n,), xx.dtype)
+        out = out.at[..., idx].add(xx)
+        return jnp.moveaxis(out, -1, 0) if axis == 0 else out
+
+    return run_op("overlap_add", fn, (x,))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference `signal.py:246`).
+
+    x: [B, N] or [N] real (complex allowed with onesided=False). Returns
+    complex [B, n_fft//2 + 1, num_frames] (onesided) or
+    [B, n_fft, num_frames].
+    """
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(x, window):
+        squeeze = x.ndim == 1
+        xx = x[None] if squeeze else x
+        is_complex = jnp.iscomplexobj(xx)
+        if is_complex and onesided:
+            raise ValueError("onesided=True requires real input")
+        if window is None:
+            win = jnp.ones((win_length,), jnp.float32)
+        else:
+            win = window.reshape(-1)
+        if win_length < n_fft:  # center-pad the window to n_fft
+            pad = n_fft - win_length
+            win = jnp.pad(win, (pad // 2, pad - pad // 2))
+        if center:
+            xx = jnp.pad(xx, [(0, 0)] * (xx.ndim - 1)
+                         + [(n_fft // 2, n_fft // 2)], mode=pad_mode)
+        n = xx.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = xx[..., idx] * win[None, None, :]   # [B, num, n_fft]
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        spec = jnp.swapaxes(spec, -1, -2)            # [B, freq, num]
+        return spec[0] if squeeze else spec
+
+    return run_op("stft", fn, (x, window))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, return_complex=False,
+          length=None, name=None):
+    """Inverse STFT (reference `signal.py:423`): least-squares overlap-add
+    with squared-window normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(x, window):
+        squeeze = x.ndim == 2
+        spec = x[None] if squeeze else x             # [B, freq, num]
+        if window is None:
+            win = jnp.ones((win_length,), jnp.float32)
+        else:
+            win = window.reshape(-1)
+        if win_length < n_fft:
+            pad = n_fft - win_length
+            win = jnp.pad(win, (pad // 2, pad - pad // 2))
+        frames = jnp.swapaxes(spec, -1, -2)          # [B, num, freq]
+        if normalized:
+            frames = frames * jnp.sqrt(
+                jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            sig = jnp.fft.irfft(frames, n=n_fft, axis=-1)
+        else:
+            sig = jnp.fft.ifft(frames, axis=-1)
+            if not return_complex:
+                sig = sig.real
+        sig = sig * win[None, None, :]
+        num = sig.shape[1]
+        n = (num - 1) * hop_length + n_fft
+        starts = jnp.arange(num) * hop_length
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :])
+        out = jnp.zeros(sig.shape[:1] + (n,), sig.dtype)
+        out = out.at[:, idx].add(sig)
+        norm = jnp.zeros((n,), jnp.float32).at[idx.reshape(-1)].add(
+            jnp.tile(win.astype(jnp.float32) ** 2, (num,)))
+        out = out / jnp.where(norm > 1e-11, norm, 1.0)
+        if center:
+            out = out[:, n_fft // 2:]
+            if length is not None:
+                out = out[:, :length]
+            else:
+                out = out[:, :n - n_fft]
+        elif length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+
+    return run_op("istft", fn, (x, window))

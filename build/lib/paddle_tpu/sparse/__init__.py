@@ -1,0 +1,223 @@
+"""``paddle.sparse`` — COO/CSR sparse tensors.
+
+Reference: `python/paddle/sparse/` (`creation.py` sparse_coo_tensor /
+sparse_csr_tensor, unary/binary/matmul ops backed by
+`phi/kernels/sparse/`). TPU-native backend: ``jax.experimental.sparse``
+BCOO/BCSR — XLA lowers sparse contractions to gather/scatter+MXU
+segment ops. Values participate in the autograd tape (gradients flow to
+``values()`` and to dense operands of ``matmul``); indices are static.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..framework.tensor import Tensor, run_op
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "matmul", "add", "multiply", "relu", "abs",
+           "sin", "tanh", "sqrt", "pow", "neg", "is_same_shape"]
+
+
+def _values_in(x):
+    return x._values
+
+
+class _SparseBase:
+    def __init__(self, mat, values_tensor):
+        self._mat = mat              # BCOO/BCSR with values_tensor._data
+        self._values = values_tensor  # tape-tracked values
+
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self):
+        return int(self._mat.nse)
+
+    def values(self):
+        return self._values
+
+    def to_dense(self):
+        def fn(v):
+            return self._with_values(v).todense()
+
+        return run_op("sparse_to_dense", fn, (self._values,))
+
+    def _with_values(self, v):
+        raise NotImplementedError
+
+    def _rebuild(self):
+        return self._with_values(self._values._data)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(shape={self.shape}, "
+                f"nnz={self.nnz}, dtype={self.dtype})")
+
+
+class SparseCooTensor(_SparseBase):
+    def __init__(self, indices, values_tensor, shape):
+        self._indices = jnp.asarray(indices)
+        mat = jsparse.BCOO((values_tensor._data, self._indices.T),
+                           shape=tuple(shape))
+        super().__init__(mat, values_tensor)
+
+    def indices(self):
+        # paddle layout: [sparse_dim, nnz] (what sparse_coo_tensor takes)
+        return Tensor(self._indices, stop_gradient=True)
+
+    def _with_values(self, v):
+        return jsparse.BCOO((v, self._indices.T), shape=self._mat.shape)
+
+    def coalesce(self):
+        m = self._rebuild().sum_duplicates()
+        vals = Tensor(m.data, stop_gradient=self._values.stop_gradient)
+        return SparseCooTensor(m.indices.T, vals, m.shape)
+
+    def to_sparse_csr(self):
+        m = jsparse.BCSR.from_bcoo(self._rebuild().sum_duplicates())
+        vals = Tensor(m.data, stop_gradient=self._values.stop_gradient)
+        return SparseCsrTensor._wrap(m, vals)
+
+
+class SparseCsrTensor(_SparseBase):
+    def __init__(self, crows, cols, values_tensor, shape):
+        self._indptr = jnp.asarray(crows, jnp.int32)
+        self._cols = jnp.asarray(cols, jnp.int32)
+        mat = jsparse.BCSR((values_tensor._data, self._cols, self._indptr),
+                           shape=tuple(shape))
+        super().__init__(mat, values_tensor)
+
+    @classmethod
+    def _wrap(cls, m, vals):
+        obj = cls.__new__(cls)
+        obj._indptr = m.indptr
+        obj._cols = m.indices
+        _SparseBase.__init__(obj, m, vals)
+        return obj
+
+    def crows(self):
+        return Tensor(self._indptr, stop_gradient=True)
+
+    def cols(self):
+        return Tensor(self._cols, stop_gradient=True)
+
+    def _with_values(self, v):
+        return jsparse.BCSR((v, self._cols, self._indptr),
+                            shape=self._mat.shape)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        m = self._rebuild().to_bcoo()
+        vals = Tensor(m.data, stop_gradient=self._values.stop_gradient)
+        return SparseCooTensor(m.indices.T, vals, m.shape)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """Reference creation.py sparse_coo_tensor: indices [ndim, nnz]."""
+    idx = np.asarray(indices)
+    vals = values if isinstance(values, Tensor) \
+        else Tensor(np.asarray(values), dtype=dtype,
+                    stop_gradient=stop_gradient)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    vals = values if isinstance(values, Tensor) \
+        else Tensor(np.asarray(values), dtype=dtype,
+                    stop_gradient=stop_gradient)
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (reference sparse/matmul.py). Grads flow to the
+    sparse values and the dense operand."""
+    if isinstance(y, _SparseBase):
+        raise NotImplementedError("sparse @ sparse: densify one side")
+    rebuild = x._with_values
+
+    def fn(v, d):
+        return rebuild(v) @ d
+
+    return run_op("sparse_matmul", fn, (x._values, y))
+
+
+def add(x, y, name=None):
+    """coo + coo -> coo (concatenated coordinates, duplicates implicit —
+    ``to_dense`` sums them, like an uncoalesced reference tensor);
+    sparse + dense -> dense."""
+    if isinstance(y, _SparseBase):
+        if not (isinstance(x, SparseCooTensor)
+                and isinstance(y, SparseCooTensor)):
+            raise NotImplementedError(
+                "sparse add of CSR tensors: convert with to_sparse_coo()")
+        if list(x.shape) != list(y.shape):
+            raise ValueError(f"shape mismatch: {x.shape} vs {y.shape}")
+        vals = run_op("sparse_add_values",
+                      lambda a, b: jnp.concatenate([a, b]),
+                      (x._values, y._values))
+        idx = np.concatenate([np.asarray(x._indices),
+                              np.asarray(y._indices)], axis=1)
+        return SparseCooTensor(idx, vals, x._mat.shape)
+    return run_op("sparse_add_dense",
+                  lambda v, d: x._with_values(v).todense() + d,
+                  (x._values, y))
+
+
+def multiply(x, y, name=None):
+    """elementwise sparse * dense — keeps sparsity: each stored value is
+    scaled by the dense entry at its own coordinates."""
+    if isinstance(x, SparseCooTensor):
+        idx = tuple(np.asarray(x._indices))          # [ndim, nnz] static
+    else:
+        indptr = np.asarray(x._indptr)
+        counts = np.diff(indptr)
+        rows = np.repeat(np.arange(len(counts)), counts)
+        idx = (rows, np.asarray(x._cols))
+
+    def fn(v, d):
+        return v * d[idx]
+
+    return _rewrap(x, run_op("sparse_multiply", fn, (x._values, y)))
+
+
+def _unary(name, jfn):
+    def op(x):
+        return _rewrap(x, run_op(f"sparse_{name}", jfn, (x._values,)))
+    op.__name__ = name
+    return op
+
+
+def _rewrap(x, vals):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x._indices, vals, x._mat.shape)
+    return SparseCsrTensor._wrap(x._with_values(vals._data), vals)
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+abs = _unary("abs", jnp.abs)
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+neg = _unary("neg", jnp.negative)
+
+
+def pow(x, factor):
+    vals = run_op("sparse_pow", lambda v: jnp.power(v, factor),
+                  (x._values,))
+    return _rewrap(x, vals)

@@ -1,0 +1,56 @@
+"""``paddle.static`` — the static-graph surface.
+
+Reference: `python/paddle/static/` (Program builders, ``InputSpec``,
+save/load_inference_model). TPU-native: there is no separate static
+graph — ``jit.to_static`` traces imperative code into one XLA program —
+so this namespace keeps the pieces that still mean something:
+``InputSpec`` (shape/dtype specs with symbolic batch dims for export)
+and the inference-model save/load entry points, which delegate to
+``paddle_tpu.jit.save``/``load`` (StableHLO serialization).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.dtype import convert_dtype
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+
+
+class InputSpec:
+    """Shape/dtype/name spec (reference `static/input.py` InputSpec).
+    ``None`` dims are symbolic (any size at run time — exported models
+    stay shape-polymorphic in them, each ``None`` independent). Use a
+    STRING dim (e.g. ``InputSpec(["batch", 8])``) to share one symbol
+    across inputs whose sizes must match."""
+
+    def __init__(self, shape, dtype="float32", name=None,
+                 stop_gradient=False):
+        self.shape = list(shape)
+        self.dtype = np.dtype(convert_dtype(dtype))
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(list(tensor.shape), str(tensor.dtype), name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype.name}, "
+                f"name={self.name})")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """Reference `static/io.py:save_inference_model`; delegates to
+    jit.save on the traced program."""
+    raise NotImplementedError(
+        "save_inference_model requires a legacy Program; use "
+        "paddle_tpu.jit.save(layer, path, input_spec=[...]) — the "
+        "TPU-native export path (StableHLO)")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from ..jit import load as jit_load
+    return jit_load(path_prefix)

@@ -1,0 +1,18 @@
+"""``paddle.sysconfig`` (reference: `python/paddle/sysconfig.py`)."""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of the C++ sources usable as headers (the native
+    runtime's src/)."""
+    return os.path.join(_ROOT, "native", "src")
+
+
+def get_lib():
+    """Directory containing the built native libraries."""
+    return os.path.join(_ROOT, "native", "lib")

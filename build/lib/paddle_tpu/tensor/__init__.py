@@ -1,0 +1,122 @@
+"""Tensor op surface — re-exports every op and patches Tensor operators.
+
+Reference analog: `python/paddle/tensor/__init__.py` plus the operator
+monkey-patching in `python/paddle/base/dygraph/math_op_patch.py`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .registry import OPS  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .attribute import *  # noqa: F401,F403
+
+from . import math as _math
+from . import logic as _logic
+from . import manipulation as _manip
+
+# ---------------------------------------------------------------------------
+# operator overloads
+# ---------------------------------------------------------------------------
+def _swap(fn):
+    return lambda self, other: fn(_coerce(other, self), self)
+
+
+def _coerce(v, like):
+    if isinstance(v, Tensor):
+        return v
+    return Tensor(jnp.asarray(v))
+
+
+def _binop(fn):
+    def op(self, other):
+        return fn(self, other if isinstance(other, Tensor) else _coerce(other, self))
+    return op
+
+
+Tensor.__add__ = _binop(_math.add)
+Tensor.__radd__ = _swap(_math.add)
+Tensor.__sub__ = _binop(_math.subtract)
+Tensor.__rsub__ = _swap(_math.subtract)
+Tensor.__mul__ = _binop(_math.multiply)
+Tensor.__rmul__ = _swap(_math.multiply)
+Tensor.__truediv__ = _binop(_math.divide)
+Tensor.__rtruediv__ = _swap(_math.divide)
+Tensor.__floordiv__ = _binop(_math.floor_divide)
+Tensor.__rfloordiv__ = _swap(_math.floor_divide)
+Tensor.__mod__ = _binop(_math.mod)
+Tensor.__rmod__ = _swap(_math.mod)
+Tensor.__pow__ = _binop(_math.pow)
+Tensor.__rpow__ = _swap(_math.pow)
+Tensor.__matmul__ = _binop(matmul)
+Tensor.__rmatmul__ = _swap(matmul)
+Tensor.__neg__ = lambda self: _math.neg(self)
+Tensor.__abs__ = lambda self: _math.abs(self)
+Tensor.__invert__ = lambda self: _logic.logical_not(self) \
+    if self.dtype == jnp.bool_ else _logic.bitwise_not(self)
+
+Tensor.__eq__ = _binop(_logic.equal)
+Tensor.__ne__ = _binop(_logic.not_equal)
+Tensor.__lt__ = _binop(_logic.less_than)
+Tensor.__le__ = _binop(_logic.less_equal)
+Tensor.__gt__ = _binop(_logic.greater_than)
+Tensor.__ge__ = _binop(_logic.greater_equal)
+Tensor.__and__ = _binop(lambda a, b: _logic.logical_and(a, b)
+                        if a.dtype == jnp.bool_ else _logic.bitwise_and(a, b))
+Tensor.__or__ = _binop(lambda a, b: _logic.logical_or(a, b)
+                       if a.dtype == jnp.bool_ else _logic.bitwise_or(a, b))
+Tensor.__xor__ = _binop(lambda a, b: _logic.logical_xor(a, b)
+                        if a.dtype == jnp.bool_ else _logic.bitwise_xor(a, b))
+Tensor.__lshift__ = _binop(_logic.bitwise_left_shift)
+Tensor.__rshift__ = _binop(_logic.bitwise_right_shift)
+
+# in-place arithmetic: rebind payload (optimizers rely on these)
+def _iop(fn):
+    def op(self, other):
+        out = fn(self, other if isinstance(other, Tensor) else _coerce(other, self))
+        self._data, self._node, self._out_index = out._data, out._node, out._out_index
+        self.stop_gradient = out.stop_gradient and self.stop_gradient
+        return self
+    return op
+
+
+Tensor.__iadd__ = _iop(_math.add)
+Tensor.__isub__ = _iop(_math.subtract)
+Tensor.__imul__ = _iop(_math.multiply)
+Tensor.__itruediv__ = _iop(_math.divide)
+
+Tensor.add_ = _iop(_math.add)
+Tensor.subtract_ = _iop(_math.subtract)
+Tensor.multiply_ = _iop(_math.multiply)
+Tensor.divide_ = _iop(_math.divide)
+Tensor.scale_ = lambda self, scale=1.0, bias=0.0, **kw: _iop(
+    lambda a, b: _math.add(_math.multiply(a, b), Tensor(jnp.asarray(bias))))(self, scale)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    from ..framework.tensor import run_op
+    s = scale._data if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        out = run_op("scale", lambda a: a * s + bias, [x])
+    else:
+        out = run_op("scale", lambda a: (a + bias) * s, [x])
+    return out
+
+
+Tensor.scale = scale
+Tensor.mean = _math.mean
+Tensor.item = Tensor.item  # keep
+
+__all__ = [  # noqa: F405
+    name for name in dir() if not name.startswith("_")
+]

@@ -1,0 +1,36 @@
+"""Tensor attribute queries (reference: `python/paddle/tensor/attribute.py`)."""
+
+from __future__ import annotations
+
+from ..framework.dtype import default_int as _i64
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["shape", "rank", "is_floating_point", "is_integer", "is_complex",
+           "numel"]
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x.ndim, dtype=jnp.int32))
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x.dtype, jnp.integer)
+
+
+def is_complex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=_i64()))

@@ -1,0 +1,178 @@
+"""Tensor creation ops (reference: `python/paddle/tensor/creation.py`)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.tensor import Tensor, to_tensor, run_op
+from .registry import defop
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "assign", "tril", "triu", "meshgrid", "clone",
+    "complex", "polar", "tril_indices", "triu_indices", "one_hot",
+    "fill"]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else (default or dtypes.get_default_dtype())
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = jnp.result_type(fill_value) if not isinstance(fill_value, float) \
+            else dtypes.get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor) else x,
+                                 dtype=dtypes.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x._data if isinstance(x, Tensor) else x,
+                                dtype=dtypes.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x._data if isinstance(x, Tensor) else x,
+                                fill_value, dtype=dtypes.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.logspace(val(start), val(stop), int(val(num)),
+                               base=val(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+@defop(method=True)
+def diag(x, offset=0, padding_value=0):
+    if padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        if x.ndim == 1:
+            n = x.shape[0] + abs(offset)
+            full_mat = jnp.full((n, n), padding_value, dtype=x.dtype)
+            idx = jnp.arange(x.shape[0])
+            r = idx if offset >= 0 else idx - offset
+            c = idx + offset if offset >= 0 else idx
+            return full_mat.at[r, c].set(x)
+        return d
+    return jnp.diag(x, k=offset)
+
+
+@defop()
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@defop(method=True)
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@defop(method=True)
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@defop()
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return run_op("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                  [Tensor(a) for a in arrays])
+
+
+@defop(name="complex")
+def complex(real, imag):
+    return real + 1j * imag
+
+
+@defop()
+def polar(abs, angle):
+    return abs * jnp.cos(angle) + 1j * abs * jnp.sin(angle)
+
+
+def tril_indices(row, col=None, offset=0, dtype=None):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtypes.convert_dtype(dtype or "int64")))
+
+
+def triu_indices(row, col=None, offset=0, dtype=None):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtypes.convert_dtype(dtype or "int64")))
+
+
+@defop(differentiable=False)
+def one_hot(x, num_classes):
+    return jnp.eye(num_classes, dtype=dtypes.get_default_dtype())[x]
+
+
+@defop(method=True, inplace_method="fill_")
+def fill(x, value):
+    """Fill the whole tensor with ``value`` (reference op `fill`; the
+    in-place spelling is ``Tensor.fill_``)."""
+    return jnp.full_like(x, value)

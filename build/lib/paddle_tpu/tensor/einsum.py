@@ -1,0 +1,19 @@
+"""Einsum (reference: `python/paddle/tensor/einsum.py` — here a direct
+lowering to XLA's native einsum, which maps contractions onto the MXU)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import defop
+
+__all__ = ["einsum"]
+
+
+@defop(name="einsum")
+def _einsum_impl(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands, name=None):
+    return _einsum_impl(equation, *operands)

@@ -1,0 +1,325 @@
+"""Linear algebra ops (reference: `python/paddle/tensor/linalg.py`).
+
+``matmul`` is the MXU workhorse: it lowers straight to ``jnp.matmul`` →
+XLA dot_general, which XLA tiles onto the 128×128 systolic array. The
+reference routes this through cuBLAS (`phi/kernels/gpu/matmul_kernel.cu`).
+"""
+
+from __future__ import annotations
+
+from ..framework.dtype import default_int as _i64
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .registry import defop
+
+__all__ = [
+    "lu_unpack",
+    "matmul", "mm", "bmm", "dot", "mv", "t", "norm", "dist", "cross",
+    "cholesky", "cholesky_solve", "qr", "svd", "pca_lowrank", "eig", "eigh",
+    "eigvals", "eigvalsh", "det", "slogdet", "inv", "pinv", "solve",
+    "triangular_solve", "lstsq", "lu", "matrix_power", "matrix_rank",
+    "multi_dot", "histogram", "histogramdd", "bincount", "cov", "corrcoef",
+    "cdist", "householder_product", "matrix_exp",
+]
+
+
+@defop(method=True)
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@defop(method=True)
+def mm(input, mat2):
+    return jnp.matmul(input, mat2)
+
+
+@defop(method=True)
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@defop(method=True)
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@defop()
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@defop(method=True)
+def t(input):
+    if input.ndim <= 1:
+        return input
+    return jnp.swapaxes(input, -1, -2)
+
+
+@defop(method=True)
+def norm(x, p=None, axis=None, keepdim=False):
+    if axis is None and p is None:
+        return jnp.linalg.norm(x.reshape(-1))
+    if p is None:
+        p = 2
+    if isinstance(p, str) and p in ("fro", "nuc"):
+        return jnp.linalg.norm(x, ord=p, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+                               keepdims=keepdim)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if isinstance(axis, (list, tuple)) and len(axis) == 1:
+        axis = axis[0]
+    if isinstance(axis, (list, tuple)):
+        return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+    p = float(p)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@defop()
+def dist(x, y, p=2.0):
+    d = x - y
+    p = float(p)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+@defop()
+def cross(x, y, axis=9):
+    ax = axis if axis != 9 else None
+    if ax is None:
+        for i, s in enumerate(x.shape):
+            if s == 3:
+                ax = i
+                break
+    return jnp.cross(x, y, axis=ax)
+
+
+@defop(method=True)
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@defop()
+def cholesky_solve(x, y, upper=False):
+    L = jnp.swapaxes(y, -1, -2).conj() if upper else y
+    z = jax.scipy.linalg.solve_triangular(L, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(jnp.swapaxes(L, -1, -2).conj(), z, lower=False)
+
+
+@defop()
+def qr(x, mode="reduced"):
+    return tuple(jnp.linalg.qr(x, mode=mode)) if mode != "r" \
+        else (jnp.linalg.qr(x, mode="r"),)
+
+
+@defop()
+def svd(x, full_matrices=False):
+    return tuple(jnp.linalg.svd(x, full_matrices=full_matrices))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    from ..framework.tensor import run_op
+    if q is None:
+        q = min(6, x.shape[-2], x.shape[-1])
+
+    def fn(a):
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]
+    return run_op("pca_lowrank", fn, [x])
+
+
+@defop(differentiable=False)
+def eig(x):
+    return tuple(jnp.linalg.eig(x))
+
+
+@defop()
+def eigh(x, UPLO="L"):
+    return tuple(jnp.linalg.eigh(x, UPLO=UPLO))
+
+
+@defop(differentiable=False)
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+@defop()
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@defop()
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@defop()
+def slogdet(x):
+    s, ld = jnp.linalg.slogdet(x)
+    return jnp.stack([s, ld]) if s.ndim == 0 else jnp.stack([s, ld])
+
+
+@defop(method=True)
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+@defop()
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@defop()
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@defop()
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    a = x
+    if transpose:
+        a = jnp.swapaxes(a, -1, -2)
+        upper = not upper
+    return jax.scipy.linalg.solve_triangular(
+        a, y, lower=not upper, unit_diagonal=unitriangular)
+
+
+@defop(differentiable=False)
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@defop(differentiable=False)
+def lu(x, pivot=True):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_mat, piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+
+
+@defop()
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@defop(differentiable=False)
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def multi_dot(x, name=None):
+    from ..framework.tensor import run_op
+    return run_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(list(xs)), list(x))
+
+
+@defop(differentiable=False)
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(input.reshape(-1), bins=bins, range=rng,
+                            weights=None if weight is None else weight.reshape(-1),
+                            density=density)
+    return hist if density else hist.astype(_i64())
+
+
+@defop(differentiable=False)
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    hist, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                                  weights=weights)
+    return (hist,) + tuple(edges)
+
+
+@defop(differentiable=False)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x.reshape(-1), weights=weights, minlength=minlength,
+                        length=None)
+
+
+@defop()
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@defop()
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@defop()
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+@defop()
+def householder_product(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+    eye = jnp.eye(m, dtype=x.dtype)
+
+    def body(q, i):
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[..., :, i].at[i].set(1.0))
+        h = eye - tau[..., i] * jnp.outer(v, v)
+        return q @ h, None
+
+    q0 = jnp.eye(m, dtype=x.dtype)
+    q, _ = jax.lax.scan(body, q0, jnp.arange(n))
+    return q[..., :, :n]
+
+
+@defop()
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+@defop()
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
+    """Unpack jax lu_factor output into (P, L, U) (reference
+    `tensor/linalg.py:lu_unpack`; ``y`` is the 1-based pivot vector that
+    :func:`lu` returns)."""
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    if unpack_ludata:
+        tri_l = jnp.tril(x[..., :, :k], k=-1)
+        eye = jnp.eye(m, k, dtype=x.dtype)
+        l_mat = tri_l + eye
+        u_mat = jnp.triu(x[..., :k, :])
+    else:
+        l_mat = u_mat = jnp.zeros((0,), x.dtype)
+    if unpack_pivots:
+        piv = jnp.asarray(y, jnp.int32) - 1           # back to 0-based
+        perm = jnp.arange(m, dtype=jnp.int32)
+
+        def swap(i, p):
+            j = piv[..., i]
+            pi, pj = p[..., i], p[j]
+            p = p.at[..., i].set(pj)
+            return p.at[j].set(pi)
+
+        for i in range(piv.shape[-1]):   # pivot count is static
+            perm = swap(i, perm)
+        p_mat = jnp.eye(m, dtype=x.dtype)[perm].T
+    else:
+        p_mat = jnp.zeros((0,), x.dtype)
+    return p_mat, l_mat, u_mat

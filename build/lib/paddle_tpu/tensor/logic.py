@@ -1,0 +1,73 @@
+"""Comparison & logical ops (reference: `python/paddle/tensor/logic.py`)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .registry import defop
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift", "is_empty", "is_tensor",
+]
+
+
+def _cmp(name, fn):
+    @defop(name=name, method=True, differentiable=False)
+    def op(x, y):
+        return fn(x, jnp.asarray(y))
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+@defop(method=True, differentiable=False)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@defop(method=True, differentiable=False)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@defop(method=True, differentiable=False)
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+@defop(method=True, differentiable=False)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=float(rtol), atol=float(atol), equal_nan=equal_nan)
+
+
+@defop(method=True, differentiable=False)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=float(rtol), atol=float(atol), equal_nan=equal_nan)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

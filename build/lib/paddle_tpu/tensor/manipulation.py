@@ -1,0 +1,785 @@
+"""Shape / layout / indexing ops (reference: `python/paddle/tensor/manipulation.py`)."""
+
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.tensor import Tensor, run_op
+from .registry import defop
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes",
+    "concat", "stack", "vstack", "hstack", "dstack", "split", "vsplit",
+    "hsplit", "dsplit", "chunk", "squeeze", "unsqueeze", "unsqueeze_",
+    "expand", "expand_as", "broadcast_to", "broadcast_tensors", "tile",
+    "cast", "slice", "strided_slice", "gather", "gather_nd", "scatter",
+    "scatter_nd", "scatter_nd_add", "index_select", "index_add", "index_put",
+    "masked_select", "masked_fill", "masked_scatter", "where", "take_along_axis",
+    "index_fill",
+    "put_along_axis", "flip", "rot90", "roll", "unique", "unique_consecutive",
+    "unbind", "unstack", "repeat_interleave", "as_strided", "view", "view_as",
+    "tensordot", "crop", "pad", "shard_index", "tolist", "as_complex",
+    "as_real", "atleast_1d", "atleast_2d", "atleast_3d", "diagonal",
+    "diagonal_scatter", "select_scatter", "slice_scatter", "unflatten",
+    "unfold", "tensor_split",
+    "diag_embed", "fill_diagonal", "fill_diagonal_tensor", "multiplex",
+    "reverse", "sequence_mask", "shuffle_channel", "temporal_shift",
+    "gather_tree",
+]
+
+
+def _axes(a):
+    if isinstance(a, Tensor):
+        return tuple(int(v) for v in np.asarray(a.numpy()).reshape(-1))
+    if isinstance(a, (list, tuple)):
+        return tuple(int(x._data) if isinstance(x, Tensor) else int(x) for x in a)
+    return int(a)
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+@defop(method=True)
+def reshape(x, shape):
+    return jnp.reshape(x, _shape_arg(shape) if not isinstance(shape, int) else (shape,))
+
+
+def reshape_(x, shape):
+    out = reshape(x, shape)
+    x._data, x._node, x._out_index = out._data, out._node, out._out_index
+    return x
+
+
+@defop(method=True)
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+@defop(method=True)
+def transpose(x, perm=None):
+    return jnp.transpose(x, axes=_axes(perm) if perm is not None else None)
+
+
+@defop()
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, _axes(source), _axes(destination))
+
+
+@defop()
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+def concat(x, axis=0, name=None):
+    axis = _axes(axis)
+    return run_op("concat", lambda *xs: jnp.concatenate(
+        [jnp.asarray(a) for a in xs], axis=axis), list(x))
+
+
+def stack(x, axis=0, name=None):
+    return run_op("stack", lambda *xs: jnp.stack(
+        [jnp.asarray(a) for a in xs], axis=axis), list(x))
+
+
+def vstack(x, name=None):
+    return run_op("vstack", lambda *xs: jnp.vstack(list(xs)), list(x))
+
+
+def hstack(x, name=None):
+    return run_op("hstack", lambda *xs: jnp.hstack(list(xs)), list(x))
+
+
+def dstack(x, name=None):
+    return run_op("dstack", lambda *xs: jnp.dstack(list(xs)), list(x))
+
+
+@defop(method=True)
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    secs = [int(s._data) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+    total = x.shape[axis]
+    known = sum(s for s in secs if s >= 0)
+    secs = [s if s >= 0 else total - known for s in secs]
+    idx = np.cumsum(secs)[:-1]
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def vsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=0)
+
+
+def hsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=1)
+
+
+def dsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=2)
+
+
+@defop()
+def tensor_split(x, num_or_indices, axis=0):
+    if isinstance(num_or_indices, int):
+        return tuple(jnp.array_split(x, num_or_indices, axis=int(axis)))
+    return tuple(jnp.split(x, list(num_or_indices), axis=int(axis)))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+@defop(method=True)
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    ax = _axes(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    ax = tuple(a for a in ax if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=ax) if ax else x
+
+
+@defop(method=True)
+def unsqueeze(x, axis):
+    ax = _axes(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    out = x
+    for a in sorted(a % (out.ndim + 1) for a in ax):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def unsqueeze_(x, axis):
+    out = unsqueeze(x, axis)
+    x._data, x._node, x._out_index = out._data, out._node, out._out_index
+    return x
+
+
+@defop(method=True)
+def expand(x, shape):
+    shape = _shape_arg(shape)
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s in (-1,) else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@defop(method=True)
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@defop(method=True)
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, _shape_arg(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    return run_op("broadcast_tensors",
+                  lambda *xs: tuple(jnp.broadcast_arrays(*xs)), list(inputs))
+
+
+@defop(method=True)
+def tile(x, repeat_times):
+    return jnp.tile(x, _shape_arg(repeat_times))
+
+
+@defop(method=True)
+def cast(x, dtype):
+    return jnp.asarray(x).astype(dtypes.convert_dtype(dtype))
+
+
+@defop(name="slice")
+def slice(x, axes, starts, ends):
+    idx = [jnp.s_[:]] * x.ndim
+    for a, s, e in zip(_axes(axes), _axes(starts), _axes(ends)):
+        idx[a] = jnp.s_[s:e]
+    return x[tuple(idx)]
+
+
+@defop()
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [jnp.s_[:]] * x.ndim
+    for a, s, e, st in zip(_axes(axes), _axes(starts), _axes(ends), _axes(strides)):
+        idx[a] = jnp.s_[s:e:st]
+    return x[tuple(idx)]
+
+
+@defop(method=True)
+def gather(x, index, axis=0):
+    index = jnp.asarray(index)
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=int(axis) if not isinstance(axis, jnp.ndarray) else int(axis))
+
+
+@defop()
+def gather_nd(x, index):
+    index = jnp.asarray(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@defop()
+def scatter(x, index, updates, overwrite=True):
+    index = jnp.asarray(index).reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@defop()
+def scatter_nd(index, updates, shape):
+    index = jnp.asarray(index)
+    zeros = jnp.zeros(_shape_arg(shape), updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+@defop()
+def scatter_nd_add(x, index, updates):
+    index = jnp.asarray(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@defop(method=True)
+def index_select(x, index, axis=0):
+    return jnp.take(x, jnp.asarray(index).reshape(-1), axis=int(axis))
+
+
+@defop()
+def index_add(x, index, axis, value):
+    index = jnp.asarray(index).reshape(-1)
+    x_m = jnp.moveaxis(x, int(axis), 0)
+    v_m = jnp.moveaxis(jnp.asarray(value), int(axis), 0)
+    out = x_m.at[index].add(v_m)
+    return jnp.moveaxis(out, 0, int(axis))
+
+
+@defop()
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(jnp.asarray(i) for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@defop(method=True)
+def masked_select(x, mask):
+    # dynamic output shape — materialized on host in eager mode
+    return x[jnp.asarray(mask)]
+
+
+@defop(method=True)
+def masked_fill(x, mask, value):
+    v = jnp.asarray(value, dtype=x.dtype) if not hasattr(value, "dtype") else value
+    return jnp.where(jnp.asarray(mask), v, x)
+
+
+@defop()
+def masked_scatter(x, mask, value):
+    mask = jnp.asarray(mask)
+    mask_b = jnp.broadcast_to(mask, x.shape)
+    flat_val = jnp.asarray(value).reshape(-1)
+    pos = jnp.cumsum(mask_b.reshape(-1)) - 1
+    take = flat_val[jnp.clip(pos, 0, flat_val.shape[0] - 1)]
+    return jnp.where(mask_b, take.reshape(x.shape), x)
+
+
+@defop(method=True)
+def where(condition, x=None, y=None):
+    return jnp.where(jnp.asarray(condition), x, y)
+
+
+@defop()
+def take_along_axis(arr, indices, axis, broadcast=True):
+    indices = jnp.asarray(indices)
+    return jnp.take_along_axis(arr, indices, axis=int(axis))
+
+
+@defop()
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    indices = jnp.asarray(indices)
+    if reduce == "add":
+        return jnp.put_along_axis(arr, indices, values, axis=int(axis), inplace=False, mode="add") \
+            if hasattr(jnp, "put_along_axis") else _put_along(arr, indices, values, int(axis), "add")
+    return _put_along(arr, indices, values, int(axis), "set")
+
+
+def _put_along(arr, indices, values, axis, mode):
+    arr_m = jnp.moveaxis(arr, axis, -1)
+    idx_m = jnp.moveaxis(jnp.broadcast_to(indices, jnp.broadcast_shapes(
+        indices.shape, arr.shape[:axis] + (indices.shape[axis],) + arr.shape[axis + 1:])), axis, -1)
+    val_m = jnp.broadcast_to(jnp.asarray(values), idx_m.shape)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx_m.shape[:-1]], indexing="ij") \
+        if idx_m.ndim > 1 else []
+    grids = [jnp.broadcast_to(g[..., None], idx_m.shape) for g in grids]
+    index_tuple = tuple(grids) + (idx_m,)
+    if mode == "add":
+        out = arr_m.at[index_tuple].add(val_m)
+    else:
+        out = arr_m.at[index_tuple].set(val_m)
+    return jnp.moveaxis(out, -1, axis)
+
+
+@defop(method=True)
+def flip(x, axis):
+    ax = _axes(axis)
+    return jnp.flip(x, axis=ax)
+
+
+@defop()
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@defop(method=True)
+def roll(x, shifts, axis=None):
+    sh = _axes(shifts) if not isinstance(shifts, int) else shifts
+    ax = _axes(axis) if axis is not None else None
+    return jnp.roll(x, sh, axis=ax)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic shape: eager-only (host round-trip), like the reference's
+    # dynamic-output ops which are incompatible with static graphs too.
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    keep = np.ones(arr.shape[axis], dtype=bool)
+    if arr.shape[axis] > 1:
+        sl = [np.s_[:]] * arr.ndim
+        sl[axis] = np.s_[1:]
+        sl_prev = [np.s_[:]] * arr.ndim
+        sl_prev[axis] = np.s_[:-1]
+        diff = (arr[tuple(sl)] != arr[tuple(sl_prev)])
+        other = tuple(i for i in range(arr.ndim) if i != axis)
+        keep[1:] = diff.any(axis=other) if other else diff
+    uniq = np.compress(keep, arr, axis=axis)
+    outs = [Tensor(jnp.asarray(uniq))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, arr.shape[axis]))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+@defop(method=True)
+def unbind(x, axis=0):
+    axis = int(axis)
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return list(unbind(x, axis))
+
+
+@defop()
+def repeat_interleave(x, repeats, axis=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return jnp.repeat(x, r, axis=axis if axis is None else int(axis))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    arr = np.lib.stride_tricks.as_strided(
+        np.asarray(x.numpy()).reshape(-1)[offset:],
+        shape=tuple(shape),
+        strides=tuple(s * x.numpy().dtype.itemsize for s in stride))
+    return Tensor(jnp.asarray(arr.copy()))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+@defop()
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(_axes(a)) if isinstance(a, (list, tuple)) else a for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@defop()
+def crop(x, shape=None, offsets=None):
+    shape = _shape_arg(shape)
+    offsets = _axes(offsets) if offsets is not None else (0,) * x.ndim
+    if isinstance(offsets, int):
+        offsets = (offsets,)
+    idx = tuple(jnp.s_[o:o + s if s != -1 else None]
+                for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+@defop()
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True):
+    pad = _axes(pad) if not isinstance(pad, (list, tuple)) else tuple(
+        int(p._data) if isinstance(p, Tensor) else int(p) for p in pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        if pad_from_left_axis:
+            widths = pairs
+        else:
+            # torch-style: first pair pads the last axis, walking backwards
+            widths = [pairs[nd - 1 - i] for i in range(nd)]
+    else:
+        # paddle semantics (reference python/paddle/nn/functional/common.py
+        # `pad`): the flat pad list pairs up as (left,right),(top,bottom),...
+        # applied to the *innermost* spatial dim first. For channels-last
+        # layouts (NHWC/NDHWC) the channel axis is skipped.
+        k = len(pad) // 2
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(k)]
+        widths = [(0, 0)] * nd
+        if len(pad) in (2, 4, 6) and nd in (3, 4, 5) and data_format in (
+                "NCL", "NCHW", "NCDHW", "NLC", "NHWC", "NDHWC"):
+            if data_format.startswith("NC"):
+                spatial = list(range(2, nd))
+            else:
+                spatial = list(range(1, nd - 1))
+            if len(pairs) > len(spatial):
+                raise ValueError(
+                    f"pad list has {len(pairs)} (left,right) pairs but "
+                    f"data_format {data_format} only has {len(spatial)} "
+                    "spatial dims")
+            # pairs[0] pads the innermost spatial dim (W), pairs[1] the next
+            # (H), etc.
+            for i, pair in enumerate(pairs):
+                widths[spatial[len(spatial) - 1 - i]] = pair
+        else:
+            # generic: pad applies to the last k dims, innermost first
+            for i, pair in enumerate(pairs):
+                widths[nd - 1 - i] = pair
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, widths, mode=jmode, constant_values=value)
+    return jnp.pad(x, widths, mode=jmode)
+
+
+@defop(differentiable=False)
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (input // shard_size) == shard_id
+    return jnp.where(in_shard, input % shard_size, ignore_value)
+
+
+def tolist(x):
+    return x.numpy().tolist()
+
+
+@defop()
+def as_complex(x):
+    return x[..., 0] + 1j * x[..., 1]
+
+
+@defop()
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@defop()
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+@defop()
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+@defop()
+def atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+@defop(method=True)
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop()
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    n = builtins_min(x.shape[axis1], x.shape[axis2])
+    i = jnp.arange(n - builtins_abs(offset))
+    r = i if offset >= 0 else i - offset
+    c = i + offset if offset >= 0 else i
+    x_m = jnp.moveaxis(jnp.moveaxis(x, axis1, 0), axis2 if axis2 > axis1 else axis2 + 1, 1)
+    x_m = x_m.at[r, c].set(jnp.moveaxis(jnp.asarray(y), -1, 0))
+    return jnp.moveaxis(jnp.moveaxis(x_m, 1, axis2 if axis2 > axis1 else axis2 + 1), 0, axis1)
+
+
+builtins_min = min
+builtins_abs = abs
+
+
+@defop()
+def select_scatter(x, values, axis, index):
+    idx = [jnp.s_[:]] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+@defop()
+def slice_scatter(x, value, axes, starts, ends, strides):
+    idx = [jnp.s_[:]] * x.ndim
+    for a, s, e, st in zip(_axes(axes), _axes(starts), _axes(ends), _axes(strides)):
+        idx[a] = jnp.s_[s:e:st]
+    return x.at[tuple(idx)].set(value)
+
+
+@defop()
+def unflatten(x, axis, shape):
+    axis = int(axis) % x.ndim
+    new_shape = x.shape[:axis] + tuple(_shape_arg(shape)) + x.shape[axis + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+@defop()
+def unfold(x, axis, size, step):
+    axis = int(axis) % x.ndim
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    windows = jax.vmap(
+        lambda s: jax.lax.dynamic_slice_in_dim(x, s, size, axis))(starts)
+    # windows: (n, ..., size at axis, ...) -> move window dim after axis
+    return jnp.moveaxis(windows, 0, axis)
+
+
+# ---------------------------------------------------------------------------
+# __getitem__ / __setitem__ support (used by Tensor)
+# ---------------------------------------------------------------------------
+def _norm_index(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+def _getitem(x, idx):
+    idx = _norm_index(idx)
+    return run_op("getitem", lambda a: a[idx], [x])
+
+
+def _setitem(x, idx, value):
+    idx = _norm_index(idx)
+    if isinstance(value, Tensor):
+        out = run_op("setitem", lambda a, v: a.at[idx].set(v.astype(a.dtype)), [x, value])
+    else:
+        out = run_op("setitem", lambda a: a.at[idx].set(
+            jnp.asarray(value, dtype=a.dtype)), [x])
+    x._data, x._node, x._out_index = out._data, out._node, out._out_index
+    if not out.stop_gradient:
+        x.stop_gradient = False
+
+
+@defop(method=True, inplace_method="index_fill_")
+def index_fill(x, index, axis, value):
+    """Fill rows of ``axis`` selected by ``index`` with ``value``
+    (reference `tensor/manipulation.py:index_fill`)."""
+    idx = jnp.asarray(index).reshape(-1)
+    v = jnp.asarray(value, dtype=x.dtype)
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[idx].set(v)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+# -- reference-op parity batch (phi/api/yaml: diag_embed, fill_diagonal,
+#    fill_diagonal_tensor, multiplex, reverse, sequence_mask,
+#    shuffle_channel, temporal_shift, gather_tree) ---------------------------
+@defop(method=True)
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    """Embed the last dim of ``x`` as the (offset) diagonal of new
+    trailing matrices (reference op `diag_embed`,
+    `phi/kernels/impl/diag_embed_impl.h`)."""
+    x = jnp.asarray(x)
+    n = x.shape[-1] + builtins.abs(int(offset))
+    out_ndim = x.ndim + 1
+    d1 = int(dim1) % out_ndim
+    d2 = int(dim2) % out_ndim
+    if d1 == d2:
+        raise ValueError("diag_embed: dim1 and dim2 must differ")
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + (-int(offset) if offset < 0 else 0)
+    c = idx + (int(offset) if offset > 0 else 0)
+    base = base.at[..., r, c].set(x)
+    # base has the matrix at the trailing two dims; move them to (d1, d2)
+    src = (out_ndim - 2, out_ndim - 1)
+    if (d1, d2) != src:
+        lo, hi = (d1, d2) if d1 < d2 else (d2, d1)
+        base = jnp.moveaxis(base, src, (lo, hi))
+        if d1 > d2:
+            base = jnp.swapaxes(base, d1, d2)
+    return base
+
+
+@defop(method=True, inplace_method="fill_diagonal_")
+def fill_diagonal(x, value, offset=0, wrap=False):
+    """Fill the main (offset) diagonal of ``x`` (reference op
+    `fill_diagonal`). With ``wrap`` the diagonal wraps for tall 2-D
+    matrices, matching numpy/paddle semantics."""
+    x = jnp.asarray(x)
+    if x.ndim < 2:
+        raise ValueError("fill_diagonal needs ndim >= 2")
+    if x.ndim == 2:
+        h, w = x.shape
+        flat = jnp.arange(h * w)
+        r, c = flat // w, flat % w
+        if wrap:
+            # numpy semantics: the diagonal stripe repeats every w+1
+            # flat positions, continuing past the bottom of tall mats
+            start = int(offset) if offset >= 0 else -int(offset) * w
+            on = (flat >= start) & ((flat - start) % (w + 1) == 0)
+        else:
+            on = (c - r) == int(offset)
+        return jnp.where(on.reshape(h, w), jnp.asarray(value, x.dtype), x)
+    n = builtins.min(x.shape[-2:])
+    idx = jnp.arange(n - builtins.abs(int(offset)))
+    r = idx + (-int(offset) if offset < 0 else 0)
+    c = idx + (int(offset) if offset > 0 else 0)
+    return x.at[..., r, c].set(jnp.asarray(value, x.dtype))
+
+
+@defop(method=True, inplace_method="fill_diagonal_tensor_")
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """Write tensor ``y`` onto the (dim1, dim2) diagonal of ``x``
+    (reference op `fill_diagonal_tensor`,
+    `phi/kernels/gpu/fill_diagonal_tensor_kernel.cu`)."""
+    x = jnp.asarray(x)
+    d1 = int(dim1) % x.ndim
+    d2 = int(dim2) % x.ndim
+    # move the diagonal pair to the back, write, move back
+    xt = jnp.moveaxis(x, (d1, d2), (-2, -1))
+    n = builtins.min(xt.shape[-2:]) - builtins.abs(int(offset))
+    idx = jnp.arange(n)
+    r = idx + (-int(offset) if offset < 0 else 0)
+    c = idx + (int(offset) if offset > 0 else 0)
+    # y carries the batch dims (x minus dim1/dim2) plus the diagonal
+    # length as its trailing dim — already aligned with xt[..., r, c]
+    xt = xt.at[..., r, c].set(jnp.asarray(y, x.dtype))
+    return jnp.moveaxis(xt, (-2, -1), (d1, d2))
+
+
+@defop()
+def multiplex(inputs, index):
+    """Row-wise select across candidate tensors: out[i] =
+    inputs[index[i]][i] (reference op `multiplex`,
+    `phi/kernels/gpu/multiplex_kernel.cu`)."""
+    stacked = jnp.stack([jnp.asarray(t) for t in inputs], axis=0)  # [K,N,...]
+    idx = jnp.asarray(index).reshape(-1).astype(jnp.int32)
+    n = stacked.shape[1]
+    return stacked[idx, jnp.arange(n)]
+
+
+def reverse(x, axis, name=None):
+    """Deprecated paddle alias of :func:`flip` (reference legacy op
+    `reverse`)."""
+    return flip(x, axis)
+
+
+@defop()
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    """mask[i, j] = j < x[i] (reference op `sequence_mask`,
+    `phi/kernels/funcs/sequence_mask_kernel.h`)."""
+    lens = jnp.asarray(x)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(lens))
+    mask = jnp.arange(m)[None, :] < lens.reshape(-1, 1)
+    return mask.reshape(lens.shape + (m,)).astype(dtypes.convert_dtype(dtype))
+
+
+@defop()
+def shuffle_channel(x, group):
+    """NCHW channel shuffle (reference op `shuffle_channel`) — the
+    ShuffleNet channel mix: [N, G, C/G, H, W] transpose."""
+    n, c, h, w = x.shape
+    g = int(group)
+    return x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(n, c, h, w)
+
+
+@defop()
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """TSM temporal shift (reference op `temporal_shift`,
+    `phi/kernels/gpu/temporal_shift_kernel.cu`): within each segment
+    group, shift the first fold of channels backward in time, the
+    second forward, keep the rest."""
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    nt, c, h, w = x.shape
+    t = int(seg_num)
+    n = nt // t
+    fold = int(c * float(shift_ratio))
+    v = x.reshape(n, t, c, h, w)
+    back = jnp.concatenate(
+        [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]],
+        axis=1)
+    out = jnp.concatenate([back, fwd, v[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@defop(differentiable=False)
+def gather_tree(ids, parents):
+    """Beam-search back-trace (reference op `gather_tree`,
+    `phi/kernels/gpu/gather_tree_kernel.cu`): ids/parents are
+    [max_time, batch, beam]; walk parents from the last step back,
+    emitting the full token path per beam."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    tmax, batch, beam = ids.shape
+    b_idx = jnp.arange(batch)[:, None]
+    k_idx = jnp.arange(beam)[None, :]
+
+    def body(parent, t):                          # parent: [batch, beam]
+        tok = ids[t][b_idx, parent]
+        return parents[t][b_idx, parent], tok
+
+    init = jnp.broadcast_to(k_idx, (batch, beam)).astype(parents.dtype)
+    _, toks = jax.lax.scan(body, init, jnp.arange(tmax - 1, -1, -1))
+    return toks[::-1]

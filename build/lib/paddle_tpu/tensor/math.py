@@ -1,0 +1,612 @@
+"""Elementwise + reduction math ops (reference: `python/paddle/tensor/math.py`).
+
+Every op is a thin jax.numpy body registered through ``@defop`` — gradients
+come from ``jax.vjp`` automatically (the reference hand-maintains these in
+`backward.yaml` + CUDA grad kernels; here XLA differentiates and fuses them).
+"""
+
+from __future__ import annotations
+
+from ..framework.dtype import default_int as _i64
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .registry import defop
+
+__all__ = [
+    "trapezoid", "cumulative_trapezoid",
+    "copysign", "nextafter", "gammaln", "gammainc", "gammaincc",
+    "polygamma", "multigammaln", "sinc", "hypot", "i0e", "i1e",
+    "p_norm", "frobenius_norm", "squared_l2_norm", "l1_norm",
+    "clip_by_norm", "mean_all", "reduce_as", "elementwise_pow",
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "float_power", "maximum", "minimum", "fmax", "fmin",
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "abs", "sign", "neg", "reciprocal", "floor", "ceil", "round",
+    "trunc", "frac", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "asinh", "acosh", "atanh", "erf", "erfinv",
+    "sigmoid", "logit", "logaddexp",
+    "sum", "mean", "max", "min", "prod", "amax", "amin",
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp", "logsumexp",
+    "clip", "lerp", "nan_to_num", "isfinite", "isinf", "isnan",
+    "all", "any", "count_nonzero", "nansum", "nanmean",
+    "multiply_", "add_n", "addmm", "inner", "outer", "trace",
+    "diff", "angle", "conj", "real", "imag", "gcd", "lcm",
+    "heaviside", "rad2deg", "deg2rad", "take", "broadcast_shape",
+    "increment", "kron", "ldexp", "digamma", "lgamma", "i0", "i1",
+    "tanh", "stanh", "softplus_math", "renorm", "vander",
+]
+
+_default_axis_none = object()
+
+
+def _ax(axis):
+    if axis is None or axis is _default_axis_none:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy()
+        return tuple(int(v) for v in a.reshape(-1)) if a.size > 1 else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# -- binary elementwise -----------------------------------------------------
+@defop(method=True)
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@defop(method=True)
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@defop(method=True)
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@defop(method=True)
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@defop(method=True)
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@defop(method=True)
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+@defop(name="pow", method=True)
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@defop()
+def float_power(x, y):
+    return jnp.float_power(x, y)
+
+
+@defop(method=True)
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@defop(method=True)
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@defop(method=True)
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@defop(method=True)
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@defop()
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@defop()
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@defop()
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@defop(differentiable=False)
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@defop(differentiable=False)
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@defop()
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+@defop()
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+# -- unary elementwise ------------------------------------------------------
+def _unary(name, fn, **kw):
+    @defop(name=name, method=True, inplace_method=name + "_", **kw)
+    def op(x):
+        return fn(x)
+    op.__name__ = name
+    return op
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+sign = _unary("sign", jnp.sign)
+neg = _unary("neg", jnp.negative)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+i0 = _unary("i0", jax.scipy.special.i0)
+i1 = _unary("i1", jax.scipy.special.i1)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+
+
+@defop()
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@defop()
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@defop()
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@defop(name="softplus_math")
+def softplus_math(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x, jnp.log1p(jnp.exp(beta * x)) / beta)
+
+
+# -- reductions -------------------------------------------------------------
+@defop(name="sum", method=True)
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=_ax(axis), dtype=dtype, keepdims=keepdim)
+
+
+@defop(method=True)
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@defop(name="max", method=True)
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@defop(name="min", method=True)
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@defop(method=True)
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_ax(axis), dtype=dtype, keepdims=keepdim)
+
+
+amax = max
+amin = min
+
+
+@defop(name="all", method=True, differentiable=False)
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@defop(name="any", method=True, differentiable=False)
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@defop(differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@defop()
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_ax(axis), dtype=dtype, keepdims=keepdim)
+
+
+@defop()
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@defop(method=True)
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_ax(axis), keepdims=keepdim)
+
+
+# -- scans ------------------------------------------------------------------
+@defop(method=True)
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=int(axis), dtype=dtype)
+
+
+@defop(method=True)
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=int(dim), dtype=dtype)
+
+
+@defop()
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=int(axis))
+    return vals, _cum_argext(x, int(axis), True)
+
+
+@defop()
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.minimum, x, axis=int(axis))
+    return vals, _cum_argext(x, int(axis), False)
+
+
+def _cum_argext(x, axis, is_max):
+    n = x.shape[axis]
+    pos = jnp.arange(n).reshape([-1 if i == axis % x.ndim else 1 for i in range(x.ndim)])
+    pos = jnp.broadcast_to(pos, x.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        keep_a = av >= bv if is_max else av <= bv
+        return jnp.where(keep_a, av, bv), jnp.where(keep_a, ai, bi)
+
+    _, idx = jax.lax.associative_scan(combine, (x, pos), axis=axis)
+    return idx.astype(_i64())
+
+
+@defop()
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=int(axis))
+
+
+# -- misc -------------------------------------------------------------------
+@defop(method=True, inplace_method="clip_")
+def clip(x, min=None, max=None):
+    mn = min._data if isinstance(min, Tensor) else min
+    mx = max._data if isinstance(max, Tensor) else max
+    return jnp.clip(x, mn, mx)
+
+
+@defop()
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@defop()
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@defop(method=True, differentiable=False)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@defop(method=True, differentiable=False)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@defop(method=True, differentiable=False)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def add_n(inputs, name=None):
+    from ..framework.tensor import run_op
+    if isinstance(inputs, Tensor):
+        return inputs
+    return run_op("add_n", lambda *xs: jnp.sum(jnp.stack(
+        [jnp.asarray(x) for x in xs]), axis=0), list(inputs))
+
+
+@defop()
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+@defop()
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@defop()
+def outer(x, y):
+    return jnp.outer(jnp.ravel(x), jnp.ravel(y))
+
+
+@defop(method=True)
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop()
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@defop(differentiable=False)
+def increment(x, value=1.0):
+    return x + value
+
+
+@defop(method=True)
+def take(x, index, mode="raise"):
+    return jnp.take(jnp.ravel(x), index, mode="clip" if mode != "raise" else "clip")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@defop()
+def renorm(x, p, axis, max_norm):
+    norms = jnp.sum(jnp.abs(x) ** p,
+                    axis=tuple(i for i in range(x.ndim) if i != axis),
+                    keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+@defop()
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def multiply_(x, y):
+    out = multiply(x, y)
+    x._data, x._node, x._out_index = out._data, out._node, out._out_index
+    return x
+
+
+@defop(method=True)
+def trapezoid(y, x=None, dx=None, axis=-1):
+    """Trapezoidal rule integral (reference `tensor/math.py:trapezoid`)."""
+    if x is not None and dx is not None:
+        raise ValueError("pass either x or dx, not both")
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+@defop(method=True)
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    """Cumulative trapezoid (reference `tensor/math.py`): running sum of
+    the per-segment trapezoid areas along ``axis``."""
+    if x is not None and dx is not None:
+        raise ValueError("pass either x or dx, not both")
+    y0 = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+    if x is not None:
+        x = jnp.asarray(x)
+        if x.ndim == 1:
+            shape = [1] * y.ndim
+            shape[axis] = x.shape[0]
+            x = x.reshape(shape)
+        d = jnp.diff(x, axis=axis)
+    else:
+        d = 1.0 if dx is None else dx
+    return jnp.cumsum((y0 + y1) * 0.5 * d, axis=axis)
+
+
+# -- special functions (reference `phi/api/yaml/ops.yaml`: copysign,
+#    nextafter, gammaln, gammainc(c), polygamma, i0e, i1e) ------------------
+@defop(method=True, inplace_method="copysign_")
+def copysign(x, y):
+    """Magnitude of ``x`` with the sign of ``y`` (reference op
+    `copysign`, CUDA kernel `phi/kernels/gpu/copysign_kernel.cu`)."""
+    return jnp.copysign(x, y)
+
+
+@defop(method=True)
+def nextafter(x, y):
+    """Next representable float after ``x`` toward ``y`` (reference op
+    `nextafter`)."""
+    return jnp.nextafter(x, y)
+
+
+gammaln = _unary("gammaln", jax.scipy.special.gammaln)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+
+
+@defop(method=True, inplace_method="gammainc_")
+def gammainc(x, y):
+    """Regularized lower incomplete gamma P(x, y) (reference op
+    `gammainc`)."""
+    return jax.scipy.special.gammainc(x, y)
+
+
+@defop(method=True, inplace_method="gammaincc_")
+def gammaincc(x, y):
+    """Regularized upper incomplete gamma Q(x, y) (reference op
+    `gammaincc`, `phi/kernels/impl/gammaincc_kernel_impl.h`)."""
+    return jax.scipy.special.gammaincc(x, y)
+
+
+@defop(method=True, inplace_method="polygamma_")
+def polygamma(x, n):
+    """n-th derivative of digamma at ``x`` (reference op `polygamma`)."""
+    return jax.scipy.special.polygamma(n, x)
+
+
+@defop(method=True)
+def multigammaln(x, p):
+    """Log multivariate gamma (reference `tensor/math.py:multigammaln`)."""
+    return jax.scipy.special.multigammaln(x, p)
+
+
+@defop(method=True)
+def sinc(x):
+    """sin(pi x)/(pi x) (reference op `sinc`)."""
+    return jnp.sinc(x)
+
+
+@defop(method=True)
+def hypot(x, y):
+    """sqrt(x^2 + y^2) without overflow (reference `tensor/math.py`)."""
+    return jnp.hypot(x, y)
+
+
+# -- reduction / norm kernels (reference ops p_norm, frobenius_norm,
+#    squared_l2_norm, l1_norm, clip_by_norm, mean_all, reduce_as) -----------
+@defop()
+def p_norm(x, porder=2.0, axis=None, keepdim=False, asvector=False):
+    """Vector p-norm along ``axis`` (reference op `p_norm`,
+    `phi/kernels/gpu/p_norm_kernel.cu`). ``asvector`` flattens first."""
+    if asvector or axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    p = float(porder)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@defop()
+def frobenius_norm(x, axis=None, keepdim=False):
+    """Frobenius norm over the trailing two dims by default (reference op
+    `frobenius_norm`)."""
+    if axis is None:
+        axis = (-2, -1) if x.ndim >= 2 else (-1,)
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+
+
+@defop()
+def squared_l2_norm(x):
+    """sum(x^2) as a 0-d tensor (reference op `squared_l2_norm` — the
+    gradient-clipping workhorse)."""
+    return jnp.sum(jnp.square(x))
+
+
+@defop()
+def l1_norm(x):
+    """sum(|x|) (reference op `l1_norm`)."""
+    return jnp.sum(jnp.abs(x))
+
+
+@defop()
+def clip_by_norm(x, max_norm):
+    """Scale ``x`` so its L2 norm is at most ``max_norm`` (reference op
+    `clip_by_norm`, `phi/kernels/clip_by_norm_kernel.h`)."""
+    nrm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.minimum(max_norm / jnp.maximum(nrm, 1e-12), 1.0)
+    return x * scale
+
+
+@defop()
+def mean_all(x):
+    """Global mean as a 0-d tensor (reference op `mean_all`)."""
+    return jnp.mean(x)
+
+
+@defop()
+def reduce_as(x, target):
+    """Sum-reduce ``x`` down to ``target``'s shape (reference op
+    `reduce_as` — the broadcast-gradient reducer)."""
+    t_shape = target.shape if hasattr(target, "shape") else tuple(target)
+    extra = x.ndim - len(t_shape)
+    if extra:
+        x = jnp.sum(x, axis=tuple(range(extra)))
+    axes = tuple(i for i, (a, b) in enumerate(zip(x.shape, t_shape))
+                 if a != b and b == 1)
+    if axes:
+        x = jnp.sum(x, axis=axes, keepdims=True)
+    return x
+
+
+@defop(name="elementwise_pow", method=False)
+def elementwise_pow(x, y):
+    """Elementwise x**y (reference legacy op `elementwise_pow`)."""
+    return jnp.power(x, y)

@@ -1,0 +1,209 @@
+"""Random sampling ops (reference: `python/paddle/tensor/random.py`).
+
+All draws go through ``framework.random.next_key()`` so they respect the
+active RNG scope (global generator eagerly; traced key under jit).
+"""
+
+from __future__ import annotations
+
+from ..framework.dtype import default_int as _i64
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework import random as framework_random
+from ..framework.tensor import Tensor, run_op
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "gaussian", "randperm", "bernoulli", "multinomial",
+    "poisson", "exponential_", "uniform_", "normal_", "shuffle", "binomial",
+    "log_normal", "standard_gamma",
+    "truncated_gaussian_random", "dirichlet",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else dtypes.get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None):
+    key = framework_random.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    key = framework_random.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), dtype=_dt(dtype)))
+
+
+standard_normal = randn
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.key(seed) if seed else framework_random.next_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape), dtype=_dt(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = framework_random.next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), low, high,
+                                     dtype=dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = framework_random.next_key()
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else x.dtype
+    out = jax.random.randint(key, tuple(x.shape), low, high, dtype=_i64())
+    return Tensor(out.astype(dt))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else framework_random.next_key()
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype),
+                                     minval=mn, maxval=mx))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = framework_random.next_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            jnp.shape(m), jnp.shape(s)) if shape is None else _shape(shape)
+        return Tensor(m + s * jax.random.normal(key, out_shape,
+                                                dtype=dtypes.get_default_dtype()))
+    if shape is None:
+        shape = (1,)
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape),
+                                                 dtype=dtypes.get_default_dtype()))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    n = normal(mean, std, shape)
+    return Tensor(jnp.exp(n._data))
+
+
+def randperm(n, dtype="int64", name=None):
+    key = framework_random.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(dtypes.convert_dtype(dtype)))
+
+
+def bernoulli(x, p=None, name=None):
+    key = framework_random.next_key()
+    probs = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    u = jax.random.uniform(key, jnp.shape(probs))
+    return Tensor((u < probs).astype(probs.dtype if jnp.issubdtype(
+        probs.dtype, jnp.floating) else jnp.float32))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = framework_random.next_key()
+    probs = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    if probs.ndim == 1:
+        out = jax.random.choice(key, probs.shape[0], shape=(num_samples,),
+                                replace=replacement, p=probs / probs.sum())
+        return Tensor(out.astype(_i64()))
+    keys = jax.random.split(key, probs.shape[0])
+    outs = []
+    for i in range(probs.shape[0]):
+        outs.append(jax.random.choice(
+            keys[i], probs.shape[1], shape=(num_samples,), replace=replacement,
+            p=probs[i] / probs[i].sum()))
+    return Tensor(jnp.stack(outs).astype(_i64()))
+
+
+def poisson(x, name=None):
+    key = framework_random.next_key()
+    lam = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(key, lam).astype(lam.dtype))
+
+
+def binomial(count, prob, name=None):
+    key = framework_random.next_key()
+    n = count._data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    return Tensor(jax.random.binomial(key, n.astype(jnp.float32), p).astype(_i64()))
+
+
+def standard_gamma(x, name=None):
+    key = framework_random.next_key()
+    alpha = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.gamma(key, alpha))
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = framework_random.next_key()
+    u = jax.random.uniform(key, tuple(x.shape), dtype=x.dtype if jnp.issubdtype(
+        x.dtype, jnp.floating) else jnp.float32)
+    x._data = -jnp.log(1.0 - u) / lam
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    key = framework_random.next_key()
+    x._data = jax.random.uniform(key, tuple(x.shape), dtype=x.dtype,
+                                 minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    key = framework_random.next_key()
+    x._data = mean + std * jax.random.normal(key, tuple(x.shape), dtype=x.dtype)
+    return x
+
+
+def shuffle(x, name=None):
+    key = framework_random.next_key()
+    perm = jax.random.permutation(key, x.shape[0])
+    from . import manipulation
+    return manipulation.index_select(x, Tensor(perm), axis=0)
+
+
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, dtype=None, a=-2.0,
+                              b=2.0, name=None):
+    """Gaussian truncated to [a, b] std units (reference op
+    `truncated_gaussian_random` — the TruncatedNormal initializer's
+    kernel)."""
+    import jax
+
+    key = framework_random.next_key()
+
+    def fn(key):
+        z = jax.random.truncated_normal(key, a, b, _shape(shape))
+        return (z * std + mean).astype(_dt(dtype))
+
+    return run_op("truncated_gaussian_random", fn, (key,),
+                  differentiable=False)
+
+
+def dirichlet(alpha, name=None):
+    """Sample from Dirichlet(alpha) (reference op `dirichlet`,
+    `phi/kernels/gpu/dirichlet_kernel.cu`): normalized standard-gamma
+    draws along the last axis."""
+    import jax
+
+    key = framework_random.next_key()
+
+    def fn(alpha, key):
+        g = jax.random.gamma(key, alpha)
+        return g / jnp.sum(g, axis=-1, keepdims=True)
+
+    return run_op("dirichlet", fn, (alpha, key), differentiable=False)

@@ -1,0 +1,82 @@
+"""Single-source op registry.
+
+The reference keeps ~600 op schemas in YAML (`paddle/phi/api/yaml/ops.yaml`)
+and generates the C++ API, autograd nodes, and Python bindings from them
+(SURVEY §2.2). Here the single source is the decorated jax-level function:
+``@defop`` registers it, wraps it with the autograd executor
+(`framework.tensor.run_op` — grad comes from ``jax.vjp``, no per-op grad
+rules), and optionally attaches it as a ``Tensor`` method. ``OPS`` is the
+machine-readable inventory (the analog of the YAML file).
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..framework.tensor import Tensor, run_op
+
+__all__ = ["defop", "OPS", "attach_tensor_method"]
+
+# name -> {fn, wrapper, differentiable, methods}
+OPS: dict[str, dict] = {}
+
+
+def defop(name=None, differentiable=True, method=False, method_name=None,
+          inplace_method=None):
+    """Register an op.
+
+    Args:
+        name: public op name (defaults to fn.__name__).
+        differentiable: record a grad node for this op.
+        method: also attach as ``Tensor.<name>`` method.
+        method_name: method name if different from op name.
+        inplace_method: if set, also attach ``Tensor.<inplace_method>`` that
+            rebinds the tensor payload in place (paddle's ``op_`` convention).
+    """
+    def deco(fn):
+        opname = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            kwargs.pop("name", None)
+            return run_op(opname, fn, args, kwargs, differentiable=differentiable)
+
+        OPS[opname] = {"fn": fn, "wrapper": wrapper,
+                       "differentiable": differentiable,
+                       "method": (method_name or opname) if method else None,
+                       "inplace": inplace_method,
+                       "module": fn.__module__}
+        if method:
+            attach_tensor_method(method_name or opname, wrapper)
+        if inplace_method:
+            def inplace(self, *args, **kwargs):
+                out = wrapper(self, *args, **kwargs)
+                self._data = out._data
+                self._node = out._node
+                self._out_index = out._out_index
+                self.stop_gradient = out.stop_gradient
+                return self
+            attach_tensor_method(inplace_method, inplace)
+        return wrapper
+    return deco
+
+
+def attach_tensor_method(name, fn):
+    """Attach a function as a Tensor method (reference:
+    ``python/paddle/base/dygraph/math_op_patch.py`` monkey-patching)."""
+    if getattr(fn, "__self_is_first_arg__", True):
+        setattr(Tensor, name, fn)
+
+
+def register_existing(fn, name, differentiable=True):
+    """Inventory an EXISTING public function as a schema op.
+
+    Some reference ops (`concat`, `topk`, creation/random ops, ...) are
+    implemented here as plain functions wrapping ``run_op`` directly —
+    variadic inputs or eager RNG handling don't fit the ``@defop``
+    template. They are still ops of the framework; this records them in
+    ``OPS`` (and therefore in ops.yaml and ``_C_ops``) with the public
+    function as the dispatch target."""
+    OPS[name] = {"fn": fn, "wrapper": fn, "differentiable": differentiable,
+                 "method": None, "inplace": None, "module": fn.__module__}
+    return fn

@@ -1,0 +1,186 @@
+"""Search / sort ops (reference: `python/paddle/tensor/search.py`).
+
+Ops with integer index outputs (argmax/argsort/topk) compute indices under
+stop-grad and recover differentiable values via gather — so values carry
+gradients while indices stay integer, matching the reference's grad behavior.
+"""
+
+from __future__ import annotations
+
+from ..framework.dtype import default_int as _i64, convert_dtype as _cvt
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, run_op
+from .registry import defop
+from . import manipulation
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+    "where_index", "nonzero", "index_sample", "searchsorted", "bucketize",
+    "masked_select_idx", "top_p_sampling",
+]
+
+
+@defop(method=True, differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    if axis is None:
+        out = jnp.argmax(x.reshape(-1))
+        return out.astype(_cvt(dtype))
+    out = jnp.argmax(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(_cvt(dtype))
+
+
+@defop(method=True, differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    if axis is None:
+        out = jnp.argmin(x.reshape(-1))
+        return out.astype(_cvt(dtype))
+    out = jnp.argmin(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(_cvt(dtype))
+
+
+@defop(method=True, differentiable=False)
+def argsort(x, axis=-1, descending=False, stable=False):
+    idx = jnp.argsort(x, axis=int(axis), stable=stable,
+                      descending=descending)
+    return idx.astype(_i64())
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    idx = argsort(x, axis=axis, descending=descending, stable=stable)
+    return manipulation.take_along_axis(x, idx, axis=axis)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else int(axis)
+
+    def idx_fn(a):
+        a_m = a if largest else -a
+        if ax != -1 and ax != a.ndim - 1:
+            a_m = jnp.moveaxis(a_m, ax, -1)
+        import jax
+        _, idx = jax.lax.top_k(a_m, k)
+        if ax != -1 and ax != a.ndim - 1:
+            idx = jnp.moveaxis(idx, -1, ax)
+        return idx.astype(_i64())
+
+    indices = run_op("topk_indices", idx_fn, [x], differentiable=False)
+    values = manipulation.take_along_axis(x, indices, axis=ax)
+    return values, indices
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    ax = int(axis)
+    idx_sorted = argsort(x, axis=ax)
+    sel = manipulation.take_along_axis(
+        idx_sorted, Tensor(jnp.full(
+            tuple(1 if i == ax % x.ndim else s for i, s in enumerate(x.shape)),
+            k - 1, dtype=_i64())), axis=ax)
+    vals = manipulation.take_along_axis(x, sel, axis=ax)
+    if not keepdim:
+        vals = manipulation.squeeze(vals, axis=ax)
+        sel = manipulation.squeeze(sel, axis=ax)
+    return vals, sel
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    # host computation (dynamic counting), eager-only like reference dynamic ops
+    arr = np.asarray(x.numpy())
+    ax = int(axis) % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=arr.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    shape = moved.shape[:-1]
+    v = vals.reshape(shape)
+    ind = idxs.reshape(shape)
+    if keepdim:
+        v = np.expand_dims(v, ax)
+        ind = np.expand_dims(ind, ax)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(ind))
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic output shape → host round-trip in eager mode
+    arr = np.asarray(x.numpy())
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(a.astype(np.int64))[:, None]) for a in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+where_index = nonzero
+
+
+@defop()
+def index_sample(x, index):
+    return jnp.take_along_axis(x, jnp.asarray(index), axis=1)
+
+
+@defop(differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        import jax
+        flat_seq = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+        flat_val = jnp.asarray(values).reshape(-1, jnp.asarray(values).shape[-1])
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(flat_seq, flat_val)
+        out = out.reshape(jnp.asarray(values).shape)
+    return out.astype(jnp.int32 if out_int32 else _i64())
+
+
+@defop(differentiable=False)
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else _i64())
+
+
+def masked_select_idx(x, mask):
+    return manipulation.masked_select(x, mask)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Sample one id per row from the top-p nucleus (reference
+    `python/paddle/tensor/search.py:1261`, CUDA kernel
+    `phi/kernels/gpu/top_p_sampling_kernel.cu`). ``x`` [B, V] holds
+    probabilities, ``ps`` [B] the cumulative threshold, ``threshold`` an
+    optional absolute probability floor. Returns (values [B, 1],
+    ids [B, 1] int64).
+
+    TPU-native: sort + masked Gumbel-argmax — static shapes, no
+    rejection loop.
+    """
+    import jax
+
+    from ..framework import random as frandom
+    from ..framework.tensor import run_op
+
+    key = jax.random.key(seed) if seed is not None else frandom.next_key()
+
+    def fn(x, ps, thr, key):
+        sx_idx = jnp.argsort(-x, axis=-1)
+        sx = jnp.take_along_axis(x, sx_idx, axis=-1)
+        cum_before = jnp.cumsum(sx, axis=-1) - sx
+        keep = cum_before < ps[:, None]          # always keeps the top-1
+        if thr is not None:
+            keep &= (sx >= thr[:, None]) | (cum_before <= 0)
+        logits = jnp.where(keep, jnp.log(jnp.maximum(sx, 1e-38)), -1e30)
+        j = jax.random.categorical(key, logits, axis=-1)      # [B]
+        val = jnp.take_along_axis(sx, j[:, None], axis=-1)
+        ids = jnp.take_along_axis(sx_idx, j[:, None], axis=-1)
+        return val, ids.astype(_i64())
+
+    return run_op("top_p_sampling", fn, (x, ps, threshold, key),
+                  differentiable=False)

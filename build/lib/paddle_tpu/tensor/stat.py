@@ -1,0 +1,55 @@
+"""Statistics ops (reference: `python/paddle/tensor/stat.py`)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import defop
+
+__all__ = ["std", "var", "median", "nanmedian", "quantile", "nanquantile"]
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@defop(method=True)
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_ax(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@defop(method=True)
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_ax(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@defop(method=True)
+def median(x, axis=None, keepdim=False, mode="avg"):
+    if mode == "min":
+        n = x.shape[_ax(axis)] if axis is not None else x.size
+        q = jnp.quantile(x, 0.5, axis=_ax(axis), keepdims=keepdim, method="lower") \
+            if n % 2 == 0 else jnp.quantile(x, 0.5, axis=_ax(axis), keepdims=keepdim,
+                                            method="nearest")
+        return q
+    return jnp.median(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@defop()
+def nanmedian(x, axis=None, keepdim=False, mode="avg"):
+    return jnp.nanmedian(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@defop()
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=_ax(axis), keepdims=keepdim,
+                        method=interpolation)
+
+
+@defop()
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=_ax(axis), keepdims=keepdim,
+                           method=interpolation)

@@ -1,0 +1,9 @@
+"""``paddle.text`` (reference: `python/paddle/text/__init__.py`):
+Viterbi decoding + classic NLP datasets."""
+
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+from .datasets import (  # noqa: F401
+    UCIHousing, Imdb, Imikolov, Movielens, WMT16, Conll05st)
+
+__all__ = ["ViterbiDecoder", "viterbi_decode",
+           "UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT16", "Conll05st"]

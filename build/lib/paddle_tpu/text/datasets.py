@@ -1,0 +1,517 @@
+"""Text datasets (reference: `python/paddle/text/datasets/`).
+
+The reference auto-downloads corpora; this build runs with zero egress,
+so every dataset takes ``data_file`` pointing at the same archive the
+reference would download (formats identical — an aclImdb tar for
+:class:`Imdb`, the simple-examples PTB tar for :class:`Imikolov`, the
+whitespace table for :class:`UCIHousing`). Parsing, vocabulary building,
+and example layout match the reference classes cited per dataset.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT16",
+           "Conll05st"]
+
+
+class UCIHousing(Dataset):
+    """Boston-housing regression table (reference
+    `text/datasets/uci_housing.py`): 14 whitespace-separated columns,
+    features mean-centered and range-normalized over the full table,
+    80/20 train/test split."""
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        if data_file is None:
+            raise ValueError(
+                "data_file is required (no network in this build): pass "
+                "the housing.data table the reference downloads")
+        self.data_file = data_file
+        self._load_data()
+
+    def _load_data(self, feature_num=14, ratio=0.8):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maxs, mins, avgs = (data.max(0), data.min(0),
+                            data.sum(0) / data.shape[0])
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (row[:-1].astype("float32"), row[-1:].astype("float32"))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment corpus from the aclImdb tar (reference
+    `text/datasets/imdb.py`): vocabulary of words with frequency >
+    ``cutoff`` over train+test, docs as id arrays, label 0=pos 1=neg."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        if data_file is None:
+            raise ValueError(
+                "data_file is required (no network in this build): pass "
+                "the aclImdb_v1.tar.gz archive the reference downloads")
+        self.data_file = data_file
+        self.word_idx = self._build_word_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, pattern):
+        docs = []
+        with tarfile.open(self.data_file) as tarf:
+            member = tarf.next()
+            while member is not None:
+                if pattern.match(member.name):
+                    docs.append(
+                        tarf.extractfile(member).read()
+                        .rstrip(b"\n\r")
+                        .translate(None,
+                                   string.punctuation.encode("latin-1"))
+                        .lower().split())
+                member = tarf.next()
+        return docs
+
+    def _build_word_dict(self, cutoff):
+        freq = collections.defaultdict(int)
+        pattern = re.compile(
+            r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for doc in self._tokenize(pattern):
+            for w in doc:
+                freq[w] += 1
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        # keys are bytes (tar payload); the reference mixes a str '<unk>'
+        # into a bytes vocab — uniform bytes here
+        word_idx[b"<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx[b"<unk>"]
+        self.docs, self.labels = [], []
+        for label, sub in ((0, "pos"), (1, "neg")):
+            pattern = re.compile(rf"aclImdb/{self.mode}/{sub}/.*\.txt$")
+            for doc in self._tokenize(pattern):
+                self.docs.append(
+                    [self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language-model corpus from the simple-examples tar (reference
+    `text/datasets/imikolov.py`): vocabulary over train+valid with
+    ``<s>``/``<e>`` markers, examples as N-grams or (src, trg) pairs."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=False):
+        if data_type.upper() not in ("NGRAM", "SEQ"):
+            raise ValueError(
+                f"data_type should be 'NGRAM' or 'SEQ', got {data_type}")
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.data_type = data_type.upper()
+        self.mode = mode.lower()
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        if data_file is None:
+            raise ValueError(
+                "data_file is required (no network in this build): pass "
+                "the simple-examples.tgz archive the reference downloads")
+        self.data_file = data_file
+        self.word_idx = self._build_word_dict(min_word_freq)
+        self._load_anno()
+
+    @staticmethod
+    def _word_count(f, freq=None):
+        freq = freq if freq is not None else collections.defaultdict(int)
+        for line in f:
+            for w in line.strip().split():
+                freq[w] += 1
+            freq[b"<s>"] += 1
+            freq[b"<e>"] += 1
+        return freq
+
+    def _build_word_dict(self, cutoff):
+        with tarfile.open(self.data_file) as tf:
+            freq = self._word_count(
+                tf.extractfile("./simple-examples/data/ptb.valid.txt"),
+                self._word_count(
+                    tf.extractfile("./simple-examples/data/ptb.train.txt")))
+        freq.pop(b"<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx[b"<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        self.data = []
+        unk = self.word_idx[b"<unk>"]
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(
+                f"./simple-examples/data/ptb.{self.mode}.txt")
+            for line in f:
+                if self.data_type == "NGRAM":
+                    if self.window_size < 0:
+                        raise ValueError("NGRAM needs window_size > 0")
+                    toks = [b"<s>"] + line.strip().split() + [b"<e>"]
+                    if len(toks) < self.window_size:
+                        continue
+                    ids = [self.word_idx.get(w, unk) for w in toks]
+                    for i in range(self.window_size, len(ids) + 1):
+                        self.data.append(
+                            tuple(ids[i - self.window_size:i]))
+                else:
+                    ids = [self.word_idx.get(w, unk)
+                           for w in line.strip().split()]
+                    src = [self.word_idx[b"<s>"]] + ids
+                    trg = ids + [self.word_idx[b"<e>"]]
+                    if 0 < self.window_size < len(src):
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+_AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """Movie metadata row (reference `text/datasets/movielens.py`)."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+
+class UserInfo:
+    """User metadata row (reference `text/datasets/movielens.py`)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = _AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings from the ml-1m.zip archive (reference
+    `text/datasets/movielens.py`): '::'-separated users/movies/ratings
+    tables, ratings rescaled to [-5, 5] via r*2-5, random train/test
+    split by ``test_ratio`` under ``rand_seed``. Each example is
+    (uid, gender, age_bucket, job, movie_id, category_ids, title_ids,
+    rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        import re
+        import zipfile
+
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        if data_file is None:
+            raise ValueError(
+                "data_file is required (no network in this build): pass "
+                "the ml-1m.zip archive the reference downloads")
+        self.data_file = data_file
+
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info = {}
+        self.user_info = {}
+        title_words, category_set = set(), set()
+        with zipfile.ZipFile(data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode("latin").strip() \
+                        .split("::")
+                    cats = cats.split("|")
+                    category_set.update(cats)
+                    m = pattern.match(title)
+                    title = m.group(1) if m else title
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+                    title_words.update(w.lower() for w in title.split())
+            self.movie_title_dict = {w: i for i, w
+                                     in enumerate(sorted(title_words))}
+            self.categories_dict = {c: i for i, c
+                                    in enumerate(sorted(category_set))}
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = line.decode("latin") \
+                        .strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age,
+                                                        job)
+            rng = np.random.RandomState(rand_seed)
+            is_test = self.mode == "test"
+            self.data = []
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (rng.random_sample() < test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = line.decode("latin").strip() \
+                        .split("::")
+                    usr = self.user_info[int(uid)]
+                    mov = self.movie_info[int(mid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating) * 2 - 5.0]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT16(Dataset):
+    """WMT16 en-de parallel corpus from the reference's tar layout
+    (reference `text/datasets/wmt16.py`): members ``wmt16/{train,val,
+    test}`` hold tab-separated "en\\tde" lines. Per-language vocabularies
+    keep the ``dict_size`` most frequent train-set words behind the
+    <s>/<e>/<unk> markers (built in memory — the reference caches dict
+    files on disk). Examples are (src_ids with <s>...<e>, trg_ids with
+    leading <s>, trg_ids_next with trailing <e>)."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=False):
+        if mode.lower() not in ("train", "val", "test"):
+            raise ValueError(
+                f"mode should be 'train', 'val' or 'test', got {mode}")
+        if lang not in ("en", "de"):
+            raise ValueError(f"lang should be 'en' or 'de', got {lang}")
+        if data_file is None:
+            raise ValueError(
+                "data_file is required (no network in this build): pass "
+                "the wmt16 tar archive the reference downloads")
+        self.mode = mode.lower()
+        self.lang = lang
+        self.data_file = data_file
+        self.src_dict = self._build_dict(lang, src_dict_size)
+        self.trg_dict = self._build_dict("de" if lang == "en" else "en",
+                                         trg_dict_size)
+        self._load_data()
+
+    def _build_dict(self, lang, dict_size):
+        col = 0 if lang == "en" else 1
+        freq = collections.defaultdict(int)
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile("wmt16/train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[col].split():
+                    freq[w] += 1
+        words = [w for w, _ in sorted(freq.items(),
+                                      key=lambda x: (-x[1], x[0]))]
+        if dict_size > 0:
+            words = words[:max(dict_size - 3, 0)]
+        vocab = [self.START, self.END, self.UNK] + words
+        return {w: i for i, w in enumerate(vocab)}
+
+    def _load_data(self):
+        start = self.src_dict[self.START]
+        end = self.src_dict[self.END]
+        unk = self.src_dict[self.UNK]
+        src_col = 0 if self.lang == "en" else 1
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [self.src_dict.get(w, unk)
+                       for w in parts[src_col].split()]
+                trg = [self.trg_dict.get(w, unk)
+                       for w in parts[1 - src_col].split()]
+                self.src_ids.append([start] + src + [end])
+                self.trg_ids.append([start] + trg)
+                self.trg_ids_next.append(trg + [end])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split (reference
+    `text/datasets/conll05.py`): the tar holds gzipped word and
+    proposition columns; each verb of a sentence yields one example with
+    the bracketed proposition tags converted to B/I/O and a 5-word
+    context window around the predicate. Dict files (word/verb/target)
+    are the reference's plain one-entry-per-line files."""
+
+    UNK_IDX = 0
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=False):
+        import gzip
+
+        for name, f in (("data_file", data_file),
+                        ("word_dict_file", word_dict_file),
+                        ("verb_dict_file", verb_dict_file),
+                        ("target_dict_file", target_dict_file)):
+            if f is None:
+                raise ValueError(
+                    f"{name} is required (no network in this build): pass "
+                    "the conll05st files the reference downloads")
+        self.data_file = data_file
+        self.word_dict = self._load_dict(word_dict_file)
+        self.predicate_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_label_dict(target_dict_file)
+        self.emb_file = emb_file
+
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+                self._parse(words, props)
+
+    @staticmethod
+    def _load_dict(filename):
+        with open(filename) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(filename):
+        tags = set()
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d, idx = {}, 0
+        for tag in tags:
+            d["B-" + tag] = idx
+            d["I-" + tag] = idx + 1
+            idx += 2
+        d["O"] = idx
+        return d
+
+    def _parse(self, words_file, props_file):
+        # lockstep: one word line per prop line; a blank prop line ends
+        # the sentence (the reference's protocol)
+        sentence, columns = [], []
+        for word, prop in zip(words_file, props_file):
+            word = word.strip().decode()
+            prop = prop.strip().decode().split()
+            if not prop:
+                self._finish_sentence(sentence, columns)
+                sentence, columns = [], []
+            else:
+                sentence.append(word)
+                columns.append(prop)
+        if sentence:
+            self._finish_sentence(sentence, columns)
+
+    def _finish_sentence(self, sentence, columns):
+        if not columns:
+            return
+        # transpose the per-token rows into per-column tag sequences
+        per_col = [[row[i] for row in columns]
+                   for i in range(len(columns[0]))]
+        verbs = [v for v in per_col[0] if v != "-"]
+        for i, col in enumerate(per_col[1:]):
+            seq, cur, inside = [], "O", False
+            for tag in col:
+                if tag == "*":
+                    seq.append("I-" + cur if inside else "O")
+                elif tag == "*)":
+                    seq.append("I-" + cur)
+                    inside = False
+                elif "(" in tag and ")" in tag:
+                    cur = tag[1:tag.find("*")]
+                    seq.append("B-" + cur)
+                    inside = False
+                elif "(" in tag:
+                    cur = tag[1:tag.find("*")]
+                    seq.append("B-" + cur)
+                    inside = True
+                else:
+                    raise ValueError(f"unexpected proposition tag {tag!r}")
+            self.sentences.append(list(sentence))
+            self.predicates.append(verbs[i])
+            self.labels.append(seq)
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        v = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, name, fallback in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                                    (0, "0", None), (1, "p1", "eos"),
+                                    (2, "p2", "eos")):
+            j = v + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = fallback
+        wd = self.word_dict
+        word_idx = [wd.get(w, self.UNK_IDX) for w in sentence]
+        rows = [word_idx]
+        for name in ("n2", "n1", "0", "p1", "p2"):
+            rows.append([wd.get(ctx[name], self.UNK_IDX)] * n)
+        rows.append([self.predicate_dict.get(self.predicates[idx])] * n)
+        rows.append(mark)
+        rows.append([self.label_dict.get(t) for t in labels])
+        return tuple(np.array(r) for r in rows)
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict

@@ -1,0 +1,97 @@
+"""Viterbi decoding (reference: `python/paddle/text/viterbi_decode.py`).
+
+TPU-native: the forward max-product recursion is a ``lax.scan`` over
+time with the [B, N, N] score expansion on the VPU; backtrace is a
+second reversed scan over the stored backpointers. Variable lengths are
+handled by masking (frozen alpha beyond each sequence's end), keeping
+everything static-shaped for jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, run_op
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi(pot, trans, lengths, include_bos_eos_tag):
+    b, l, n = pot.shape
+    lengths = lengths.astype(jnp.int32)
+    alpha0 = pot[:, 0, :]
+    if include_bos_eos_tag:
+        # last row/col = start tag, second-to-last = stop tag
+        alpha0 = alpha0 + trans[-1][None, :]
+
+    def step(alpha, xs):
+        pot_t, t = xs
+        scores = alpha[:, :, None] + trans[None]          # [B, N, N]
+        best = jnp.max(scores, axis=1) + pot_t            # [B, N]
+        bp = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        live = (t < lengths)[:, None]
+        return jnp.where(live, best, alpha), bp
+
+    ts = jnp.arange(1, l, dtype=jnp.int32)
+    alpha, bps = jax.lax.scan(step, alpha0,
+                              (jnp.swapaxes(pot[:, 1:], 0, 1), ts))
+    final = alpha + (trans[:, -2][None] if include_bos_eos_tag else 0.0)
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1).astype(jnp.int32)
+
+    def back(tag, xs):
+        bp_t, t = xs
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        # the transition into position t+1 only happened if t+1 < length
+        tag = jnp.where(t + 1 <= lengths - 1, prev, tag)
+        return tag, tag
+
+    ts_rev = jnp.arange(l - 2, -1, -1, dtype=jnp.int32)
+    _, tags_rev = jax.lax.scan(back, last_tag, (bps[::-1], ts_rev))
+    paths = jnp.concatenate(
+        [tags_rev[::-1], last_tag[None]], axis=0).swapaxes(0, 1)  # [B, L]
+    pos = jnp.arange(l, dtype=jnp.int32)[None, :]
+    paths = jnp.where(pos < lengths[:, None], paths, 0)
+    return scores, paths.astype(jnp.int32)
+
+
+from ..tensor.registry import defop
+
+
+@defop(name="viterbi_decode", differentiable=False)
+def _viterbi_op(potentials, transition_params, lengths,
+                include_bos_eos_tag=True):
+    """Schema entry for the reference op `viterbi_decode`
+    (`phi/kernels/cpu/viterbi_decode_kernel.cc`)."""
+    return _viterbi(potentials, transition_params, lengths,
+                    include_bos_eos_tag)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag sequence per batch row.
+
+    Returns ``(scores [B], paths [B, max(lengths)])`` — like the
+    reference, the path tensor is truncated to the longest real
+    sequence; shorter rows are zero-padded.
+    """
+    scores, paths = _viterbi_op(potentials, transition_params, lengths,
+                                include_bos_eos_tag=include_bos_eos_tag)
+    max_len = int(np.asarray(
+        getattr(lengths, "_data", lengths)).max())
+    return scores, paths[:, :max_len]
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (reference ``ViterbiDecoder``)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

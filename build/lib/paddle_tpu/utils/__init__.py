@@ -1,0 +1,21 @@
+"""``paddle.utils`` (reference: `python/paddle/utils/__init__.py`):
+deprecated-API shims, install checks, and the C++ extension builder."""
+
+from . import cpp_extension  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+
+__all__ = ["cpp_extension", "try_import", "run_check"]
+
+
+def run_check():
+    """Reference `utils/install_check.py:run_check` — verify the install
+    can compute on the available device."""
+    import jax
+    import numpy as np
+    from .. import to_tensor
+
+    x = to_tensor(np.ones((2, 2), np.float32))
+    y = (x @ x).numpy()
+    assert (y == 2).all()
+    n = len(jax.devices())
+    print(f"PaddleTPU works! backend={jax.default_backend()} devices={n}")

@@ -1,0 +1,130 @@
+"""C++ custom-op extension builder (reference:
+`python/paddle/utils/cpp_extension/` — ``load`` JIT-compiles user C++
+into a loadable op library).
+
+TPU-native shape: custom device kernels are Pallas (Python), so the C++
+seam here is for HOST ops — data munging, tokenization, lookups — that
+plug into the eager layer as ordinary Python functions. ``load`` builds
+the sources with the same g++ flow as `paddle_tpu/native/build.py`
+(content-hash cached .so) and binds ``extern "C"`` symbols via ctypes.
+``CppExtension``/``setup`` are offered for parity with the reference's
+setuptools path.
+
+A bound symbol is called with ctypes argtypes/restype declared by the
+caller, or through :func:`numpy_op`, which wraps an
+``f(const T* in, int64 n, T* out)``-shaped kernel as a numpy->numpy
+function.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "setup", "numpy_op"]
+
+_CACHE_DIR = os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")
+
+
+class _Extension:
+    """Handle over a built .so: ``ext.fn_name`` returns the ctypes
+    symbol; declare signatures via ``ext.declare``."""
+
+    def __init__(self, path):
+        self._path = path
+        self._lib = ctypes.CDLL(path)
+
+    def declare(self, name, restype=None, argtypes=()):
+        fn = getattr(self._lib, name)
+        fn.restype = restype
+        fn.argtypes = list(argtypes)
+        return fn
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._lib, name)
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
+         extra_ldflags=None, build_directory=None, verbose=False):
+    """Compile ``sources`` (C++ files) into a cached shared object and
+    return an :class:`_Extension` (reference ``cpp_extension.load``)."""
+    srcs = [os.fspath(s) for s in sources]
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_cxx_cflags or []).encode())
+    tag = h.hexdigest()[:16]
+    out_dir = build_directory or _CACHE_DIR
+    os.makedirs(out_dir, exist_ok=True)
+    out = os.path.join(out_dir, f"{name}_{tag}.so")
+    if not os.path.exists(out):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+        cmd += extra_cxx_cflags or []
+        for inc in extra_include_paths or []:
+            cmd += ["-I", os.fspath(inc)]
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=out_dir)
+        os.close(fd)
+        try:
+            proc = subprocess.run(cmd + srcs + ["-o", tmp]
+                                  + (extra_ldflags or []),
+                                  capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"extension '{name}' failed to build:\n"
+                    f"{proc.stderr[-4000:]}")
+            os.replace(tmp, out)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        if verbose:
+            print(f"built {out}")
+    return _Extension(out)
+
+
+def numpy_op(ext, name, dtype=np.float32):
+    """Bind an ``extern "C" void f(const T* in, int64_t n, T* out)``
+    symbol as a numpy array -> numpy array function."""
+    ct = np.ctypeslib.ndpointer(dtype=dtype, flags="C_CONTIGUOUS")
+    fn = ext.declare(name, None, [ct, ctypes.c_int64, ct])
+
+    def call(x):
+        x = np.ascontiguousarray(x, dtype=dtype)
+        out = np.empty_like(x)
+        fn(x.reshape(-1), x.size, out.reshape(-1))
+        return out
+
+    call.__name__ = name
+    return call
+
+
+class CppExtension:
+    """setuptools-parity descriptor (reference ``CppExtension``)."""
+
+    def __init__(self, sources, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*args, **kwargs):
+    raise NotImplementedError(
+        "CUDAExtension has no TPU analog — device kernels are Pallas "
+        "(see paddle_tpu/ops/flash_attention.py for the pattern); use "
+        "CppExtension/load for host-side C++ ops")
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Eager analog of the reference's setuptools ``setup``: builds each
+    CppExtension immediately and returns the handles."""
+    exts = []
+    for i, ext in enumerate(ext_modules or []):
+        exts.append(load(f"{name or 'ext'}_{i}", ext.sources,
+                         **ext.kwargs))
+    return exts

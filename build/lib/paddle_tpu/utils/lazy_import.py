@@ -1,0 +1,12 @@
+"""Reference `python/paddle/utils/lazy_import.py`."""
+
+import importlib
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"module {module_name!r} is required but not "
+            "installed (and this build has no network to fetch it)")

@@ -1,0 +1,45 @@
+"""``paddle.version`` (reference: generated `python/paddle/version.py`)."""
+
+from __future__ import annotations
+
+import subprocess
+
+full_version = "0.1.0"
+major, minor, patch = (p for p in full_version.split("."))
+rc = 0
+cuda_version = "False"   # reference prints the CUDA toolkit here
+cudnn_version = "False"
+tensorrt_version = "False"
+xpu_version = "False"
+
+istaged = False
+with_pip = False
+
+
+def _git_commit():
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             capture_output=True, text=True, timeout=5)
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+commit = _git_commit()
+
+
+def show():
+    """Reference ``paddle.version.show()``."""
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("tpu: True (XLA/PJRT backend)")
+    print(f"cuda: {cuda_version}")
+    print(f"cudnn: {cudnn_version}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -1,0 +1,11 @@
+"""``paddle_tpu.vision`` — datasets, transforms, model zoo.
+
+Reference: `python/paddle/vision/__init__.py`.
+"""
+
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import ops  # noqa: F401
+
+__all__ = ["datasets", "models", "transforms", "ops"]

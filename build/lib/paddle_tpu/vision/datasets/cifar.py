@@ -1,0 +1,102 @@
+"""CIFAR datasets (reference: `python/paddle/vision/datasets/cifar.py`).
+
+Parses the real ``cifar-10-python.tar.gz`` / ``cifar-100-python.tar.gz``
+archives (pickled batches of [N, 3072] uint8 rows) when ``data_file`` is
+given. With no archive (this build has zero egress) it falls back to a
+deterministic synthetic task: each class is a distinct 32x32 RGB
+frequency pattern plus noise — a real N-way classification problem for
+end-to-end tests, clearly labeled as synthetic.
+"""
+
+from __future__ import annotations
+
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["Cifar10", "Cifar100"]
+
+
+def _synthetic(mode, num_classes, n_per_class, seed=7):
+    rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+    xs, ys = [], []
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32) / 32.0
+    for c in range(num_classes):
+        fx, fy = 1 + c % 5, 1 + (c // 5) % 5
+        phase = 2 * np.pi * c / num_classes
+        base = np.stack([
+            np.sin(2 * np.pi * fx * xx + phase),
+            np.cos(2 * np.pi * fy * yy + phase),
+            np.sin(2 * np.pi * (fx * xx + fy * yy)),
+        ])  # [3, 32, 32]
+        for _ in range(n_per_class):
+            img = base + 0.4 * rng.randn(3, 32, 32).astype(np.float32)
+            img = ((img - img.min()) / (np.ptp(img) + 1e-6) * 255)
+            xs.append(img.astype(np.uint8))
+            ys.append(c)
+    order = rng.permutation(len(xs))
+    return ([xs[i] for i in order], [ys[i] for i in order])
+
+
+class Cifar10(Dataset):
+    """10-class 32x32 RGB images. ``data_file=None`` -> synthetic task."""
+
+    MODE_TRAIN_MEMBERS = [f"data_batch_{i}" for i in range(1, 6)]
+    MODE_TEST_MEMBERS = ["test_batch"]
+    _label_key = b"labels"
+    num_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        self.transform = transform
+        if data_file is None:
+            n = 200 if self.mode == "train" else 50
+            self.images, self.labels = _synthetic(
+                self.mode, self.num_classes, n)
+        else:
+            self.images, self.labels = self._load_archive(data_file)
+
+    def _load_archive(self, data_file):
+        wanted = (self.MODE_TRAIN_MEMBERS if self.mode == "train"
+                  else self.MODE_TEST_MEMBERS)
+        images, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                stem = member.name.rsplit("/", 1)[-1]
+                if stem not in wanted:
+                    continue
+                batch = pickle.load(tf.extractfile(member),
+                                    encoding="bytes")
+                data = batch[b"data"].reshape(-1, 3, 32, 32)
+                images.extend(data)
+                labels.extend(batch[self._label_key])
+        if not images:
+            raise ValueError(
+                f"no {wanted} members found in {data_file!r} — expected "
+                "the reference's cifar python archive layout")
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)
+        label = np.array([self.labels[idx]], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    """100-class variant (reference ``Cifar100``: fine labels)."""
+
+    MODE_TRAIN_MEMBERS = ["train"]
+    MODE_TEST_MEMBERS = ["test"]
+    _label_key = b"fine_labels"
+    num_classes = 100

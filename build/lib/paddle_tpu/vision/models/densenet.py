@@ -1,0 +1,101 @@
+"""DenseNet (reference: `python/paddle/vision/models/densenet.py`).
+
+Dense blocks concatenate along channels; XLA keeps the concats as
+views feeding the next conv's im2col, so no quadratic copies.
+"""
+
+from __future__ import annotations
+
+from ... import nn
+from ...tensor import manipulation
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class DenseLayer(nn.Layer):
+    def __init__(self, inp, growth, bn_size=4, dropout=0.0):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(inp)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(inp, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return manipulation.concat([x, out], axis=1)
+
+
+class Transition(nn.Sequential):
+    def __init__(self, inp, oup):
+        super().__init__(
+            nn.BatchNorm2D(inp), nn.ReLU(),
+            nn.Conv2D(inp, oup, 1, bias_attr=False),
+            nn.AvgPool2D(2, stride=2))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        if layers not in _CFG:
+            raise ValueError(f"layers must be one of {sorted(_CFG)}")
+        init_ch, growth, blocks = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_ch, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_ch), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        ch = init_ch
+        feats = []
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if i != len(blocks) - 1:
+                feats.append(Transition(ch, ch // 2))
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape([x.shape[0], -1]))
+        return x
+
+
+def _factory(depth):
+    def build(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError("pretrained weights are not bundled")
+        return DenseNet(layers=depth, **kwargs)
+    return build
+
+
+densenet121 = _factory(121)
+densenet161 = _factory(161)
+densenet169 = _factory(169)
+densenet201 = _factory(201)
+densenet264 = _factory(264)

@@ -1,0 +1,98 @@
+"""GoogLeNet / Inception v1 (reference:
+`python/paddle/vision/models/googlenet.py`). Returns (main, aux1, aux2)
+logits like the reference; aux heads are identity in eval mode.
+"""
+
+from __future__ import annotations
+
+from ... import nn
+from ...tensor import manipulation
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+def _conv_relu(inp, oup, k, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(inp, oup, k, stride=stride, padding=padding), nn.ReLU())
+
+
+class Inception(nn.Layer):
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _conv_relu(inp, c1, 1)
+        self.b2 = nn.Sequential(_conv_relu(inp, c3r, 1),
+                                _conv_relu(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_conv_relu(inp, c5r, 1),
+                                _conv_relu(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _conv_relu(inp, proj, 1))
+
+    def forward(self, x):
+        return manipulation.concat(
+            [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class AuxHead(nn.Layer):
+    def __init__(self, inp, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((4, 4))
+        self.conv = _conv_relu(inp, 128, 1)
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = self.relu(self.fc1(x.reshape([x.shape[0], -1])))
+        return self.fc2(self.dropout(x))
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _conv_relu(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _conv_relu(64, 64, 1),
+            _conv_relu(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.inc3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if num_classes > 0:
+            self.aux1 = AuxHead(512, num_classes)
+            self.aux2 = AuxHead(528, num_classes)
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.inc3b(self.inc3a(self.stem(x)))
+        x = self.inc4a(self.pool3(x))
+        aux1 = self.aux1(x) if (self.num_classes > 0 and self.training) \
+            else None
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        aux2 = self.aux2(x) if (self.num_classes > 0 and self.training) \
+            else None
+        x = self.inc5b(self.inc5a(self.pool4(self.inc4e(x))))
+        x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.reshape([x.shape[0], -1])))
+        return x, aux1, aux2
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,125 @@
+"""MobileNetV3 (reference: `python/paddle/vision/models/mobilenetv3.py`)."""
+
+from __future__ import annotations
+
+from ... import nn
+from .mobilenetv2 import _make_divisible
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+class SqueezeExcite(nn.Layer):
+    def __init__(self, ch, squeeze_ch):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(ch, squeeze_ch, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze_ch, ch, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class ConvBNAct(nn.Sequential):
+    def __init__(self, inp, oup, k, stride=1, groups=1, act=None):
+        layers = [
+            nn.Conv2D(inp, oup, k, stride=stride, padding=(k - 1) // 2,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(oup)]
+        if act is not None:
+            layers.append(act())
+        super().__init__(*layers)
+
+
+class InvertedResidualV3(nn.Layer):
+    def __init__(self, inp, hidden, oup, k, stride, use_se, use_hs):
+        super().__init__()
+        act = nn.Hardswish if use_hs else nn.ReLU
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if hidden != inp:
+            layers.append(ConvBNAct(inp, hidden, 1, act=act))
+        layers.append(ConvBNAct(hidden, hidden, k, stride=stride,
+                                groups=hidden, act=act))
+        if use_se:
+            layers.append(SqueezeExcite(hidden,
+                                        _make_divisible(hidden // 4)))
+        layers.append(ConvBNAct(hidden, oup, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, hidden, out, use_se, use_hs, stride)
+_LARGE = [
+    (3, 16, 16, False, False, 1), (3, 64, 24, False, False, 2),
+    (3, 72, 24, False, False, 1), (5, 72, 40, True, False, 2),
+    (5, 120, 40, True, False, 1), (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2), (3, 200, 80, False, True, 1),
+    (3, 184, 80, False, True, 1), (3, 184, 80, False, True, 1),
+    (3, 480, 112, True, True, 1), (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2), (5, 960, 160, True, True, 1),
+    (5, 960, 160, True, True, 1),
+]
+_SMALL = [
+    (3, 16, 16, True, False, 2), (3, 72, 24, False, False, 2),
+    (3, 88, 24, False, False, 1), (5, 96, 40, True, True, 2),
+    (5, 240, 40, True, True, 1), (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1), (5, 144, 48, True, True, 1),
+    (5, 288, 96, True, True, 2), (5, 576, 96, True, True, 1),
+    (5, 576, 96, True, True, 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_ch, num_classes=1000, scale=1.0):
+        super().__init__()
+        self.num_classes = num_classes
+        inp = _make_divisible(16 * scale)
+        layers = [ConvBNAct(3, inp, 3, stride=2, act=nn.Hardswish)]
+        for k, hidden, oup, se, hs, s in cfg:
+            hidden = _make_divisible(hidden * scale)
+            oup = _make_divisible(oup * scale)
+            layers.append(InvertedResidualV3(inp, hidden, oup, k, s, se, hs))
+            inp = oup
+        last_conv = _make_divisible(6 * inp)
+        layers.append(ConvBNAct(inp, last_conv, 1, act=nn.Hardswish))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        if self.num_classes > 0:
+            x = self.classifier(x.reshape([x.shape[0], -1]))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__(_SMALL, 1024, num_classes, scale)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__(_LARGE, 1280, num_classes, scale)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV3Large(scale=scale, **kwargs)

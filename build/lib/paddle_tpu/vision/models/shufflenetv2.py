@@ -1,0 +1,114 @@
+"""ShuffleNetV2 (reference: `python/paddle/vision/models/shufflenetv2.py`).
+
+Channel split + shuffle; the shuffle is `F.channel_shuffle` (a pure
+relayout XLA folds into the surrounding convs).
+"""
+
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+from ...tensor import manipulation
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0"]
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+def _conv_bn(inp, oup, k, stride=1, groups=1, act=True):
+    layers = [nn.Conv2D(inp, oup, k, stride=stride, padding=(k - 1) // 2,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(oup)]
+    if act:
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class ShuffleUnit(nn.Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch = oup // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(inp // 2, branch, 1),
+                _conv_bn(branch, branch, 3, groups=branch, act=False),
+                _conv_bn(branch, branch, 1))
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(inp, inp, 3, stride=stride, groups=inp, act=False),
+                _conv_bn(inp, branch, 1))
+            self.branch2 = nn.Sequential(
+                _conv_bn(inp, branch, 1),
+                _conv_bn(branch, branch, 3, stride=stride, groups=branch,
+                         act=False),
+                _conv_bn(branch, branch, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1 = x[:, :half]
+            x2 = x[:, half:]
+            out = manipulation.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = manipulation.concat([self.branch1(x), self.branch2(x)],
+                                      axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"scale must be one of {sorted(_STAGE_OUT)}")
+        ch = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _conv_bn(3, ch[0], 3, stride=2)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = ch[0]
+        for stage_idx, repeats in enumerate([4, 8, 4]):
+            oup = ch[stage_idx + 1]
+            units = [ShuffleUnit(inp, oup, stride=2)]
+            units += [ShuffleUnit(oup, oup, stride=1)
+                      for _ in range(repeats - 1)]
+            stages.append(nn.Sequential(*units))
+            inp = oup
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _conv_bn(inp, ch[4], 1)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(ch[4], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape([x.shape[0], -1]))
+        return x
+
+
+def _factory(scale):
+    def build(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError("pretrained weights are not bundled")
+        return ShuffleNetV2(scale=scale, **kwargs)
+    return build
+
+
+shufflenet_v2_x0_25 = _factory(0.25)
+shufflenet_v2_x0_5 = _factory(0.5)
+shufflenet_v2_x1_0 = _factory(1.0)
+shufflenet_v2_x1_5 = _factory(1.5)
+shufflenet_v2_x2_0 = _factory(2.0)

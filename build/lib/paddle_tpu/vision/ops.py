@@ -1,0 +1,764 @@
+"""Detection ops (reference: `python/paddle/vision/ops.py` — nms:1867,
+roi_align:1640, roi_pool, box kernels in `phi/kernels/gpu/`).
+
+TPU-native notes: NMS's greedy suppression is an O(N^2) IoU matrix +
+a ``lax.fori_loop`` sweep (static shapes, no data-dependent Python);
+RoI align is vectorized bilinear gather-interpolation over a static
+sampling grid, so XLA fuses it into a few gathers + contractions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import run_op
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_iou", "deform_conv2d",
+           "DeformConv2D", "box_coder", "prior_box", "yolo_box",
+           "matrix_nms", "psroi_pool", "distribute_fpn_proposals",
+           "generate_proposals", "multiclass_nms3", "read_file", "decode_jpeg"]
+
+
+def _iou_matrix(boxes):
+    """[N, 4] xyxy -> [N, N] IoU."""
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = (x2 - x1) * (y2 - y1)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU between two [N,4]/[M,4] xyxy sets -> [N, M]."""
+    def fn(a, b):
+        x1, y1, x2, y2 = (a[:, i] for i in range(4))
+        u1, v1, u2, v2 = (b[:, i] for i in range(4))
+        area_a = (x2 - x1) * (y2 - y1)
+        area_b = (u2 - u1) * (v2 - v1)
+        ix1 = jnp.maximum(x1[:, None], u1[None, :])
+        iy1 = jnp.maximum(y1[:, None], v1[None, :])
+        ix2 = jnp.minimum(x2[:, None], u2[None, :])
+        iy2 = jnp.minimum(y2[:, None], v2[None, :])
+        inter = jnp.maximum(ix2 - ix1, 0.0) * jnp.maximum(iy2 - iy1, 0.0)
+        union = area_a[:, None] + area_b[None, :] - inter
+        return jnp.where(union > 0, inter / union, 0.0)
+
+    return run_op("box_iou", fn, (boxes1, boxes2), differentiable=False)
+
+
+def _nms_kept_mask(boxes, iou_threshold):
+    """Greedy NMS on boxes already sorted by descending score; returns a
+    bool keep-mask. lax.fori_loop over rows: a row survives iff no
+    earlier surviving row overlaps it beyond the threshold."""
+    iou = _iou_matrix(boxes)
+    n = boxes.shape[0]
+
+    def body(i, keep):
+        # suppressed if any kept j < i has IoU > thr
+        over = (iou[i] > iou_threshold) & keep \
+            & (jnp.arange(n) < i)
+        return keep.at[i].set(~jnp.any(over))
+
+    return jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Reference `vision/ops.py:1867`. Returns indices of kept boxes
+    sorted by descending score (or input order when ``scores`` is None),
+    truncated to ``top_k``."""
+    def fn(boxes, scores, category_idxs):
+        n = boxes.shape[0]
+        order = jnp.arange(n) if scores is None \
+            else jnp.argsort(-scores)
+        sorted_boxes = boxes[order]
+        if category_idxs is None:
+            keep = _nms_kept_mask(sorted_boxes, iou_threshold)
+        else:
+            # batched NMS: offset each category's boxes to disjoint
+            # regions so cross-category IoU is 0 (standard trick — one
+            # kernel instead of a per-category loop)
+            cats = category_idxs[order].astype(sorted_boxes.dtype)
+            span = jnp.max(sorted_boxes) - jnp.min(sorted_boxes) + 1.0
+            shifted = sorted_boxes + (cats * span)[:, None]
+            keep = _nms_kept_mask(shifted, iou_threshold)
+        kept = order[jnp.where(keep, size=n, fill_value=-1)[0]]
+        kept = kept[jnp.where(kept >= 0, size=n, fill_value=-1)[0]]
+        count = int(jnp.sum(keep))
+        return kept[:count] if top_k is None \
+            else kept[:min(top_k, count)]
+
+    # host-side sizes: NMS output is inherently data-dependent, so this
+    # op runs eagerly (like the reference's CPU/GPU kernel returning a
+    # dynamic-size tensor)
+    return run_op("nms", fn, (boxes, scores, category_idxs),
+                  differentiable=False)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Reference `vision/ops.py:1640` (Mask R-CNN RoI Align). x [N,C,H,W];
+    boxes [R, 4] xyxy in input-image coordinates; boxes_num [N] ints
+    summing to R. Output [R, C, ph, pw]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def fn(x, boxes, boxes_num):
+        n, c, h, w = x.shape
+        r = boxes.shape[0]
+        # map each roi to its batch image
+        img_idx = jnp.repeat(jnp.arange(n), boxes_num, axis=0,
+                             total_repeat_length=r)
+        off = 0.5 if aligned else 0.0
+        bx = boxes * spatial_scale
+        x1, y1, x2, y2 = (bx[:, i] for i in range(4))
+        x1, y1 = x1 - off, y1 - off
+        x2, y2 = x2 - off, y2 - off
+        roi_w = x2 - x1
+        roi_h = y2 - y1
+        if not aligned:
+            roi_w = jnp.maximum(roi_w, 1.0)
+            roi_h = jnp.maximum(roi_h, 1.0)
+        bin_w = roi_w / pw
+        bin_h = roi_h / ph
+        s = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [R, ph, s] y coords and [R, pw, s] x coords
+        sy = (jnp.arange(ph)[None, :, None]
+              + (jnp.arange(s)[None, None, :] + 0.5) / s)
+        sx = (jnp.arange(pw)[None, :, None]
+              + (jnp.arange(s)[None, None, :] + 0.5) / s)
+        ys = y1[:, None, None] + sy * bin_h[:, None, None]   # [R, ph, s]
+        xs = x1[:, None, None] + sx * bin_w[:, None, None]   # [R, pw, s]
+
+        def bilinear(img, yy, xx):
+            """img [C, H, W]; yy [ph*s], xx [pw*s] -> [C, ph*s, pw*s]."""
+            y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+            y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+            wy1 = jnp.clip(yy - y0, 0.0, 1.0)
+            wx1 = jnp.clip(xx - x0, 0.0, 1.0)
+            wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+            # zero contribution for samples outside the feature map
+            valid_y = ((yy >= -1) & (yy <= h)).astype(img.dtype)
+            valid_x = ((xx >= -1) & (xx <= w)).astype(img.dtype)
+            g = lambda yi, xi: img[:, yi][:, :, xi]      # [C, len(y), len(x)]
+            out = (g(y0i, x0i) * (wy0 * valid_y)[None, :, None]
+                   * (wx0 * valid_x)[None, None, :]
+                   + g(y0i, x1i) * (wy0 * valid_y)[None, :, None]
+                   * (wx1 * valid_x)[None, None, :]
+                   + g(y1i, x0i) * (wy1 * valid_y)[None, :, None]
+                   * (wx0 * valid_x)[None, None, :]
+                   + g(y1i, x1i) * (wy1 * valid_y)[None, :, None]
+                   * (wx1 * valid_x)[None, None, :])
+            return out
+
+        def per_roi(ri):
+            img = x[img_idx[ri]]                        # [C, H, W]
+            yy = ys[ri].reshape(-1)                     # [ph*s]
+            xx = xs[ri].reshape(-1)                     # [pw*s]
+            vals = bilinear(img, yy, xx)                # [C, ph*s, pw*s]
+            vals = vals.reshape(c, ph, s, pw, s)
+            return jnp.mean(vals, axis=(2, 4))          # [C, ph, pw]
+
+        return jax.vmap(per_roi)(jnp.arange(r))
+
+    return run_op("roi_align", fn, (x, boxes, boxes_num))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Reference `vision/ops.py` roi_pool (max pooling per bin, Fast
+    R-CNN). Same layout as :func:`roi_align`."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def fn(x, boxes, boxes_num):
+        n, c, h, w = x.shape
+        r = boxes.shape[0]
+        img_idx = jnp.repeat(jnp.arange(n), boxes_num, axis=0,
+                             total_repeat_length=r)
+        bx = jnp.round(boxes * spatial_scale)
+        x1 = bx[:, 0].astype(jnp.int32)
+        y1 = bx[:, 1].astype(jnp.int32)
+        x2 = jnp.maximum(bx[:, 2].astype(jnp.int32), x1 + 1)
+        y2 = jnp.maximum(bx[:, 3].astype(jnp.int32), y1 + 1)
+
+        ww = jnp.arange(w)
+        hh = jnp.arange(h)
+
+        def per_roi(ri):
+            img = x[img_idx[ri]]
+            # bin edges (float) over the roi
+            ys = y1[ri] + (y2[ri] - y1[ri]) * jnp.arange(ph + 1) / ph
+            xs = x1[ri] + (x2[ri] - x1[ri]) * jnp.arange(pw + 1) / pw
+
+            def pool_bin(by, bx_):
+                y_lo = jnp.floor(ys[by]).astype(jnp.int32)
+                y_hi = jnp.ceil(ys[by + 1]).astype(jnp.int32)
+                x_lo = jnp.floor(xs[bx_]).astype(jnp.int32)
+                x_hi = jnp.ceil(xs[bx_ + 1]).astype(jnp.int32)
+                m = ((hh >= y_lo) & (hh < jnp.maximum(y_hi, y_lo + 1)))[
+                    :, None] & \
+                    ((ww >= x_lo) & (ww < jnp.maximum(x_hi, x_lo + 1)))[
+                    None, :]
+                m = m & (hh[:, None] < h) & (ww[None, :] < w)
+                return jnp.max(
+                    jnp.where(m[None], img, -jnp.inf), axis=(1, 2))
+
+            grid = jax.vmap(lambda by: jax.vmap(
+                lambda bx_: pool_bin(by, bx_))(jnp.arange(pw)))(
+                jnp.arange(ph))                          # [ph, pw, C]
+            return jnp.transpose(grid, (2, 0, 1))
+
+        return jax.vmap(per_roi)(jnp.arange(r))
+
+    return run_op("roi_pool", fn, (x, boxes, boxes_num))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference `vision/ops.py:753`,
+    CUDA kernel `phi/kernels/gpu/deformable_conv_kernel.cu`).
+
+    x [N, Cin, H, W]; offset [N, 2*dg*kh*kw, Ho, Wo] ordered (y, x) per
+    tap; optional mask [N, dg*kh*kw, Ho, Wo] (v2 modulation); weight
+    [Cout, Cin/groups, kh, kw]. TPU-native: every kernel tap becomes one
+    batched bilinear gather over its offset field, accumulated into an
+    im2col-like tensor that contracts with the weights on the MXU — no
+    per-position scalar loops.
+    """
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def fn(x, offset, weight, bias, mask):
+        n, cin, h, w = x.shape
+        cout, cin_g, kh, kw = weight.shape
+        ho = (h + 2 * padding[0] - dilation[0] * (kh - 1) - 1) \
+            // stride[0] + 1
+        wo = (w + 2 * padding[1] - dilation[1] * (kw - 1) - 1) \
+            // stride[1] + 1
+        dg = deformable_groups
+        off = offset.reshape(n, dg, kh * kw, 2, ho, wo)
+        if mask is not None:
+            mk = mask.reshape(n, dg, kh * kw, ho, wo)
+        # base sampling grid per tap: [kh*kw, Ho, Wo]
+        base_y = (jnp.arange(ho) * stride[0] - padding[0])[None, :, None] \
+            + (jnp.arange(kh) * dilation[0])[:, None, None].repeat(
+                kw, axis=0).reshape(kh * kw, 1, 1)
+        base_x = (jnp.arange(wo) * stride[1] - padding[1])[None, None, :] \
+            + jnp.tile(jnp.arange(kw) * dilation[1], kh)[:, None, None]
+        ys = base_y[None, None] + off[:, :, :, 0]       # [N, dg, K, Ho, Wo]
+        xs = base_x[None, None] + off[:, :, :, 1]
+
+        # bilinear sample x at (ys, xs) for each deformable group's
+        # channel slice: returns [N, dg, C/dg, K, Ho, Wo]
+        cg = cin // dg
+        xg = x.reshape(n, dg, cg, h, w)
+
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy1 = (ys - y0)[:, :, None]                     # [N, dg, 1, K, ...]
+        wx1 = (xs - x0)[:, :, None]
+        wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+        valid = ((ys > -1) & (ys < h) & (xs > -1) & (xs < w))[:, :, None]
+
+        def gather(yi, xi):
+            yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            flat = yi * w + xi                          # [N, dg, K, Ho, Wo]
+            xf = xg.reshape(n, dg, cg, h * w)
+            # take_along_axis over the flattened spatial dim
+            idx = flat.reshape(n, dg, 1, -1)
+            out = jnp.take_along_axis(
+                xf, jnp.broadcast_to(idx, (n, dg, cg, idx.shape[-1])),
+                axis=-1)
+            return out.reshape(n, dg, cg, kh * kw, ho, wo)
+
+        sampled = (gather(y0, x0) * wy0 * wx0
+                   + gather(y0, x0 + 1) * wy0 * wx1
+                   + gather(y0 + 1, x0) * wy1 * wx0
+                   + gather(y0 + 1, x0 + 1) * wy1 * wx1)
+        sampled = jnp.where(valid, sampled, 0.0)
+        if mask is not None:
+            sampled = sampled * mk[:, :, None]
+        # [N, Cin, K, Ho, Wo] -> grouped contraction with the weights
+        col = sampled.reshape(n, cin, kh * kw, ho, wo)
+        colg = col.reshape(n, groups, cin // groups, kh * kw, ho, wo)
+        wg = weight.reshape(groups, cout // groups, cin_g, kh * kw)
+        out = jnp.einsum("ngckhw,gock->ngohw", colg, wg,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(n, cout, ho, wo).astype(x.dtype)
+        if bias is not None:
+            out = out + bias.reshape(1, cout, 1, 1)
+        return out
+
+    return run_op("deform_conv2d", fn, (x, offset, weight, bias, mask))
+
+
+class DeformConv2D:
+    """Layer wrapper over :func:`deform_conv2d` (reference
+    `vision/ops.py:DeformConv2D`). Holds weight/bias; offset (and v2
+    mask) are runtime inputs, as in the reference."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from .. import nn
+
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+        # reuse Conv2D's parameter creation (fan-in init, attrs)
+        self._conv = nn.Conv2D(in_channels, out_channels, ks, stride=stride,
+                               padding=padding, dilation=dilation,
+                               groups=groups, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+        self.weight = self._conv.weight
+        self.bias = self._conv.bias
+
+    def parameters(self):
+        return self._conv.parameters()
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._cfg)
+
+
+# -- reference detection-op parity batch (phi/api/yaml: box_coder,
+#    prior_box, yolo_box, matrix_nms, psroi_pool,
+#    distribute_fpn_proposals, generate_proposals) --------------------------
+from ..tensor.registry import defop  # noqa: E402
+
+
+@defop(differentiable=False)
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0):
+    """Encode/decode boxes against priors (reference op `box_coder`,
+    kernel `phi/kernels/cpu/box_coder_kernel.cc` — formulas match
+    EncodeCenterSize/DecodeCenterSize exactly, including the +1
+    width/height for unnormalized boxes)."""
+    pb = jnp.asarray(prior_box, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    one = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + one
+    ph = pb[:, 3] - pb[:, 1] + one
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if prior_box_var is None:
+        var = jnp.ones((pb.shape[0], 4), jnp.float32)
+    elif isinstance(prior_box_var, (list, tuple)):
+        var = jnp.broadcast_to(jnp.asarray(prior_box_var, jnp.float32),
+                               (pb.shape[0], 4))
+    else:
+        var = jnp.asarray(prior_box_var, jnp.float32)
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + one
+        th = tb[:, 3] - tb[:, 1] + one
+        tcx = (tb[:, 0] + tb[:, 2]) / 2
+        tcy = (tb[:, 1] + tb[:, 3]) / 2
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)     # [N, M, 4]
+        return out / var[None, :, :]
+    if code_type != "decode_center_size":
+        raise ValueError(f"bad code_type {code_type!r}")
+    # decode: target [N, M, 4]; prior broadcast along `axis`
+    exp = (slice(None), None) if axis == 0 else (None, slice(None))
+    pw_, ph_ = pw[exp], ph[exp]
+    pcx_, pcy_ = pcx[exp], pcy[exp]
+    var_ = var[exp + (slice(None),)]
+    cx = var_[..., 0] * tb[..., 0] * pw_ + pcx_
+    cy = var_[..., 1] * tb[..., 1] * ph_ + pcy_
+    w = jnp.exp(var_[..., 2] * tb[..., 2]) * pw_
+    h = jnp.exp(var_[..., 3] * tb[..., 3]) * ph_
+    return jnp.stack([cx - w / 2, cy - h / 2,
+                      cx + w / 2 - one, cy + h / 2 - one], axis=-1)
+
+
+@defop(differentiable=False)
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes (reference op `prior_box`,
+    `phi/kernels/cpu/prior_box_kernel.cc`). Returns (boxes, variances)
+    each [H, W, num_priors, 4]."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    max_sizes = list(max_sizes or [])
+    cx = (np.arange(fw) + offset) * step_w        # [W]
+    cy = (np.arange(fh) + offset) * step_h        # [H]
+    whs = []                                       # (w/2, h/2) per prior
+    for s, mn in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((mn / 2, mn / 2))
+            if max_sizes:
+                mx = max_sizes[s]
+                whs.append((np.sqrt(mn * mx) / 2,) * 2)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((mn * np.sqrt(ar) / 2, mn / np.sqrt(ar) / 2))
+        else:
+            for ar in ars:
+                whs.append((mn * np.sqrt(ar) / 2, mn / np.sqrt(ar) / 2))
+            if max_sizes:
+                mx = max_sizes[s]
+                whs.append((np.sqrt(mn * mx) / 2,) * 2)
+    wh = np.asarray(whs, np.float32)              # [P, 2]
+    ccx = np.broadcast_to(cx[None, :, None], (fh, fw, wh.shape[0]))
+    ccy = np.broadcast_to(cy[:, None, None], (fh, fw, wh.shape[0]))
+    boxes = np.stack([(ccx - wh[None, None, :, 0]) / iw,
+                      (ccy - wh[None, None, :, 1]) / ih,
+                      (ccx + wh[None, None, :, 0]) / iw,
+                      (ccy + wh[None, None, :, 1]) / ih], axis=-1)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(np.asarray(variance, np.float32),
+                            boxes.shape).copy()
+    return jnp.asarray(boxes), jnp.asarray(vars_)
+
+
+@defop(differentiable=False)
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """YOLOv3 head decode (reference op `yolo_box`,
+    `phi/kernels/funcs/yolo_box_util.h:GetYoloBox` — same center/size
+    formulas, clipping, and confidence gating)."""
+    x = jnp.asarray(x, jnp.float32)
+    n, _, h, w = x.shape
+    an = len(anchors) // 2
+    aw = jnp.asarray(anchors[0::2], jnp.float32)
+    ah = jnp.asarray(anchors[1::2], jnp.float32)
+    isz = jnp.asarray(img_size, jnp.float32)       # [N, 2] = (h, w)
+    img_h = isz[:, 0][:, None, None, None]
+    img_w = isz[:, 1][:, None, None, None]
+    in_w = downsample_ratio * w
+    in_h = downsample_ratio * h
+    if iou_aware:
+        ious = jax.nn.sigmoid(x[:, :an].reshape(n, an, 1, h, w))
+        x = x[:, an:]
+    v = x.reshape(n, an, 5 + int(class_num), h, w)
+    gi = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gj = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    scale, bias = float(scale_x_y), -0.5 * (float(scale_x_y) - 1)
+    cx = (gi + jax.nn.sigmoid(v[:, :, 0]) * scale + bias) * img_w / w
+    cy = (gj + jax.nn.sigmoid(v[:, :, 1]) * scale + bias) * img_h / h
+    bw = jnp.exp(v[:, :, 2]) * aw[None, :, None, None] * img_w / in_w
+    bh = jnp.exp(v[:, :, 3]) * ah[None, :, None, None] * img_h / in_h
+    conf = jax.nn.sigmoid(v[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) \
+            * ious[:, :, 0] ** iou_aware_factor
+    x1, y1 = cx - bw / 2, cy - bh / 2
+    x2, y2 = cx + bw / 2, cy + bh / 2
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    keep = (conf > conf_thresh).astype(jnp.float32)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=2) * keep[:, :, None]
+    scores = jax.nn.sigmoid(v[:, :, 5:]) * (conf * keep)[:, :, None]
+    boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(n, -1, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, -1, int(class_num))
+    return boxes, scores
+
+
+@defop(differentiable=False)
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None):
+    """Assign RoIs to FPN levels (reference op
+    `distribute_fpn_proposals`,
+    `phi/kernels/impl/distribute_fpn_proposals_kernel_impl.h`):
+    level = floor(refer_level + log2(sqrt(area) / refer_scale)),
+    clamped to [min_level, max_level]. Returns (rois per level,
+    restore_index) with each level's rois gathered in order."""
+    rois = jnp.asarray(fpn_rois, jnp.float32)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = jnp.sqrt(ws * hs)
+    lvl = jnp.floor(jnp.log2(scale / float(refer_scale) + 1e-8)) \
+        + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    order = jnp.argsort(lvl, stable=True)
+    restore = jnp.argsort(order, stable=True)
+    multi_rois, counts = [], []
+    for level in range(int(min_level), int(max_level) + 1):
+        mask = lvl == level
+        counts.append(jnp.sum(mask.astype(jnp.int32)))
+        # stable partition: rois of this level in original order,
+        # padded region filled by duplicating the sort gather (callers
+        # use the per-level count to slice)
+        sel = jnp.argsort(jnp.where(mask, 0, 1), stable=True)
+        multi_rois.append(rois[sel])
+    return tuple(multi_rois) + (restore,) + tuple(counts)
+
+
+@defop(differentiable=False)
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=-1, keep_top_k=-1, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True):
+    """Matrix NMS (reference op `matrix_nms`,
+    `phi/kernels/impl/matrix_nms_kernel_impl.h` — SOLOv2's parallel
+    soft suppression). bboxes [N, M, 4], scores [N, C, M]; returns
+    ([N, K, 6] (class, score, box) sorted by decayed score, padded with
+    -1 rows, and per-image kept counts [N])."""
+    b = jnp.asarray(bboxes, jnp.float32)
+    s = jnp.asarray(scores, jnp.float32)
+    n, c, m = s.shape
+    top_k = m if nms_top_k < 0 else min(int(nms_top_k), m)
+
+    def one_class(boxes, sc):
+        order = jnp.argsort(-sc)[:top_k]
+        bs, ss = boxes[order], sc[order]
+        valid = ss > score_threshold
+        x1, y1, x2, y2 = bs[:, 0], bs[:, 1], bs[:, 2], bs[:, 3]
+        one = 0.0 if normalized else 1.0
+        area = (x2 - x1 + one) * (y2 - y1 + one)
+        ix1 = jnp.maximum(x1[:, None], x1[None, :])
+        iy1 = jnp.maximum(y1[:, None], y1[None, :])
+        ix2 = jnp.minimum(x2[:, None], x2[None, :])
+        iy2 = jnp.minimum(y2[:, None], y2[None, :])
+        iw = jnp.maximum(ix2 - ix1 + one, 0)
+        ih = jnp.maximum(iy2 - iy1 + one, 0)
+        inter = iw * ih
+        iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                                  1e-10)
+        upper = jnp.tril(iou, k=-1)                 # [i, j<i]: iou with
+        #                                             higher-scored box j
+        # compensate iou of j = its own max iou with anything above it
+        comp = jnp.max(upper, axis=1)
+        if use_gaussian:
+            decay = jnp.exp((comp[None, :] ** 2 - upper ** 2)
+                            / gaussian_sigma)
+        else:
+            decay = (1 - upper) / jnp.maximum(1 - comp[None, :], 1e-10)
+        decay = jnp.where(jnp.tril(jnp.ones_like(iou), k=-1) > 0,
+                          decay, jnp.inf)
+        dec = jnp.min(decay, axis=1)     # over higher-scored boxes j < i
+        dec = jnp.where(jnp.isinf(dec), 1.0, dec)
+        out_s = jnp.where(valid, ss * dec, -1.0)
+        return bs, out_s
+
+    outs, cnts = [], []
+    for bi in range(n):
+        rows = []
+        for ci in range(c):
+            if ci == background_label:
+                continue
+            bs, ds = one_class(b[bi], s[bi, ci])
+            keep = ds > post_threshold
+            rows.append(jnp.concatenate(
+                [jnp.full((bs.shape[0], 1), ci, jnp.float32),
+                 jnp.where(keep, ds, -1.0)[:, None],
+                 jnp.where(keep[:, None], bs, -1.0)], axis=1))
+        if not rows:  # every class was the background class
+            rows = [jnp.full((1, 6), -1.0, jnp.float32)]
+        allr = jnp.concatenate(rows, axis=0)
+        order = jnp.argsort(-allr[:, 1])
+        k = allr.shape[0] if keep_top_k < 0 else min(int(keep_top_k),
+                                                     allr.shape[0])
+        top = allr[order[:k]]
+        cnts.append(jnp.sum((top[:, 1] > 0).astype(jnp.int32)))
+        outs.append(top)
+    return jnp.stack(outs), jnp.stack(cnts)
+
+
+@defop(differentiable=False)
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive RoI pooling (reference op `psroi_pool`,
+    `phi/kernels/gpu/psroi_pool_kernel.cu`): channel block (i, j) of
+    the output grid average-pools its own C/(k*k) input channels over
+    the (i, j) spatial bin."""
+    oh, ow = (output_size if isinstance(output_size, (list, tuple))
+              else (output_size, output_size))
+    x = jnp.asarray(x, jnp.float32)
+    rois = jnp.asarray(boxes, jnp.float32)
+    n, c, h, w = x.shape
+    out_c = c // (oh * ow)
+    nb = np.asarray(boxes_num).astype(np.int64)
+    batch_of = np.repeat(np.arange(nb.shape[0]), nb)
+
+    def pool_one(roi, img):
+        x1 = roi[0] * spatial_scale
+        y1 = roi[1] * spatial_scale
+        x2 = roi[2] * spatial_scale
+        y2 = roi[3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / ow, rh / oh
+        # mask-based average per bin: differentiable-free gather of the
+        # whole feature map with per-bin membership weights
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        out = []
+        for i in range(oh):
+            for j in range(ow):
+                hs = jnp.floor(y1 + i * bin_h)
+                he = jnp.ceil(y1 + (i + 1) * bin_h)
+                ws_ = jnp.floor(x1 + j * bin_w)
+                we = jnp.ceil(x1 + (j + 1) * bin_w)
+                mask = ((ys[:, None] >= hs) & (ys[:, None] < he)
+                        & (xs[None, :] >= ws_) & (xs[None, :] < we))
+                cnt = jnp.maximum(jnp.sum(mask), 1)
+                chans = img[(i * ow + j) * out_c:(i * ow + j + 1) * out_c]
+                out.append(jnp.sum(chans * mask[None], axis=(1, 2)) / cnt)
+        return jnp.stack(out, axis=0).reshape(oh, ow, out_c) \
+            .transpose(2, 0, 1)
+
+    return jnp.stack([pool_one(rois[r], x[batch_of[r]])
+                      for r in range(rois.shape[0])])
+
+
+@defop(differentiable=False)
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False):
+    """RPN proposal generation (reference op `generate_proposals`,
+    `phi/kernels/gpu/generate_proposals_kernel.cu`): decode anchor
+    deltas, clip to image, filter small boxes, NMS, keep top-N. Single
+    image ([1, ...] inputs); returns (rois [post_nms_top_n, 4],
+    roi_scores, count) padded with zeros."""
+    sc = jnp.asarray(scores, jnp.float32)[0]        # [A, H, W]
+    bd = jnp.asarray(bbox_deltas, jnp.float32)[0]   # [A*4, H, W]
+    a, h, w = sc.shape
+    anc = jnp.asarray(anchors, jnp.float32).reshape(-1, 4)
+    var = jnp.asarray(variances, jnp.float32).reshape(-1, 4)
+    s_flat = sc.transpose(1, 2, 0).reshape(-1)
+    d = bd.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+    off = 1.0 if pixel_offset else 0.0
+    aw = anc[:, 2] - anc[:, 0] + off
+    ah = anc[:, 3] - anc[:, 1] + off
+    acx = anc[:, 0] + aw / 2
+    acy = anc[:, 1] + ah / 2
+    cx = var[:, 0] * d[:, 0] * aw + acx
+    cy = var[:, 1] * d[:, 1] * ah + acy
+    bw = jnp.exp(jnp.minimum(var[:, 2] * d[:, 2], 10.0)) * aw
+    bh = jnp.exp(jnp.minimum(var[:, 3] * d[:, 3], 10.0)) * ah
+    props = jnp.stack([cx - bw / 2, cy - bh / 2,
+                       cx + bw / 2 - off, cy + bh / 2 - off], axis=1)
+    ih, iw = (jnp.asarray(img_size, jnp.float32).reshape(-1)[0],
+              jnp.asarray(img_size, jnp.float32).reshape(-1)[1])
+    props = jnp.stack([jnp.clip(props[:, 0], 0, iw - off),
+                       jnp.clip(props[:, 1], 0, ih - off),
+                       jnp.clip(props[:, 2], 0, iw - off),
+                       jnp.clip(props[:, 3], 0, ih - off)], axis=1)
+    pw = props[:, 2] - props[:, 0] + off
+    ph = props[:, 3] - props[:, 1] + off
+    ok = (pw >= min_size) & (ph >= min_size)
+    s_flat = jnp.where(ok, s_flat, -1e10)
+    top = min(int(pre_nms_top_n), s_flat.shape[0])
+    order = jnp.argsort(-s_flat)[:top]
+    props, s_top = props[order], s_flat[order]
+    keep = _nms_kept_mask(props, nms_thresh)
+    s_kept = jnp.where(keep & (s_top > -1e9), s_top, -1e10)
+    order2 = jnp.argsort(-s_kept)[:int(post_nms_top_n)]
+    rois = props[order2]
+    rs = s_kept[order2]
+    count = jnp.sum((rs > -1e9).astype(jnp.int32))
+    valid = (rs > -1e9)[:, None]
+    return jnp.where(valid, rois, 0.0), jnp.where(valid[:, 0], rs, 0.0), \
+        count
+
+
+@defop(differentiable=False)
+def multiclass_nms3(bboxes, scores, score_threshold=0.05, nms_top_k=-1,
+                    keep_top_k=100, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=-1, rois_num=None):
+    """Per-class greedy NMS + cross-class top-k (reference op
+    `multiclass_nms3`, `phi/kernels/funcs/detection/nms_util.h`).
+    bboxes [N, M, 4], scores [N, C, M]; returns ([N, keep_top_k, 6]
+    rows (class, score, box) padded with -1, kept counts [N])."""
+    b = jnp.asarray(bboxes, jnp.float32)
+    s = jnp.asarray(scores, jnp.float32)
+    n, c, m = s.shape
+    top_k = m if nms_top_k < 0 else min(int(nms_top_k), m)
+    outs, cnts = [], []
+    for bi in range(n):
+        rows = []
+        for ci in range(c):
+            if ci == background_label:
+                continue
+            sc = s[bi, ci]
+            order = jnp.argsort(-sc)[:top_k]
+            bs, ss = b[bi][order], sc[order]
+            keep = _nms_kept_mask(bs, nms_threshold) \
+                & (ss > score_threshold)
+            rows.append(jnp.concatenate(
+                [jnp.full((top_k, 1), ci, jnp.float32),
+                 jnp.where(keep, ss, -1.0)[:, None],
+                 jnp.where(keep[:, None], bs, -1.0)], axis=1))
+        if not rows:  # every class was the background class
+            rows = [jnp.full((1, 6), -1.0, jnp.float32)]
+        allr = jnp.concatenate(rows, axis=0)
+        order = jnp.argsort(-allr[:, 1])
+        k = allr.shape[0] if keep_top_k < 0 else min(int(keep_top_k),
+                                                     allr.shape[0])
+        top = allr[order[:k]]
+        cnts.append(jnp.sum((top[:, 1] > 0).astype(jnp.int32)))
+        outs.append(top)
+    return jnp.stack(outs), jnp.stack(cnts)
+
+
+@defop(differentiable=False)
+def read_file(filename):
+    """Read a file's bytes as a uint8 tensor (reference op
+    `read_file`)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return jnp.asarray(np.frombuffer(data, np.uint8))
+
+
+@defop(differentiable=False)
+def decode_jpeg(x, mode="unchanged"):
+    """Decode a JPEG byte tensor to CHW uint8 (reference op
+    `decode_jpeg`, `phi/kernels/gpu/decode_jpeg_kernel.cu` — nvjpeg
+    there; PIL on the host here, feeding the device pipeline)."""
+    import io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(x).astype(np.uint8).tobytes())
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)

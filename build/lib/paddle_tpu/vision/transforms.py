@@ -1,0 +1,297 @@
+"""Vision transforms (numpy host-side preprocessing).
+
+Reference: `python/paddle/vision/transforms/transforms.py`. These run on
+the host inside DataLoader workers; the device only ever sees the final
+batched array (one H2D per step).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "Transpose",
+           "RandomResizedCrop", "RandomVerticalFlip", "ColorJitter",
+           "Pad", "Grayscale", "RandomRotation", "RandomErasing"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor:
+    """HWC uint8/float -> CHW float32 scaled to [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        raw = np.asarray(img)
+        arr = raw.astype(np.float32)
+        if raw.dtype == np.uint8:
+            arr = arr / 255.0
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        if self.data_format == "CHW":
+            arr = np.transpose(arr, (2, 0, 1))
+        return arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+def _resize_nn(arr, size):
+    """Nearest-neighbor resize (no cv2/PIL dependency)."""
+    h, w = arr.shape[:2]
+    nh, nw = size
+    ri = (np.arange(nh) * h / nh).astype(np.int64)
+    ci = (np.arange(nw) * w / nw).astype(np.int64)
+    return arr[ri][:, ci]
+
+
+class Resize:
+    def __init__(self, size, interpolation="nearest"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        return _resize_nn(np.asarray(img), self.size)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            pad = [(self.padding, self.padding), (self.padding, self.padding)]
+            pad += [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pad)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+class RandomResizedCrop:
+    """Random area+aspect crop then resize (reference
+    `vision/transforms/transforms.py:RandomResizedCrop`). HWC arrays,
+    like the other transforms here."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round((target * ar) ** 0.5))
+            ch = int(round((target / ar) ** 0.5))
+            if 0 < cw <= w and 0 < ch <= h:
+                y = np.random.randint(0, h - ch + 1)
+                x = np.random.randint(0, w - cw + 1)
+                return _resize_nn(arr[y:y + ch, x:x + cw], self.size)
+        # fallback: center crop of the smaller side
+        s = min(h, w)
+        y, x = (h - s) // 2, (w - s) // 2
+        return _resize_nn(arr[y:y + s, x:x + s], self.size)
+
+
+class RandomVerticalFlip:
+    """Reference RandomVerticalFlip (HWC)."""
+
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if np.random.random() < self.prob:
+            return arr[::-1].copy()
+        return arr
+
+
+class ColorJitter:
+    """Brightness/contrast jitter on HWC float arrays (reference
+    ColorJitter; hue/saturation need HSV — brightness/contrast cover the
+    common training recipes)."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0):
+        if saturation or hue:
+            raise NotImplementedError(
+                "saturation/hue jitter not supported (needs HSV space); "
+                "use brightness/contrast")
+        self.brightness = brightness
+        self.contrast = contrast
+
+    def __call__(self, img):
+        out = np.asarray(img)
+        if self.brightness:
+            f = np.random.uniform(max(0, 1 - self.brightness),
+                                  1 + self.brightness)
+            out = out * f
+        if self.contrast:
+            f = np.random.uniform(max(0, 1 - self.contrast),
+                                  1 + self.contrast)
+            out = (out - out.mean()) * f + out.mean()
+        return out
+
+
+class Pad:
+    """Constant-pad H and W of an HWC array (reference transforms.Pad)."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding, padding, padding, padding)  # l, t, r, b
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        pad = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+        if self.padding_mode == "constant":
+            return np.pad(arr, pad, constant_values=self.fill)
+        return np.pad(arr, pad, mode=self.padding_mode)
+
+
+class Grayscale:
+    """ITU-R 601-2 luma transform on HWC RGB (reference
+    transforms.Grayscale); num_output_channels 1 or 3."""
+
+    def __init__(self, num_output_channels=1):
+        if num_output_channels not in (1, 3):
+            raise ValueError("num_output_channels must be 1 or 3")
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                + 0.114 * arr[..., 2])[..., None]
+        if self.num_output_channels == 3:
+            gray = np.repeat(gray, 3, axis=-1)
+        return gray.astype(arr.dtype)
+
+
+class RandomRotation:
+    """Rotate by a uniform random angle (reference
+    transforms.RandomRotation); nearest-neighbor resample around the
+    image center, out-of-frame pixels filled with ``fill``."""
+
+    def __init__(self, degrees, fill=0):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        angle = np.random.uniform(*self.degrees) * np.pi / 180.0
+        h, w = arr.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float64)
+        c, s = np.cos(angle), np.sin(angle)
+        # inverse map: output pixel pulls from rotated source coordinate
+        sy = cy + (yy - cy) * c - (xx - cx) * s
+        sx = cx + (yy - cy) * s + (xx - cx) * c
+        syi = np.round(sy).astype(np.int64)
+        sxi = np.round(sx).astype(np.int64)
+        valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+        out = np.full_like(arr, self.fill)
+        out[valid] = arr[syi[valid], sxi[valid]]
+        return out
+
+
+class RandomErasing:
+    """Erase a random rectangle (reference transforms.RandomErasing):
+    area in ``scale`` x image, aspect in ``ratio``; value 0 or 'random'."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img).copy()
+        if np.random.random() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round((target / ar) ** 0.5))
+            ew = int(round((target * ar) ** 0.5))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                y = np.random.randint(0, h - eh + 1)
+                x = np.random.randint(0, w - ew + 1)
+                if self.value == "random":
+                    arr[y:y + eh, x:x + ew] = np.random.rand(
+                        eh, ew, *arr.shape[2:]).astype(arr.dtype)
+                else:
+                    arr[y:y + eh, x:x + ew] = self.value
+                return arr
+        return arr
