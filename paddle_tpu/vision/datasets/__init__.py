@@ -2,5 +2,8 @@
 
 from .mnist import MNIST, FashionMNIST  # noqa: F401
 from .cifar import Cifar10, Cifar100  # noqa: F401
+from .folder import DatasetFolder, ImageFolder  # noqa: F401
+from .flowers_voc import Flowers, VOC2012  # noqa: F401
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]

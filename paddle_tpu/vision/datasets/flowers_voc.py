@@ -1,0 +1,131 @@
+"""Flowers-102 and VOC2012 datasets (reference:
+`python/paddle/vision/datasets/flowers.py`, `voc2012.py`).
+
+Real archives are parsed when their files are given (this build has
+zero egress, so nothing downloads); without them each dataset falls
+back to a deterministic synthetic task with the same shapes and label
+spaces, clearly labeled as synthetic.
+"""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["Flowers", "VOC2012"]
+
+
+class Flowers(Dataset):
+    """102-category flowers (reference flowers.py): jpegs in a tgz,
+    labels + split ids in MATLAB files."""
+
+    num_classes = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, backend="cv2"):
+        if mode not in ("train", "valid", "test"):
+            raise ValueError(f"bad mode {mode!r}")
+        self.mode = mode
+        self.transform = transform
+        self.synthetic = data_file is None
+        if self.synthetic:
+            rng = np.random.RandomState(
+                {"train": 1, "valid": 2, "test": 3}[mode])
+            n = {"train": 204, "valid": 102, "test": 102}[mode]
+            self._labels = rng.randint(0, self.num_classes, (n,))
+            self._imgs = None
+            self._rng_seed = int(rng.randint(1 << 30))
+            return
+        import scipy.io as sio
+
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self._ids = setid[key].reshape(-1)          # 1-based image ids
+        labels = sio.loadmat(label_file)["labels"].reshape(-1)
+        self._labels = labels[self._ids - 1] - 1    # 0-based classes
+        self._tar = tarfile.open(data_file)
+        self._members = {m.name.split("/")[-1]: m
+                         for m in self._tar.getmembers()
+                         if m.name.endswith(".jpg")}
+
+    def __getitem__(self, idx):
+        if self.synthetic:
+            c = int(self._labels[idx])
+            rng = np.random.RandomState(self._rng_seed + idx)
+            img = np.full((64, 64, 3), c * 2, np.uint8) \
+                + rng.randint(0, 20, (64, 64, 3)).astype(np.uint8)
+        else:
+            from PIL import Image
+
+            name = f"image_{int(self._ids[idx]):05d}.jpg"
+            f = self._tar.extractfile(self._members[name])
+            img = np.asarray(Image.open(io.BytesIO(f.read()))
+                             .convert("RGB"))
+        label = int(self._labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([label], np.int64)
+
+    def __len__(self):
+        return len(self._labels)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation (reference voc2012.py): (image,
+    mask) pairs from the devkit tar; 21 classes (incl background)."""
+
+    num_classes = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend="cv2"):
+        if mode not in ("train", "valid", "trainval"):
+            raise ValueError(f"bad mode {mode!r}")
+        self.mode = mode
+        self.transform = transform
+        self.synthetic = data_file is None
+        if self.synthetic:
+            rng = np.random.RandomState({"train": 5, "valid": 6,
+                                         "trainval": 7}[mode])
+            self._n = {"train": 40, "valid": 20, "trainval": 60}[mode]
+            self._rng_seed = int(rng.randint(1 << 30))
+            return
+        self._tar = tarfile.open(data_file)
+        names = {m.name: m for m in self._tar.getmembers()}
+        split = {"train": "train.txt", "valid": "val.txt",
+                 "trainval": "trainval.txt"}[mode]
+        seg_dir = "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+        ids = self._tar.extractfile(names[seg_dir + split]) \
+            .read().decode().split()
+        self._ids = ids
+        self._names = names
+
+    def __getitem__(self, idx):
+        if self.synthetic:
+            rng = np.random.RandomState(self._rng_seed + idx)
+            img = rng.randint(0, 255, (64, 64, 3)).astype(np.uint8)
+            mask = np.zeros((64, 64), np.uint8)
+            c = rng.randint(1, self.num_classes)
+            x0, y0 = rng.randint(0, 32, 2)
+            mask[y0:y0 + 24, x0:x0 + 24] = c
+            if self.transform is not None:
+                img = self.transform(img)
+            return img, mask
+        from PIL import Image
+
+        vid = self._ids[idx]
+        base = "VOCdevkit/VOC2012/"
+        img = np.asarray(Image.open(io.BytesIO(self._tar.extractfile(
+            self._names[base + f"JPEGImages/{vid}.jpg"]).read()))
+            .convert("RGB"))
+        mask = np.asarray(Image.open(io.BytesIO(self._tar.extractfile(
+            self._names[base + f"SegmentationClass/{vid}.png"]).read())))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return self._n if self.synthetic else len(self._ids)

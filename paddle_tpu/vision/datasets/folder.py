@@ -1,0 +1,122 @@
+"""Folder datasets (reference: `python/paddle/vision/datasets/folder.py:107`
+``DatasetFolder`` / ``ImageFolder``).
+
+A directory tree of ``root/class_x/img.ext`` becomes a labeled dataset;
+``ImageFolder`` is the unlabeled flat variant. Loading is PIL on the
+host (the device pipeline starts at the DataLoader's numpy batches).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def default_loader(path):
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        img = Image.open(f)
+        return img.convert("RGB")
+
+
+def has_valid_extension(filename, extensions):
+    return filename.lower().endswith(tuple(extensions))
+
+
+def make_dataset(directory, class_to_idx, extensions=None,
+                 is_valid_file=None):
+    """(path, class_index) samples from a class-per-subdir tree
+    (reference folder.py:make_dataset)."""
+    if (extensions is None) == (is_valid_file is None):
+        raise ValueError(
+            "pass exactly one of extensions / is_valid_file")
+    if is_valid_file is None:
+        def is_valid_file(p):
+            return has_valid_extension(p, extensions)
+    samples = []
+    for cls in sorted(class_to_idx):
+        d = os.path.join(directory, cls)
+        if not os.path.isdir(d):
+            continue
+        for root, _, fnames in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[cls]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """``root/<class>/<image>`` tree -> (image, label) dataset
+    (reference folder.py:107)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = make_dataset(root, self.class_to_idx, extensions,
+                                    is_valid_file)
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+        self.targets = [s[1] for s in self.samples]
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat recursive image list, no labels (reference folder.py
+    ``ImageFolder``)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return has_valid_extension(p, extensions)
+        samples = []
+        for r, _, fnames in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(r, fname)
+                if is_valid_file(path):
+                    samples.append(path)
+        if not samples:
+            raise RuntimeError(f"no valid files under {root}")
+        self.samples = samples
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
