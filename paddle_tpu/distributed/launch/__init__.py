@@ -28,7 +28,7 @@ import subprocess
 import sys
 import time
 
-__all__ = ["launch", "main"]
+__all__ = ["launch", "launch_elastic", "main"]
 
 
 def launch(script_args, nnodes=1, node_rank=0, nproc_per_node=1,
@@ -86,6 +86,117 @@ def launch(script_args, nnodes=1, node_rank=0, nproc_per_node=1,
             log.close()
 
 
+def launch_elastic(script_args, nproc_per_node=2, max_restarts=3,
+                   min_nproc=1, master=None, log_dir="log",
+                   env_extra=None, store_dir=None):
+    """Elastic supervisor: the loop the reference closes in
+    `fleet/elastic/manager.py:594` (watch membership -> on scale event,
+    tear down, relaunch, resume from checkpoint).
+
+    Each round spawns ``nproc`` workers registered in a
+    :class:`~paddle_tpu.distributed.watchdog.FileStore`; a worker death
+    deregisters it and the round's :class:`ElasticManager` reports
+    ``scale_down``, at which point the survivors are torn down and the
+    world relaunches with ``PADDLE_RESTART_COUNT`` bumped — the training
+    script resumes from its last checkpoint (`distributed.checkpoint` /
+    ``paddle.save``). After a failed retry at the same size the world
+    shrinks by one (elastic scale-down) until ``min_nproc``.
+
+    Returns the final exit code (0 once a round completes cleanly).
+    """
+    import tempfile
+
+    from ..watchdog import ElasticManager, FileStore
+
+    store_dir = store_dir or tempfile.mkdtemp(prefix="elastic_store_")
+    restarts = 0
+    nproc = int(nproc_per_node)
+    while True:
+        code = _elastic_round(script_args, nproc, master, log_dir,
+                              dict(env_extra or {}), restarts, store_dir,
+                              ElasticManager, FileStore)
+        if code == 0:
+            return 0
+        restarts += 1
+        if restarts > max_restarts:
+            return code
+        if restarts > 1 and nproc > min_nproc:
+            nproc -= 1          # repeated failure: shrink the world
+
+
+def _elastic_round(script_args, nproc, master, log_dir, env_extra,
+                   restarts, store_dir, ElasticManager, FileStore):
+    """One supervised generation: spawn, watch membership, tear down on
+    the first scale event."""
+    world = nproc
+    if world > 1 and master is None:
+        master = "127.0.0.1:23459"
+    os.makedirs(log_dir, exist_ok=True)
+    store = FileStore(store_dir)
+    for h in store.hosts():        # a fresh generation starts empty
+        store.deregister(h)
+    manager = ElasticManager(store, host_id="supervisor",
+                             expected_hosts=world)
+    procs, logs = [], []
+    try:
+        for rank in range(world):
+            env = dict(os.environ)
+            env.update(env_extra)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(rank),
+                "PADDLE_NNODES": "1",
+                "PADDLE_RESTART_COUNT": str(restarts),
+                "PADDLE_ELASTIC": "1",
+                "FLAGS_selected_devices": str(rank),
+            })
+            if master:
+                env["PADDLE_MASTER"] = master
+            log = open(os.path.join(log_dir,
+                                    f"workerlog.{restarts}.{rank}"), "w")
+            logs.append(log)
+            store.register(str(rank))
+            procs.append(subprocess.Popen(
+                [sys.executable] + list(script_args),
+                env=env, stdout=log, stderr=subprocess.STDOUT))
+        exit_code = 0
+        pending = set(range(world))
+        while pending:
+            for i in sorted(pending):
+                ret = procs[i].poll()
+                if ret is None:
+                    continue
+                pending.discard(i)
+                store.deregister(str(i))
+                if ret != 0 and exit_code == 0:
+                    exit_code = ret
+            if exit_code and manager.watch_once() == "scale_down":
+                # membership shrank below the expected world: tear down
+                # the generation (reference manager.py:594 behavior).
+                # Survivors may be parked in a blocking collective that
+                # shrugs off SIGTERM — escalate to SIGKILL after a grace
+                # period.
+                for j in pending:
+                    procs[j].send_signal(signal.SIGTERM)
+                for j in pending:
+                    try:
+                        procs[j].wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        procs[j].kill()
+                        procs[j].wait(timeout=30)
+                    store.deregister(str(j))
+                pending.clear()
+            time.sleep(0.2)
+        return exit_code
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+
+
 def main(argv=None):
     import argparse
     ap = argparse.ArgumentParser(
@@ -99,13 +210,25 @@ def main(argv=None):
                          "one process, the TPU default)")
     ap.add_argument("--master", default=os.environ.get("PADDLE_MASTER"))
     ap.add_argument("--log_dir", default="log")
+    ap.add_argument("--elastic", action="store_true",
+                    help="supervise with restart-on-failure + scale-down "
+                         "(reference fleet/elastic)")
+    ap.add_argument("--max_restarts", type=int, default=3)
+    ap.add_argument("--min_nproc", type=int, default=1)
     ap.add_argument("script", nargs=argparse.REMAINDER,
                     help="training script and its arguments")
     args = ap.parse_args(argv)
     if not args.script:
         ap.error("no training script given")
-    code = launch(args.script, nnodes=args.nnodes,
-                  node_rank=args.node_rank,
-                  nproc_per_node=args.nproc_per_node, master=args.master,
-                  log_dir=args.log_dir)
+    if args.elastic:
+        code = launch_elastic(args.script,
+                              nproc_per_node=args.nproc_per_node,
+                              max_restarts=args.max_restarts,
+                              min_nproc=args.min_nproc,
+                              master=args.master, log_dir=args.log_dir)
+    else:
+        code = launch(args.script, nnodes=args.nnodes,
+                      node_rank=args.node_rank,
+                      nproc_per_node=args.nproc_per_node,
+                      master=args.master, log_dir=args.log_dir)
     sys.exit(code)
