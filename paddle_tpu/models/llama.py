@@ -51,6 +51,10 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     initializer_range: float = 0.02
+    #: checkpoint each decoder layer (training fwd): activations
+    #: recompute in the backward sweep, trading ~1 extra forward for
+    #: O(L) -> O(1) layer-activation memory (bigger batch/seq fits)
+    recompute: bool = False
 
     @property
     def head_dim(self):
@@ -258,11 +262,16 @@ class LlamaModel(nn.Layer):
                     + cache_len.astype("int64")
             # identical for every layer — build once, not per layer
             attn_mask = _decode_mask(cache_len, s, caches[0][0].shape[1])
+        use_remat = self.config.recompute and caches is None \
+            and not x.stop_gradient
         for i, layer in enumerate(self.layers):
             if caches is not None:
                 x, c = layer(x, position_ids, caches[i], cache_len,
                              attn_mask)
                 new_caches.append(c)
+            elif use_remat:
+                from ..distributed.recompute import recompute
+                x = recompute(layer, x, position_ids)
             else:
                 x = layer(x, position_ids)
         x = self.norm(x)

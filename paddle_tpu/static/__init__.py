@@ -43,12 +43,31 @@ class InputSpec:
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          **kwargs):
-    """Reference `static/io.py:save_inference_model`; delegates to
-    jit.save on the traced program."""
-    raise NotImplementedError(
-        "save_inference_model requires a legacy Program; use "
-        "paddle_tpu.jit.save(layer, path, input_spec=[...]) — the "
-        "TPU-native export path (StableHLO)")
+    """Reference `static/io.py:save_inference_model`, mapped to the
+    traced-program world: there is no Program object, so ``fetch_vars``
+    is the model itself (an ``nn.Layer`` or callable) and ``feed_vars``
+    its input specs (InputSpec / example Tensors). Delegates to
+    ``jit.save`` — StableHLO + params — which ``load_inference_model``
+    (and the inference ``Predictor``) loads back."""
+    from ..jit import save as jit_save
+    from ..nn import Layer
+
+    model = fetch_vars
+    if isinstance(model, (list, tuple)):
+        if len(model) != 1:
+            raise ValueError(
+                "save_inference_model expects ONE model (nn.Layer or "
+                "callable) as fetch_vars — traced programs replace the "
+                "reference's fetch-variable lists")
+        model = model[0]
+    if not (isinstance(model, Layer) or callable(model)):
+        raise TypeError(
+            "fetch_vars must be the nn.Layer (or callable) to export; "
+            f"got {type(model).__name__}")
+    specs = feed_vars
+    if specs is not None and not isinstance(specs, (list, tuple)):
+        specs = [specs]
+    return jit_save(model, path_prefix, input_spec=specs)
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):

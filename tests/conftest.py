@@ -25,3 +25,22 @@ def _seed():
     paddle.seed(1234)
     np.random.seed(1234)
     yield
+
+
+# -- fast/slow split (VERDICT r4 weak #9): the compile-heavy modules
+#    dominate the 20-minute full run; `pytest -m "not slow"` is the
+#    iteration loop, the full suite stays the CI gate -------------------
+_SLOW_MODULES = {
+    "test_llama", "test_bert", "test_pipeline", "test_serving",
+    "test_moe", "test_ring_attention", "test_launch", "test_hapi",
+    "test_vision_models", "test_jit", "test_jit_save", "test_rpc_misc",
+    "test_ps", "test_checkpoint_dist", "test_amp", "test_fleet",
+    "test_distributed", "test_autotune",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        if mod in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)

