@@ -19,7 +19,8 @@ from ..framework.tensor import Tensor, run_op
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "SparseCsrTensor", "matmul", "add", "multiply", "relu", "abs",
-           "sin", "tanh", "sqrt", "pow", "neg", "is_same_shape"]
+           "sin", "tanh", "sqrt", "pow", "neg", "is_same_shape",
+           "masked_matmul", "nn"]
 
 
 def _values_in(x):
@@ -221,3 +222,30 @@ def pow(x, factor):
     vals = run_op("sparse_pow", lambda v: jnp.power(v, factor),
                   (x._values,))
     return _rewrap(x, vals)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """SDDMM (reference `sparse/matmul.py:masked_matmul`,
+    `phi/kernels/sparse/gpu/matmul_kernel.cu`): dense @ dense evaluated
+    ONLY at ``mask``'s stored coordinates; returns a sparse tensor with
+    mask's pattern. Grads flow to both dense operands."""
+    if isinstance(mask, SparseCooTensor):
+        rows, cols = (np.asarray(mask._indices)[-2],
+                      np.asarray(mask._indices)[-1])
+    else:
+        indptr = np.asarray(mask._indptr)
+        counts = np.diff(indptr)
+        rows = np.repeat(np.arange(len(counts)), counts)
+        cols = np.asarray(mask._cols)
+
+    def fn(a, b):
+        # value n = a[.., rows[n], :] . b[.., :, cols[n]]
+        ar = jnp.take(a, jnp.asarray(rows), axis=-2)
+        bc = jnp.take(b, jnp.asarray(cols), axis=-1)
+        return jnp.einsum("...nd,...dn->...n", ar, bc)
+
+    vals = run_op("sparse_masked_matmul", fn, (x, y))
+    return _rewrap(mask, vals)
+
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,60 @@
+"""``paddle.sparse.nn`` — sparse layers (reference `python/paddle/sparse/nn`)."""
+
+from . import functional  # noqa: F401
+from .functional import attention  # noqa: F401
+
+from ...framework.tensor import Parameter
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Conv3D", "SubmConv3D", "ReLU", "functional", "attention"]
+
+
+class _ConvBase:
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, subm=False):
+        from ...framework import random as frandom
+
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * 3
+        self._cfg = dict(stride=stride, padding=padding)
+        self._subm = subm
+        fan_in = in_channels * int(jnp.prod(jnp.asarray(k)))
+        self.weight = Parameter(jax.random.normal(
+            frandom.next_key(), tuple(k) + (in_channels, out_channels),
+            jnp.float32) * (1.0 / fan_in ** 0.5))
+        self.bias = Parameter(jnp.zeros((out_channels,), jnp.float32))
+
+    def parameters(self):
+        return [self.weight, self.bias]
+
+    def __call__(self, x):
+        fn = functional.subm_conv3d if self._subm else functional.conv3d
+        return fn(x, self.weight, self.bias, **self._cfg)
+
+
+class Conv3D(_ConvBase):
+    """Standard sparse conv3d (reference sparse/nn/layer/conv.py)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, subm=False)
+
+
+class SubmConv3D(_ConvBase):
+    """Submanifold sparse conv3d: output pattern == input pattern."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, subm=True)
+
+
+class ReLU:
+    def __call__(self, x):
+        from .. import relu
+        return relu(x)
+
+    def parameters(self):
+        return []

@@ -1,0 +1,158 @@
+"""``paddle.sparse.nn.functional`` — sparse conv3d / attention.
+
+Reference: `python/paddle/sparse/nn/functional/{conv.py, attention.py}`
+with CUDA kernels `phi/kernels/sparse/gpu/conv_kernel.cu` (gather-gemm-
+scatter) and `fused_attention_kernel.cu`.
+
+TPU-native design:
+- **subm_conv3d** (submanifold: output pattern == input pattern, the
+  backbone of sparse 3-D CNNs): the coordinate hash-map the CUDA kernel
+  builds on device is HOST bookkeeping here (indices are concrete in
+  eager mode); per kernel offset the neighbor pairs become one gather +
+  matmul + scatter-add — the gather-gemm-scatter scheme with the gemm
+  on the MXU.
+- **conv3d** (standard, pattern grows): densify -> `lax.conv` ->
+  re-sparsify. On TPU the MXU conv beats gather-scatter for the
+  occupancies where a dense intermediate fits; the sparse format is
+  kept at the API boundary.
+- **attention**: per-query softmax restricted to a sparse [S, S] mask
+  pattern via segment ops over the mask's stored coordinates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import run_op
+from .. import SparseCooTensor
+
+__all__ = ["conv3d", "subm_conv3d", "attention"]
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (int(v),) * 3
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, name=None):
+    """Submanifold sparse conv: x SparseCooTensor [N, D, H, W, C]
+    (dense channel dim), weight [kd, kh, kw, C_in, C_out]. Output keeps
+    x's coordinate pattern (stride must be 1 — the submanifold
+    definition)."""
+    if _triple(stride) != (1, 1, 1):
+        raise ValueError("subm_conv3d requires stride 1")
+    idx = np.asarray(x._indices)              # [4, nnz]: n, d, h, w
+    nnz = idx.shape[1]
+    wshape = weight.shape
+    kd, kh, kw = int(wshape[0]), int(wshape[1]), int(wshape[2])
+    # host-side coordinate hash: site -> row
+    site_of = {tuple(idx[:, i]): i for i in range(nnz)}
+    gathers = []                              # (offset_flat, in_rows, out_rows)
+    for oz in range(kd):
+        for oy in range(kh):
+            for ox in range(kw):
+                dz, dy, dx = oz - kd // 2, oy - kh // 2, ox - kw // 2
+                ins, outs = [], []
+                for i in range(nnz):
+                    n, d, h, w = idx[:, i]
+                    j = site_of.get((n, d + dz, h + dy, w + dx))
+                    if j is not None:
+                        ins.append(j)
+                        outs.append(i)
+                if ins:
+                    gathers.append(((oz, oy, ox),
+                                    np.asarray(ins, np.int32),
+                                    np.asarray(outs, np.int32)))
+
+    def fn(vals, w, b):
+        out = jnp.zeros((nnz, w.shape[-1]), vals.dtype)
+        for (oz, oy, ox), ins, outs in gathers:
+            contrib = vals[ins] @ w[oz, oy, ox]
+            out = out.at[outs].add(contrib)
+        if b is not None:
+            out = out + b
+        return out
+
+    args = (x._values, weight) + ((bias,) if bias is not None else ())
+    if bias is not None:
+        vals = run_op("sparse_subm_conv3d", fn, args)
+    else:
+        vals = run_op("sparse_subm_conv3d",
+                      lambda v, w: fn(v, w, None), args)
+    out_shape = tuple(x._mat.shape[:-1]) + (int(wshape[-1]),)
+    return SparseCooTensor(x._indices, vals, out_shape)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, name=None):
+    """Standard sparse conv3d (output pattern grows with the receptive
+    field): densify, run the MXU conv, re-sparsify the result."""
+    st = _triple(stride)
+    pd = _triple(padding)
+    dense = x.to_dense()                      # [N, D, H, W, C]
+
+    def fn(dense, w, b):
+        out = jax.lax.conv_general_dilated(
+            dense, w, window_strides=st,
+            padding=[(p, p) for p in pd],
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if b is not None:
+            out = out + b
+        return out
+
+    args = (dense, weight) + ((bias,) if bias is not None else ())
+    if bias is not None:
+        out = run_op("sparse_conv3d", fn, args)
+    else:
+        out = run_op("sparse_conv3d", lambda d, w: fn(d, w, None), args)
+    # re-sparsify: pattern from the concrete result (eager op, like the
+    # reference kernel whose output nnz is data-dependent)
+    arr = np.asarray(out._data)
+    mask = np.abs(arr).sum(-1) > 0
+    coords = np.stack(np.nonzero(mask))       # [4, nnz_out]
+    from ...tensor import manipulation  # noqa: F401  (tape gather below)
+    rows = out[tuple(jnp.asarray(c) for c in coords)]
+    return SparseCooTensor(jnp.asarray(coords), rows, arr.shape)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-pattern attention (reference
+    `sparse/nn/functional/attention.py`): q/k/v [B, H, S, D]; the [S, S]
+    sparse ``sparse_mask`` names which (query, key) pairs participate;
+    softmax is per query row over its stored keys only."""
+    if isinstance(sparse_mask, SparseCooTensor):
+        rows = np.asarray(sparse_mask._indices)[-2]
+        cols = np.asarray(sparse_mask._indices)[-1]
+    else:
+        indptr = np.asarray(sparse_mask._indptr)
+        counts = np.diff(indptr)
+        rows = np.repeat(np.arange(len(counts)), counts)
+        cols = np.asarray(sparse_mask._cols)
+    s_len = int(sparse_mask.shape[-2])
+    rows_j = jnp.asarray(rows, jnp.int32)
+    cols_j = jnp.asarray(cols, jnp.int32)
+
+    def fn(q, k, v):
+        d = q.shape[-1]
+        qs = jnp.take(q, rows_j, axis=2)      # [B, H, nnz, D]
+        ks = jnp.take(k, cols_j, axis=2)
+        scores = jnp.einsum("bhnd,bhnd->bhn", qs, ks) / jnp.sqrt(
+            jnp.asarray(d, jnp.float32))
+        # segment softmax per query row
+        smax = jax.ops.segment_max(jnp.moveaxis(scores, -1, 0), rows_j,
+                                   num_segments=s_len)  # [S, B, H]
+        smax = jnp.moveaxis(smax, 0, -1)
+        p = jnp.exp(scores - jnp.take(smax, rows_j, axis=-1))
+        denom = jax.ops.segment_sum(jnp.moveaxis(p, -1, 0), rows_j,
+                                    num_segments=s_len)
+        denom = jnp.moveaxis(denom, 0, -1)
+        p = p / jnp.maximum(jnp.take(denom, rows_j, axis=-1), 1e-20)
+        vs = jnp.take(v, cols_j, axis=2)      # [B, H, nnz, D]
+        contrib = p[..., None] * vs
+        out = jax.ops.segment_sum(jnp.moveaxis(contrib, 2, 0), rows_j,
+                                  num_segments=s_len)  # [S, B, H, D]
+        return jnp.moveaxis(out, 0, 2)
+
+    return run_op("sparse_attention", fn, (query, key, value))

@@ -219,3 +219,144 @@ class TestReviewRegressions:
                          mesh=ProcessMesh(np.arange(8), dim_names=["dp"]))
         loss = paddle.to_tensor(np.float32(3.0))
         assert float(m.scale_loss(loss)) == 3.0
+
+
+class TestSparseConvAttention:
+    """VERDICT r4 missing #4: sparse conv3d, sparse attention,
+    masked_matmul over BCOO — oracle is torch/dense math."""
+
+    def _points(self):
+        rng = np.random.RandomState(0)
+        N, D, H, W, C, CO = 1, 5, 5, 5, 3, 4
+        dense_x = np.zeros((N, D, H, W, C), np.float32)
+        pts = [(0, 1, 1, 1), (0, 2, 2, 2), (0, 2, 3, 2), (0, 4, 4, 4)]
+        for p in pts:
+            dense_x[p] = rng.randn(C)
+        coords = np.array(pts).T
+        vals = np.stack([dense_x[p] for p in pts])
+        wgt = rng.randn(3, 3, 3, C, CO).astype(np.float32)
+        b = rng.randn(CO).astype(np.float32)
+        return dense_x, pts, coords, vals, wgt, b
+
+    def _torch_ref(self, dense_x, wgt, b):
+        import torch
+
+        tx = torch.tensor(dense_x.transpose(0, 4, 1, 2, 3))
+        tw = torch.tensor(wgt.transpose(4, 3, 0, 1, 2))
+        ref = torch.nn.functional.conv3d(tx, tw, torch.tensor(b),
+                                         padding=1).numpy()
+        return ref.transpose(0, 2, 3, 4, 1)
+
+    def test_subm_conv3d_matches_dense_at_sites(self):
+        import paddle_tpu.sparse as sparse
+        import paddle_tpu.sparse.nn.functional as SF
+
+        dense_x, pts, coords, vals, wgt, b = self._points()
+        xs = sparse.sparse_coo_tensor(coords, vals, dense_x.shape)
+        out = SF.subm_conv3d(xs, paddle.to_tensor(wgt),
+                             paddle.to_tensor(b))
+        ref = self._torch_ref(dense_x, wgt, b)
+        got = out.to_dense().numpy()
+        for p in pts:
+            np.testing.assert_allclose(got[p], ref[p], atol=1e-4)
+        # submanifold: pattern preserved
+        assert out.indices().numpy().shape[1] == len(pts)
+
+    def test_conv3d_matches_dense_everywhere(self):
+        import paddle_tpu.sparse as sparse
+        import paddle_tpu.sparse.nn.functional as SF
+
+        dense_x, pts, coords, vals, wgt, b = self._points()
+        xs = sparse.sparse_coo_tensor(coords, vals, dense_x.shape)
+        out = SF.conv3d(xs, paddle.to_tensor(wgt), paddle.to_tensor(b),
+                        padding=1)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   self._torch_ref(dense_x, wgt, b),
+                                   atol=1e-4)
+
+    def test_subm_conv3d_grads(self):
+        import paddle_tpu.sparse as sparse
+        import paddle_tpu.sparse.nn.functional as SF
+
+        dense_x, pts, coords, vals, wgt, _ = self._points()
+        wv = paddle.to_tensor(wgt, stop_gradient=False)
+        xs = sparse.sparse_coo_tensor(coords, vals, dense_x.shape)
+        xs._values.stop_gradient = False
+        SF.subm_conv3d(xs, wv).values().sum().backward()
+        assert wv.grad is not None
+        assert xs._values.grad is not None
+
+    def test_sparse_layers(self):
+        import paddle_tpu.sparse as sparse
+
+        dense_x, pts, coords, vals, wgt, b = self._points()
+        xs = sparse.sparse_coo_tensor(coords, vals, dense_x.shape)
+        layer = sparse.nn.SubmConv3D(3, 4, 3)
+        out = sparse.nn.ReLU()(layer(xs))
+        assert out.values().numpy().min() >= 0
+        conv = sparse.nn.Conv3D(3, 4, 3, padding=1)
+        assert list(conv(xs).shape)[-1] == 4
+
+    def test_masked_matmul(self):
+        import paddle_tpu.sparse as sparse
+
+        rng = np.random.RandomState(0)
+        A = rng.randn(4, 5).astype(np.float32)
+        B = rng.randn(5, 4).astype(np.float32)
+        idx = np.array([[0, 1, 2, 3], [1, 0, 3, 2]])
+        mask = sparse.sparse_coo_tensor(idx, np.ones(4, np.float32),
+                                        (4, 4))
+        out = sparse.masked_matmul(paddle.to_tensor(A),
+                                   paddle.to_tensor(B), mask)
+        dense = out.to_dense().numpy()
+        full = A @ B
+        for r, c in zip(*idx):
+            np.testing.assert_allclose(dense[r, c], full[r, c],
+                                       atol=1e-5)
+        # off-pattern entries stay zero
+        offp = dense.copy()
+        offp[idx[0], idx[1]] = 0
+        assert np.abs(offp).max() == 0
+
+    def test_sparse_attention_vs_masked_dense(self):
+        import paddle_tpu.sparse as sparse
+        import paddle_tpu.sparse.nn.functional as SF
+
+        rng = np.random.RandomState(0)
+        B_, Hh, S, Dd = 2, 2, 6, 8
+        q = rng.randn(B_, Hh, S, Dd).astype(np.float32)
+        k = rng.randn(B_, Hh, S, Dd).astype(np.float32)
+        v = rng.randn(B_, Hh, S, Dd).astype(np.float32)
+        mrows, mcols = [], []
+        mdense = np.zeros((S, S), bool)
+        for r in range(S):
+            for c in range(r + 1):
+                mrows.append(r)
+                mcols.append(c)
+                mdense[r, c] = True
+        smask = sparse.sparse_coo_tensor(
+            np.array([mrows, mcols]), np.ones(len(mrows), np.float32),
+            (S, S))
+        out = SF.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                           paddle.to_tensor(v), smask)
+        sc = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(Dd)
+        sc = np.where(mdense, sc, -1e30)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(out.numpy(), want, atol=2e-5)
+
+    def test_sparse_attention_grads(self):
+        import paddle_tpu.sparse as sparse
+        import paddle_tpu.sparse.nn.functional as SF
+
+        rng = np.random.RandomState(1)
+        q = paddle.to_tensor(rng.randn(1, 1, 4, 8).astype(np.float32),
+                             stop_gradient=False)
+        k = paddle.to_tensor(rng.randn(1, 1, 4, 8).astype(np.float32))
+        v = paddle.to_tensor(rng.randn(1, 1, 4, 8).astype(np.float32))
+        idx = np.array([[0, 1, 2, 3, 3], [0, 1, 2, 2, 3]])
+        smask = sparse.sparse_coo_tensor(idx, np.ones(5, np.float32),
+                                         (4, 4))
+        SF.attention(q, k, v, smask).sum().backward()
+        assert q.grad is not None
