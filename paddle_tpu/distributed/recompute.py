@@ -21,7 +21,7 @@ __all__ = ["recompute"]
 
 
 def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
-              **kwargs):
+              policy=None, **kwargs):
     """Run ``function(*args, **kwargs)`` with activation checkpointing.
 
     ``function`` may be an ``nn.Layer`` (its parameters keep gradient
@@ -68,5 +68,9 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
             for p, (d, nd) in zip(params, saved):
                 p._data, p._node = d, nd
 
-    ckpt = jax.checkpoint(pure)
+    if policy == "dots":
+        # save matmul outputs, recompute the cheap elementwise chain —
+        # near-zero extra FLOPs, still sheds the big activation tails
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    ckpt = jax.checkpoint(pure, policy=policy)
     return run_op("recompute", ckpt, tuple(tensor_args) + tuple(params))

@@ -271,7 +271,8 @@ class LlamaModel(nn.Layer):
                 new_caches.append(c)
             elif use_remat:
                 from ..distributed.recompute import recompute
-                x = recompute(layer, x, position_ids)
+                pol = "dots" if self.config.recompute == "dots" else None
+                x = recompute(layer, x, position_ids, policy=pol)
             else:
                 x = layer(x, position_ids)
         x = self.norm(x)
