@@ -194,3 +194,33 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
         return jnp.mean(hit.astype(jnp.float32))
 
     return run_op("accuracy", fn, (input, label), differentiable=False)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC (reference op `auc`, `phi/kernels/cpu/auc_kernel.cc`):
+    histogram the positive-class scores into ``num_thresholds`` bins for
+    positives and negatives, then trapezoid over the implied ROC. Returns
+    a 0-d tensor."""
+    import jax.numpy as jnp
+
+    from ..framework.tensor import run_op
+
+    nbins = int(num_thresholds)
+
+    def fn(inp, lbl):
+        score = inp[:, 1] if inp.ndim == 2 else inp.reshape(-1)
+        y = lbl.reshape(-1).astype(jnp.float32)
+        bins = jnp.clip((score * nbins).astype(jnp.int32), 0, nbins - 1)
+        pos = jnp.zeros((nbins,)).at[bins].add(y)
+        neg = jnp.zeros((nbins,)).at[bins].add(1.0 - y)
+        # sweep thresholds high -> low: cumulative TP/FP
+        tp = jnp.cumsum(pos[::-1])
+        fp = jnp.cumsum(neg[::-1])
+        tot_p = jnp.maximum(tp[-1], 1e-12)
+        tot_n = jnp.maximum(fp[-1], 1e-12)
+        tpr = jnp.concatenate([jnp.zeros((1,)), tp / tot_p])
+        fpr = jnp.concatenate([jnp.zeros((1,)), fp / tot_n])
+        return jnp.trapezoid(tpr, fpr)
+
+    return run_op("auc", fn, (input, label), differentiable=False)

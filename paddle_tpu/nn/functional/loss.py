@@ -20,7 +20,8 @@ __all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
            "square_error_cost", "log_loss", "sigmoid_focal_loss",
            "triplet_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
            "multi_label_soft_margin_loss", "margin_cross_entropy",
-           "huber_loss", "identity_loss", "hsigmoid_loss", "edit_distance"]
+           "huber_loss", "identity_loss", "hsigmoid_loss", "edit_distance",
+           "rnnt_loss"]
 
 
 def _reduce(x, reduction):
@@ -455,3 +456,59 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
     if normalized:
         dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
     return dist[:, None], jnp.asarray([b], jnp.int32)
+
+
+@defop(name="warprnnt")
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean"):
+    """RNN-T (transducer) loss (reference op `warprnnt`,
+    `phi/kernels/cpu/warprnnt_kernel.cc` wrapping warp-transducer).
+
+    input: [B, Tmax, Umax+1, V] joint-network logits; label [B, Umax];
+    the forward variable alpha walks the (T, U) lattice — outer scan
+    over time, inner scan threads the same-row emit recurrence.
+    """
+    logp = jax.nn.log_softmax(jnp.asarray(input, jnp.float32), axis=-1)
+    labels = jnp.asarray(label).astype(jnp.int32)
+    t_lens = jnp.asarray(input_lengths).reshape(-1).astype(jnp.int32)
+    u_lens = jnp.asarray(label_lengths).reshape(-1).astype(jnp.int32)
+    bsz, tmax, umax1, _ = logp.shape
+    umax = umax1 - 1
+    NEG = -1e30
+
+    def one(lp, lbl, t_len, u_len):
+        # blank[t, u] and emit[t, u] (emit consumes lbl[u])
+        blank_lp = lp[:, :, blank]                         # [T, U+1]
+        emit_lp = jnp.take_along_axis(
+            lp[:, :umax, :], lbl[None, :, None], axis=-1)[..., 0]  # [T, U]
+        u_idx = jnp.arange(umax1)
+
+        def row(prev_alpha, t):
+            # from below: alpha[t-1, u] + blank[t-1, u]
+            from_below = jnp.where(
+                t == 0, jnp.where(u_idx == 0, 0.0, NEG),
+                prev_alpha + blank_lp[jnp.maximum(t - 1, 0)])
+
+            # left-to-right emit recurrence within the row
+            def cell(left, u):
+                diag = jnp.where(u == 0, NEG,
+                                 left + emit_lp[t, jnp.maximum(u - 1, 0)])
+                a = jnp.logaddexp(from_below[u], diag)
+                a = jnp.where(u > u_len, NEG, a)
+                return a, a
+
+            _, alpha_row = jax.lax.scan(cell, NEG, u_idx)
+            return alpha_row, None
+
+        def row_keep(carry, t):
+            a, _ = row(carry, t)
+            return a, a
+
+        _, rows = jax.lax.scan(row_keep, jnp.full((umax1,), NEG),
+                               jnp.arange(tmax))
+        final = rows[t_len - 1]                            # [U+1]
+        ll = final[u_len] + blank_lp[t_len - 1, u_len]
+        return -ll
+
+    losses = jax.vmap(one)(logp, labels, t_lens, u_lens)
+    return _reduce(losses, reduction)

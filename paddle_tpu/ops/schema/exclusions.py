@@ -124,9 +124,4 @@ EXCLUSIONS = {
     "graph_sample_neighbors": ("covered", "same"),
     "weighted_sample_neighbors": ("covered", "same"),
     "reindex_graph": ("covered", "same"),
-    # niche losses not yet ported (tracked)
-    "warprnnt": ("pending", "RNN-T loss; ctc_loss (warpctc) is in"),
-    "yolo_loss": ("pending", "training loss for the YOLO head; yolo_box "
-                  "decode is in"),
-    "auc": ("pending", "metric.Auc class exists; functional op pending"),
 }

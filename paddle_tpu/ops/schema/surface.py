@@ -104,6 +104,7 @@ _EXISTING = [
     ("matrix_rank_tol", "paddle_tpu.tensor.linalg", "matrix_rank", False),
     ("segment_pool", "paddle_tpu.geometric", "segment_pool", True),
     ("accuracy", "paddle_tpu.metric", "accuracy", False),
+    ("auc", "paddle_tpu.metric", "auc", False),
     ("truncated_gaussian_random", "paddle_tpu.tensor.random",
      "truncated_gaussian_random", False),
     ("dirichlet", "paddle_tpu.tensor.random", "dirichlet", False),

@@ -762,3 +762,107 @@ def decode_jpeg(x, mode="unchanged"):
     else:
         arr = arr.transpose(2, 0, 1)
     return jnp.asarray(arr)
+
+
+@defop()
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0):
+    """YOLOv3 training loss (reference op `yolo_loss`,
+    `phi/kernels/cpu/yolo_loss_kernel.cc` — same decode, anchor
+    matching, ignore mask, location/objectness/class terms and
+    (2 - w*h) box scale). x [N, M*(5+C), H, W]; gt_box [N, B, 4]
+    (cx, cy, w, h normalized); gt_label [N, B]. Returns loss [N]."""
+    x = jnp.asarray(x, jnp.float32)
+    gt = jnp.asarray(gt_box, jnp.float32)
+    lbl = jnp.asarray(gt_label).astype(jnp.int32)
+    n, _, h, w = x.shape
+    m = len(anchor_mask)
+    an_num = len(anchors) // 2
+    c = int(class_num)
+    input_size = downsample_ratio * h
+    aw_all = jnp.asarray(anchors[0::2], jnp.float32)
+    ah_all = jnp.asarray(anchors[1::2], jnp.float32)
+    mask_arr = np.asarray(anchor_mask, np.int64)
+    scale, sbias = float(scale_x_y), -0.5 * (float(scale_x_y) - 1)
+    if use_label_smooth:
+        smooth = min(1.0 / c, 1.0 / 40)
+        pos_t, neg_t = 1.0 - smooth, smooth
+    else:
+        pos_t, neg_t = 1.0, 0.0
+    score = jnp.ones(lbl.shape, jnp.float32) if gt_score is None \
+        else jnp.asarray(gt_score, jnp.float32)
+
+    def sce(z, t):
+        return jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+
+    def iou_cwh(c1x, c1y, w1, h1, c2x, c2y, w2, h2):
+        ov_w = jnp.minimum(c1x + w1 / 2, c2x + w2 / 2) \
+            - jnp.maximum(c1x - w1 / 2, c2x - w2 / 2)
+        ov_h = jnp.minimum(c1y + h1 / 2, c2y + h2 / 2) \
+            - jnp.maximum(c1y - h1 / 2, c2y - h2 / 2)
+        inter = jnp.where((ov_w < 0) | (ov_h < 0), 0.0, ov_w * ov_h)
+        return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+    def per_image(xi, gts, lbls, scores):
+        v = xi.reshape(m, 5 + c, h, w)
+        gi_grid = jnp.arange(w, dtype=jnp.float32)[None, None, :]
+        gj_grid = jnp.arange(h, dtype=jnp.float32)[None, :, None]
+        aw = aw_all[mask_arr][:, None, None]
+        ah = ah_all[mask_arr][:, None, None]
+        px = (gi_grid + jax.nn.sigmoid(v[:, 0]) * scale + sbias) / w
+        py = (gj_grid + jax.nn.sigmoid(v[:, 1]) * scale + sbias) / h
+        pw = jnp.exp(v[:, 2]) * aw / input_size
+        ph = jnp.exp(v[:, 3]) * ah / input_size
+        valid = (gts[:, 2] > 0) & (gts[:, 3] > 0)
+        # ignore mask: best IoU of each prediction vs any valid gt
+        ious = iou_cwh(px[..., None], py[..., None], pw[..., None],
+                       ph[..., None], gts[None, None, None, :, 0],
+                       gts[None, None, None, :, 1],
+                       gts[None, None, None, :, 2],
+                       gts[None, None, None, :, 3])
+        ious = jnp.where(valid[None, None, None, :], ious, 0.0)
+        best = jnp.max(ious, axis=-1)
+        obj_mask = jnp.where(best > ignore_thresh, -1.0, 0.0)  # [m, h, w]
+        # gt -> best anchor (shape-only IoU over ALL anchors)
+        an_iou = iou_cwh(0.0, 0.0, aw_all[None, :] / input_size,
+                         ah_all[None, :] / input_size,
+                         0.0, 0.0, gts[:, 2:3], gts[:, 3:4])
+        best_n = jnp.argmax(an_iou, axis=1)                     # [B]
+        # map to this head's mask slot (-1 = not ours)
+        mask_pos = jnp.full((an_num,), -1, jnp.int32) \
+            .at[jnp.asarray(mask_arr)].set(jnp.arange(m, dtype=jnp.int32))
+        slot = mask_pos[best_n]
+        gi = jnp.clip((gts[:, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gts[:, 1] * h).astype(jnp.int32), 0, h - 1)
+        take = valid & (slot >= 0)
+        # positive-sample scatter into the objectness mask (last wins,
+        # like the reference's t loop)
+        obj_mask = obj_mask.at[
+            jnp.where(take, slot, m), gj, gi].set(
+            scores, mode="drop")
+        # location + class losses gathered at each gt's cell
+        sslot = jnp.maximum(slot, 0)
+        ent = v[sslot, :, gj, gi]                   # [B, 5+c]
+        tx = gts[:, 0] * w - gi
+        ty = gts[:, 1] * h - gj
+        tw_ = jnp.log(jnp.maximum(
+            gts[:, 2] * input_size / aw_all[best_n], 1e-9))
+        th_ = jnp.log(jnp.maximum(
+            gts[:, 3] * input_size / ah_all[best_n], 1e-9))
+        bscale = (2.0 - gts[:, 2] * gts[:, 3]) * scores
+        loc = (sce(ent[:, 0], tx) + sce(ent[:, 1], ty)
+               + jnp.abs(ent[:, 2] - tw_) + jnp.abs(ent[:, 3] - th_)) \
+            * bscale
+        cls_t = jnp.where(
+            jax.nn.one_hot(lbls, c, dtype=jnp.float32) > 0, pos_t, neg_t)
+        cls = jnp.sum(sce(ent[:, 5:], cls_t), axis=1) * scores
+        gt_loss = jnp.sum(jnp.where(take, loc + cls, 0.0))
+        # objectness loss over the whole grid
+        obj_logit = v[:, 4]
+        obj_l = jnp.where(obj_mask > 1e-5, sce(obj_logit, 1.0) * obj_mask,
+                          jnp.where(obj_mask > -0.5,
+                                    sce(obj_logit, 0.0), 0.0))
+        return gt_loss + jnp.sum(obj_l)
+
+    return jax.vmap(per_image)(x, gt, lbl, score)

@@ -663,3 +663,129 @@ class TestFinalWave:
         np.testing.assert_allclose(
             G.segment_pool(d, _t(np.array([0, 0, 1])), "mean").numpy(),
             [[2, 3], [5, 6]])
+
+
+class TestFinalPendingOps:
+    """The last three reference ops (auc, warprnnt, yolo_loss) — the
+    exclusions ledger now has zero 'pending' entries."""
+
+    def test_auc_matches_rank_statistic(self):
+        import paddle_tpu.metric as M
+
+        rng = np.random.RandomState(0)
+        y = rng.randint(0, 2, 2000)
+        good = np.clip(y * 0.6 + rng.rand(2000) * 0.4, 0, 1) \
+            .astype(np.float32)
+
+        def rank_auc(scores, y):
+            order = np.argsort(scores)
+            ranks = np.empty_like(order, float)
+            ranks[order] = np.arange(1, len(scores) + 1)
+            npos = y.sum()
+            return (ranks[y == 1].sum() - npos * (npos + 1) / 2) \
+                / (npos * (len(y) - npos))
+
+        a = float(M.auc(_t(np.stack([1 - good, good], 1)),
+                        _t(y)).numpy())
+        np.testing.assert_allclose(a, rank_auc(good, y), atol=0.01)
+        rnd = rng.rand(2000).astype(np.float32)
+        a_rnd = float(M.auc(_t(np.stack([1 - rnd, rnd], 1)),
+                            _t(y)).numpy())
+        assert abs(a_rnd - 0.5) < 0.05
+
+    def test_rnnt_loss_vs_brute_force(self):
+        import itertools
+
+        from scipy.special import log_softmax, logsumexp
+
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(0)
+        B, T, U, V = 2, 3, 2, 4
+        logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+        labels = rng.randint(1, V, (B, U)).astype(np.int64)
+        tl = np.array([3, 2])
+        ul = np.array([2, 1])
+
+        def brute(lp, lbl, T, U):
+            lp = log_softmax(lp, axis=-1)
+            total = []
+            for path in set(itertools.permutations(
+                    ["B"] * (T - 1) + ["E"] * U)):
+                t = u = 0
+                s = 0.0
+                for mv in path:
+                    if mv == "B":
+                        s += lp[t, u, 0]
+                        t += 1
+                    else:
+                        s += lp[t, u, lbl[u]]
+                        u += 1
+                s += lp[T - 1, U, 0]
+                total.append(s)
+            return -logsumexp(total)
+
+        want = [brute(logits[b], labels[b], tl[b], ul[b])
+                for b in range(B)]
+        got = F.rnnt_loss(_t(logits), _t(labels), _t(tl), _t(ul),
+                          reduction="none").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_yolo_loss_hand_computed(self):
+        import paddle_tpu.vision.ops as VO
+
+        def sce(z, t):
+            return max(z, 0) - z * t + np.log1p(np.exp(-abs(z)))
+
+        anchors = [10, 12, 20, 24]
+        mask = [1]
+        H = W = 2
+        C = 2
+        down = 16
+        inp = down * H
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 5 + C, H, W).astype(np.float32) * 0.5
+        gt = np.array([[[0.6, 0.3, 0.5, 0.6]]], np.float32)
+        lab = np.array([[1]], np.int64)
+        got = VO.yolo_loss(_t(x), _t(gt), _t(lab), anchors, mask, C,
+                           ignore_thresh=0.7, downsample_ratio=down,
+                           use_label_smooth=False).numpy()
+        v = x[0].reshape(5 + C, H, W)
+        gi, gj = 1, 0
+        tw = np.log(0.5 * inp / 20)
+        th = np.log(0.6 * inp / 24)
+        bscale = 2 - 0.5 * 0.6
+        loss = bscale * (sce(v[0, gj, gi], 0.2) + sce(v[1, gj, gi], 0.6)
+                         + abs(v[2, gj, gi] - tw)
+                         + abs(v[3, gj, gi] - th))
+        loss += sce(v[5, gj, gi], 0) + sce(v[6, gj, gi], 1)
+
+        def dec(k, l):
+            sig = lambda z: 1 / (1 + np.exp(-z))
+            return ((l + sig(v[0, k, l])) / W, (k + sig(v[1, k, l])) / H,
+                    np.exp(v[2, k, l]) * 20 / inp,
+                    np.exp(v[3, k, l]) * 24 / inp)
+
+        def iou(b1, b2):
+            ow = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2) \
+                - max(b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+            oh = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2) \
+                - max(b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+            inter = 0 if ow < 0 or oh < 0 else ow * oh
+            return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter)
+
+        g = (0.6, 0.3, 0.5, 0.6)
+        for k in range(H):
+            for l in range(W):
+                if (k, l) == (gj, gi):
+                    loss += sce(v[4, k, l], 1.0)
+                elif iou(dec(k, l), g) <= 0.7:
+                    loss += sce(v[4, k, l], 0.0)
+        np.testing.assert_allclose(got[0], loss, rtol=1e-5)
+
+    def test_zero_pending_exclusions(self):
+        from paddle_tpu.ops.schema.exclusions import EXCLUSIONS
+
+        pending = [k for k, (cat, _) in EXCLUSIONS.items()
+                   if cat == "pending"]
+        assert pending == []
