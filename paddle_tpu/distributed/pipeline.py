@@ -281,12 +281,18 @@ def _build_1f1b(stage_fn, loss_fn, jmesh, axis, M, treedef):
         def tick(carry, t):
             h_recv, g_recv, stash, gacc, loss_acc = carry
             # ---- forward lane: F_m at t = stage + 2m -----------------
+            # (F and B parities are opposite per stage, so each tick
+            # pays for at most ONE of the two lax.cond bodies — the
+            # inactive lane contributes zero FLOPs, giving the schedule
+            # its 1F1B cost instead of F+B every tick)
             rel_f = t - stage
             f_act = (rel_f >= 0) & (rel_f % 2 == 0) & (rel_f < 2 * M)
             m_f = jnp.clip(rel_f // 2, 0, M - 1)
             x_in = jax.lax.dynamic_index_in_dim(xm, m_f, 0, keepdims=False)
             h_in = jnp.where(stage == 0, x_in, h_recv)
-            h_out = stage_fn(params_local, h_in)
+            h_out = jax.lax.cond(
+                f_act, lambda h: stage_fn(params_local, h),
+                lambda h: jnp.zeros_like(h), h_in)
             slot_f = m_f % S
             cur = jax.lax.dynamic_index_in_dim(stash, slot_f, 0,
                                                keepdims=False)
@@ -298,24 +304,33 @@ def _build_1f1b(stage_fn, loss_fn, jmesh, axis, M, treedef):
             m_b = jnp.clip(rel_b // 2, 0, M - 1)
             h_saved = jax.lax.dynamic_index_in_dim(stash, m_b % S, 0,
                                                    keepdims=False)
-            h_rec, fvjp = jax.vjp(stage_fn, params_local, h_saved)
             y_in = jax.lax.dynamic_index_in_dim(ym, m_b, 0, keepdims=False)
-            loss_m, lvjp = jax.vjp(lambda h: loss_fn(h, y_in), h_rec)
-            (ct_loss,) = lvjp(jnp.ones((), loss_m.dtype))
-            ct = jnp.where(stage == P - 1, ct_loss, g_recv)
-            dp, dx = fvjp(ct)
+
+            def bwd(args):
+                h_saved, y_in, g_recv = args
+                h_rec, fvjp = jax.vjp(stage_fn, params_local, h_saved)
+                loss_m, lvjp = jax.vjp(lambda h: loss_fn(h, y_in), h_rec)
+                (ct_loss,) = lvjp(jnp.ones((), loss_m.dtype))
+                ct = jnp.where(stage == P - 1, ct_loss, g_recv)
+                dp, dx = fvjp(ct)
+                return dp, dx, loss_m
+
+            def bwd_zero(args):
+                h_saved, y_in, g_recv = args
+                return (jax.tree_util.tree_map(jnp.zeros_like,
+                                               params_local),
+                        jnp.zeros_like(h_saved), jnp.zeros((), jnp.float32))
+
+            dp, dx, loss_m = jax.lax.cond(
+                b_act, bwd, bwd_zero, (h_saved, y_in, g_recv))
             gacc = jax.tree_util.tree_map(
-                lambda a, d: a + jnp.where(b_act, d, 0).astype(a.dtype),
-                gacc, dp)
+                lambda a, d: a + d.astype(a.dtype), gacc, dp)
             loss_acc = loss_acc + jnp.where(
-                b_act & (stage == P - 1), loss_m, 0.0)
+                stage == P - 1, loss_m, 0.0)
             # ---- ride the rings ----------------------------------------
-            h_next = jax.lax.ppermute(
-                jnp.where(f_act, h_out, 0), axis, perm_f) if perm_f \
-                else jnp.where(f_act, h_out, 0)
-            g_next = jax.lax.ppermute(
-                jnp.where(b_act, dx, 0), axis, perm_b) if perm_b \
-                else jnp.where(b_act, dx, 0)
+            h_next = jax.lax.ppermute(h_out, axis, perm_f) if perm_f \
+                else h_out
+            g_next = jax.lax.ppermute(dx, axis, perm_b) if perm_b else dx
             return (h_next, g_next, stash, gacc, loss_acc), None
 
         zero_h = jnp.zeros((mb,) + xm.shape[2:], xm.dtype)

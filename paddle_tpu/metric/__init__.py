@@ -200,13 +200,17 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
         slide_steps=1):
     """Batch AUC (reference op `auc`, `phi/kernels/cpu/auc_kernel.cc`):
     histogram the positive-class scores into ``num_thresholds`` bins for
-    positives and negatives, then trapezoid over the implied ROC. Returns
-    a 0-d tensor."""
+    positives and negatives, then trapezoid over the implied curve —
+    ROC (TPR vs FPR) or PR (precision vs recall). Returns a 0-d
+    tensor."""
     import jax.numpy as jnp
 
     from ..framework.tensor import run_op
 
+    if curve not in ("ROC", "PR"):
+        raise ValueError(f"curve must be 'ROC' or 'PR', got {curve!r}")
     nbins = int(num_thresholds)
+    pr = curve == "PR"
 
     def fn(inp, lbl):
         score = inp[:, 1] if inp.ndim == 2 else inp.reshape(-1)
@@ -219,7 +223,13 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
         fp = jnp.cumsum(neg[::-1])
         tot_p = jnp.maximum(tp[-1], 1e-12)
         tot_n = jnp.maximum(fp[-1], 1e-12)
-        tpr = jnp.concatenate([jnp.zeros((1,)), tp / tot_p])
+        recall = tp / tot_p
+        if pr:
+            precision = tp / jnp.maximum(tp + fp, 1e-12)
+            rec = jnp.concatenate([jnp.zeros((1,)), recall])
+            prec = jnp.concatenate([jnp.ones((1,)), precision])
+            return jnp.trapezoid(prec, rec)
+        tpr = jnp.concatenate([jnp.zeros((1,)), recall])
         fpr = jnp.concatenate([jnp.zeros((1,)), fp / tot_n])
         return jnp.trapezoid(tpr, fpr)
 

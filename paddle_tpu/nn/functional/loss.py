@@ -468,6 +468,11 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     the forward variable alpha walks the (T, U) lattice — outer scan
     over time, inner scan threads the same-row emit recurrence.
     """
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: FastEmit regularization (fastemit_lambda != 0) "
+            "is not implemented — pass 0.0 or apply the regularizer "
+            "externally")
     logp = jax.nn.log_softmax(jnp.asarray(input, jnp.float32), axis=-1)
     labels = jnp.asarray(label).astype(jnp.int32)
     t_lens = jnp.asarray(input_lengths).reshape(-1).astype(jnp.int32)

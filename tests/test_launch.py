@@ -89,6 +89,9 @@ ELASTIC_WORKER = textwrap.dedent("""
         state = {"w": 0.0, "step": 0, "losses": []}
     w = state["w"]
     for step in range(state["step"], 6):
+        # per-step barrier: rank 0 can never run ahead of the victim,
+        # so the generation-0 kill lands mid-training deterministically
+        multihost_utils.process_allgather(jnp.asarray([float(step)]))
         if rank == 1 and restart == 0 and step == 3:
             os._exit(1)                      # the killed worker
         loss = (w * 2.0 - 8.0) ** 2          # target w = 4
