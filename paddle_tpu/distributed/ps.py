@@ -23,7 +23,7 @@ import threading
 
 import numpy as np
 
-__all__ = ["SparseTable", "PSServer", "PSClient"]
+__all__ = ["SparseTable", "DiskSparseTable", "PSServer", "PSClient"]
 
 
 def _dumps(arr):
@@ -199,3 +199,91 @@ class PSClient:
 
     def close(self):
         self.store.close()
+
+
+class DiskSparseTable(SparseTable):
+    """Disk-backed sparse table (reference `ps/table/
+    ssd_sparse_table.cc` — rocksdb-resident rows with a hot in-memory
+    cache): rows live in a sqlite file, an LRU cache of ``cache_rows``
+    keeps the hot working set in memory, evictions write through. The
+    pull/push/optimizer semantics are :class:`SparseTable`'s — servers
+    can swap table classes without touching the protocol."""
+
+    def __init__(self, dim, path, initializer=None, optimizer="sgd",
+                 lr=0.1, seed=0, cache_rows=100_000):
+        super().__init__(dim, initializer, optimizer, lr, seed)
+        import sqlite3
+
+        self._cache_rows = int(cache_rows)
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rows "
+            "(id INTEGER PRIMARY KEY, val BLOB, accum BLOB)")
+        self._db.commit()
+
+    def _evict_if_needed(self):
+        # self._rows doubles as the LRU cache (dict preserves insertion
+        # order; re-inserted-on-touch keys move to the back)
+        while len(self._rows) > self._cache_rows:
+            rid, val = next(iter(self._rows.items()))
+            self._flush_row(rid)
+            del self._rows[rid]
+            self._accum.pop(rid, None)
+
+    def _flush_row(self, rid):
+        acc = self._accum.get(rid)
+        self._db.execute(
+            "INSERT OR REPLACE INTO rows (id, val, accum) VALUES (?,?,?)",
+            (int(rid), self._rows[rid].tobytes(),
+             None if acc is None else acc.tobytes()))
+
+    def _row(self, rid):
+        r = self._rows.get(rid)
+        if r is not None:
+            # LRU touch
+            del self._rows[rid]
+            self._rows[rid] = r
+            return r
+        cur = self._db.execute(
+            "SELECT val, accum FROM rows WHERE id = ?", (int(rid),))
+        hit = cur.fetchone()
+        if hit is not None:
+            r = np.frombuffer(hit[0], np.float32).copy()
+            if hit[1] is not None:
+                self._accum[rid] = np.frombuffer(hit[1],
+                                                 np.float32).copy()
+        else:
+            r = self._init(self._rng, self.dim)
+        self._rows[rid] = r
+        self._evict_if_needed()
+        return r
+
+    def flush(self):
+        """Write every cached row through to disk (checkpoint barrier)."""
+        with self._lock:
+            for rid in list(self._rows):
+                self._flush_row(rid)
+            self._db.commit()
+
+    def num_rows(self):
+        with self._lock:
+            cached = set(self._rows)
+            on_disk = {r[0] for r in self._db.execute(
+                "SELECT id FROM rows")}
+            return len(cached | on_disk)
+
+    def state_dict(self):
+        self.flush()
+        with self._lock:
+            rows, accum = {}, {}
+            for rid, val, acc in self._db.execute(
+                    "SELECT id, val, accum FROM rows"):
+                rows[rid] = np.frombuffer(val, np.float32).copy()
+                if acc is not None:
+                    accum[rid] = np.frombuffer(acc, np.float32).copy()
+            rows.update({int(k): v for k, v in self._rows.items()})
+            return {"rows": rows, "accum": accum}
+
+    def close(self):
+        self.flush()
+        self._db.close()
