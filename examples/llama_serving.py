@@ -10,9 +10,14 @@ for reuse.
 """
 
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # repo root (when not pip-installed)
 
 import paddle_tpu as paddle
 from paddle_tpu.inference.serving import LlamaServingEngine, Request
