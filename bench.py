@@ -115,6 +115,12 @@ def bench_train_step(cfg_kw, batch, seq, steps=10, amp=True):
     }
 
 
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def bench_decode(model, batch=4, prompt=128, new_tokens=64):
     """Static-KV-cache serving throughput: steady-state decode tok/s."""
     import paddle_tpu as paddle
@@ -127,12 +133,13 @@ def bench_decode(model, batch=4, prompt=128, new_tokens=64):
     model.generate(ids, max_new_tokens=new_tokens)
     model.generate(ids, max_new_tokens=new_tokens)
     model.generate(ids, max_new_tokens=1)
-    t0 = time.perf_counter()
-    model.generate(ids, max_new_tokens=1)            # prefill-dominated
-    t_prefill = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    model.generate(ids, max_new_tokens=new_tokens)
-    t_full = time.perf_counter() - t0
+    # best-of-3 on both timed sections: the tunneled chip's dispatch
+    # latency is noisy and this number is the serving comparisons'
+    # denominator
+    t_prefill = min(_timed(lambda: model.generate(ids, max_new_tokens=1))
+                    for _ in range(3))
+    t_full = min(_timed(lambda: model.generate(
+        ids, max_new_tokens=new_tokens)) for _ in range(3))
     model.train()
     # steady-state decode: the extra (new_tokens - 1) steps beyond the
     # prefill-only call
@@ -267,7 +274,10 @@ def bench_serving(model, n_requests=24, new_tokens=48, max_batch=16,
     # warm TWICE: pass 1 runs the eager warmup + traces, pass 2 lands
     # every prefill bucket and the decode program in the compile cache
     engine.generate(prompts, max_new_tokens=2)
-    engine.generate(prompts, max_new_tokens=engine.BURST + 2)
+    # instance burst length (not the class default!) — the warm pass
+    # must land the full-length burst program in the compile cache or
+    # the timed run pays its compile
+    engine.generate(prompts, max_new_tokens=engine.burst + 2)
     t0 = time.perf_counter()
     outs = engine.generate(prompts, max_new_tokens=new_tokens)
     dt = time.perf_counter() - t0
@@ -281,11 +291,15 @@ def bench_serving(model, n_requests=24, new_tokens=48, max_batch=16,
     for _ in range(max_batch):
         engine.add_request(Request(
             rng2.randint(0, model.config.vocab_size, (32,)).tolist(),
-            max_new_tokens=new_tokens * 4 + 64))
-    engine.decode_many(engine.BURST)  # warm the burst path
-    t0 = time.perf_counter()
-    served = engine.decode_many(new_tokens * 2)
-    steady = served / (time.perf_counter() - t0)
+            max_new_tokens=new_tokens * 8 + 64))
+    engine.decode_many(engine.burst)  # warm the burst path
+    # best-of-3: the tunneled chip's per-dispatch latency is noisy, and
+    # a single timed window under-reports the engine's sustained rate
+    steady = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        served = engine.decode_many(new_tokens * 2)
+        steady = max(steady, served / (time.perf_counter() - t0))
     for r in list(engine._live.values()):
         engine.alloc.release(r.seq_id)
         engine._live.pop(r.seq_id)
