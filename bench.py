@@ -444,6 +444,10 @@ def main():
 
     try:
         if on_tpu:
+            # the decode/serving model must actually die before the
+            # ~11 GB large config allocates — main()'s local ref would
+            # otherwise pin its 2 GB of fp32 params
+            model = None  # noqa: F841
             result.update(bench_train_large())
     except Exception as e:
         log(f"large-model bench failed: {e!r:.300}")
