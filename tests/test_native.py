@@ -152,22 +152,30 @@ class TestTCPStore:
                           args=(store.port, r, world, q))
               for r in range(world)]
         [p.start() for p in ps]
-        results = [q.get(timeout=60) for _ in range(world)]
+        results = [q.get(timeout=120) for _ in range(world)]
         [p.join(10) for p in ps]
+        errs = [r for r in results if isinstance(r, str)]
+        assert not errs, errs
         assert sorted(r[0] for r in results) == list(range(world))
         assert all(r[1] == b"coordinator-payload" for r in results)
 
 
 def _worker_body(port, rank, world, q):
-    os.environ["PADDLE_TPU_WORKER"] = "1"
-    from paddle_tpu import native as n
-    c = n.TCPStore(port=port, timeout=30)
-    c.barrier(world, tag="boot")
-    if rank == 0:
-        c.set("payload", b"coordinator-payload")
-    val = c.get("payload", timeout=30)
-    q.put((rank, val))
-    c.close()
+    # failure-loud: a crashed child must surface its traceback through
+    # the queue instead of leaving the parent to die on _queue.Empty
+    try:
+        os.environ["PADDLE_TPU_WORKER"] = "1"
+        from paddle_tpu import native as n
+        c = n.TCPStore(port=port, timeout=90)
+        c.barrier(world, tag="boot")
+        if rank == 0:
+            c.set("payload", b"coordinator-payload")
+        val = c.get("payload", timeout=90)
+        q.put((rank, val))
+        c.close()
+    except Exception:
+        import traceback
+        q.put(f"rank {rank}: " + traceback.format_exc())
 
 
 @pytest.fixture

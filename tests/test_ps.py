@@ -104,9 +104,9 @@ class TestPSOverStore:
         q = ctx.Queue()
         p = ctx.Process(target=_ps_worker_body, args=(server.port, q))
         p.start()
-        result = q.get(timeout=60)
+        result = q.get(timeout=120)
         p.join(10)
-        assert result == "ok"
+        assert result == "ok", result
         c = PSClient(port=server.port)
         row = c.pull("emb", [777])
         # the other process pushed a unit gradient: row moved by -lr
@@ -116,15 +116,21 @@ class TestPSOverStore:
 
 
 def _ps_worker_body(port, q):
-    from paddle_tpu.distributed.ps import PSClient
-    import numpy as np
-    c = PSClient(port=port, timeout=30)
-    before = c.pull("emb", [777])
-    c.push("emb", [777], np.ones((1, 8), np.float32))
-    after = c.pull("emb", [777])
-    ok = np.allclose(after, before - 0.1, rtol=1e-5)
-    q.put("ok" if ok else f"mismatch {before} {after}")
-    c.close()
+    # failure-loud: surface child tracebacks through the queue instead
+    # of timing the parent out with _queue.Empty
+    try:
+        from paddle_tpu.distributed.ps import PSClient
+        import numpy as np
+        c = PSClient(port=port, timeout=90)
+        before = c.pull("emb", [777])
+        c.push("emb", [777], np.ones((1, 8), np.float32))
+        after = c.pull("emb", [777])
+        ok = np.allclose(after, before - 0.1, rtol=1e-5)
+        q.put("ok" if ok else f"mismatch {before} {after}")
+        c.close()
+    except Exception:
+        import traceback
+        q.put(traceback.format_exc())
 
 
 class TestDiskSparseTable:
