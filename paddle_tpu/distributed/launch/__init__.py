@@ -88,7 +88,7 @@ def launch(script_args, nnodes=1, node_rank=0, nproc_per_node=1,
 
 def launch_elastic(script_args, nproc_per_node=2, max_restarts=3,
                    min_nproc=1, master=None, log_dir="log",
-                   env_extra=None, store_dir=None):
+                   env_extra=None, store_dir=None, env_base=None):
     """Elastic supervisor: the loop the reference closes in
     `fleet/elastic/manager.py:594` (watch membership -> on scale event,
     tear down, relaunch, resume from checkpoint).
@@ -114,7 +114,7 @@ def launch_elastic(script_args, nproc_per_node=2, max_restarts=3,
     while True:
         code = _elastic_round(script_args, nproc, master, log_dir,
                               dict(env_extra or {}), restarts, store_dir,
-                              ElasticManager, FileStore)
+                              ElasticManager, FileStore, env_base)
         if code == 0:
             return 0
         restarts += 1
@@ -125,7 +125,8 @@ def launch_elastic(script_args, nproc_per_node=2, max_restarts=3,
 
 
 def _elastic_round(script_args, nproc, master, log_dir, env_extra,
-                   restarts, store_dir, ElasticManager, FileStore):
+                   restarts, store_dir, ElasticManager, FileStore,
+                   env_base=None):
     """One supervised generation: spawn, watch membership, tear down on
     the first scale event."""
     world = nproc
@@ -140,7 +141,7 @@ def _elastic_round(script_args, nproc, master, log_dir, env_extra,
     procs, logs = [], []
     try:
         for rank in range(world):
-            env = dict(os.environ)
+            env = dict(os.environ if env_base is None else env_base)
             env.update(env_extra)
             env.update({
                 "PADDLE_TRAINER_ID": str(rank),

@@ -125,13 +125,19 @@ def test_elastic_relaunch_resumes(tmp_path):
     work = str(tmp_path)
     script = tmp_path / "worker.py"
     script.write_text(ELASTIC_WORKER % (repo, work, work))
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("PYTHONPATH", "XLA_FLAGS")}
-    os.environ.pop("PYTHONPATH", None)
+    # Scrub the WORKER env only (env_base) — the axon TPU plugin on
+    # PYTHONPATH hijacks the workers' jax.distributed bootstrap (each
+    # becomes its own 1-process world and the kill never happens).
+    # Never mutate the pytest process's os.environ: stripping its
+    # PYTHONPATH unregisters the plugin for every later test's spawn
+    # children, which crash at import on the inherited JAX_PLATFORMS.
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("PYTHONPATH", "XLA_FLAGS")}
     code = launch_elastic([str(script)], nproc_per_node=2,
                           max_restarts=2, master="127.0.0.1:23971",
                           log_dir=str(tmp_path / "log"),
-                          store_dir=str(tmp_path / "store"))
+                          store_dir=str(tmp_path / "store"),
+                          env_base=env_base)
     logs = ""
     for f in sorted((tmp_path / "log").glob("workerlog.*")):
         logs += f"--- {f.name} ---\n" + f.read_text()
