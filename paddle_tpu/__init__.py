@@ -61,6 +61,7 @@ from . import incubate  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import observability  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402

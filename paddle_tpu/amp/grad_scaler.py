@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
+from ..observability import metrics as _om
 
 __all__ = ["GradScaler", "AmpScaler"]
 
@@ -46,6 +47,12 @@ class GradScaler:
         self._bad = Tensor(jnp.asarray(0, jnp.int32))
         self._found_inf = None        # set by unscale_
         self._unscaled = set()        # optimizers already unscaled this step
+        # counters observe only on the eager path; under to_static the
+        # overflow flag is a tracer and cannot be read host-side
+        self._m_found_inf = _om.counter(
+            "amp_found_inf_total", "steps with non-finite gradients")
+        self._m_backoff = _om.counter(
+            "amp_scale_backoff_total", "loss-scale decreases")
 
     # -- to_static integration ---------------------------------------------
     def __state_tensors__(self):
@@ -128,6 +135,15 @@ class GradScaler:
         growth = jnp.where(found_i > 0, 0, self._growth._data + 1)
         shrink = bad >= self._decr_every_n_nan_or_inf
         grow = growth >= self._incr_every_n_steps
+        if self._m_found_inf is not _om.NULL and not _is_traced(shrink):
+            # one batched D2H for both flags; skipped entirely when the
+            # counters are the shared no-op (PADDLE_TPU_METRICS=0)
+            found_host, shrink_host = jax.device_get(
+                [found_i > 0, shrink])
+            if found_host:
+                self._m_found_inf.inc()
+            if shrink_host:
+                self._m_backoff.inc()
         scale = self._scale._data
         scale = jnp.where(shrink, scale * self._decr_ratio, scale)
         scale = jnp.where(grow, scale * self._incr_ratio, scale)

@@ -28,7 +28,23 @@ import subprocess
 import sys
 import time
 
+from ...observability import metrics as _om
+
 __all__ = ["launch", "launch_elastic", "main"]
+
+
+def _launch_metrics():
+    """Supervisor-side elastic counters (live in the launcher process)."""
+    return {
+        "restarts": _om.counter(
+            "elastic_restarts_total",
+            "elastic generations re-bootstrapped after a failure"),
+        "failures": _om.counter(
+            "elastic_worker_failures_total",
+            "worker processes that exited nonzero"),
+        "world": _om.gauge(
+            "elastic_world_size", "workers in the current generation"),
+    }
 
 
 def launch(script_args, nnodes=1, node_rank=0, nproc_per_node=1,
@@ -109,24 +125,28 @@ def launch_elastic(script_args, nproc_per_node=2, max_restarts=3,
     from ..watchdog import ElasticManager, FileStore
 
     store_dir = store_dir or tempfile.mkdtemp(prefix="elastic_store_")
+    metrics = _launch_metrics()
     restarts = 0
     nproc = int(nproc_per_node)
     while True:
+        metrics["world"].set(nproc)
         code = _elastic_round(script_args, nproc, master, log_dir,
                               dict(env_extra or {}), restarts, store_dir,
-                              ElasticManager, FileStore, env_base)
+                              ElasticManager, FileStore, env_base,
+                              metrics)
         if code == 0:
             return 0
         restarts += 1
         if restarts > max_restarts:
             return code
+        metrics["restarts"].inc()
         if restarts > 1 and nproc > min_nproc:
             nproc -= 1          # repeated failure: shrink the world
 
 
 def _elastic_round(script_args, nproc, master, log_dir, env_extra,
                    restarts, store_dir, ElasticManager, FileStore,
-                   env_base=None):
+                   env_base=None, metrics=None):
     """One supervised generation: spawn, watch membership, tear down on
     the first scale event."""
     world = nproc
@@ -170,8 +190,11 @@ def _elastic_round(script_args, nproc, master, log_dir, env_extra,
                     continue
                 pending.discard(i)
                 store.deregister(str(i))
-                if ret != 0 and exit_code == 0:
-                    exit_code = ret
+                if ret != 0:
+                    if metrics is not None:
+                        metrics["failures"].inc()
+                    if exit_code == 0:
+                        exit_code = ret
             if exit_code and manager.watch_once() == "scale_down":
                 # membership shrank below the expected world: tear down
                 # the generation (reference manager.py:594 behavior).

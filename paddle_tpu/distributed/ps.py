@@ -224,11 +224,18 @@ class DiskSparseTable(SparseTable):
     def _evict_if_needed(self):
         # self._rows doubles as the LRU cache (dict preserves insertion
         # order; re-inserted-on-touch keys move to the back)
+        evicted = False
         while len(self._rows) > self._cache_rows:
             rid, val = next(iter(self._rows.items()))
             self._flush_row(rid)
             del self._rows[rid]
             self._accum.pop(rid, None)
+            evicted = True
+        if evicted:
+            # one commit for the whole eviction batch: without it the
+            # write-through sits in sqlite's open transaction and a
+            # crash loses every evicted row
+            self._db.commit()
 
     def _flush_row(self, rid):
         acc = self._accum.get(rid)

@@ -56,6 +56,7 @@ class _RpcAgent:
         self.store = store
         self._stop = threading.Event()
         self._req_seq = 0
+        self._served = 0              # dispatcher's next-unserved seq
         store.set(f"rpc/worker/{rank}", name.encode())
         # DEDICATED connection for the dispatcher: a TCPStore client
         # serializes requests on its single socket, so a blocking
@@ -106,6 +107,7 @@ class _RpcAgent:
                 if st.delete_key(tomb_key):
                     st.delete_key(reply_key)
             seq += 1
+            self._served = seq
 
     def call(self, to, fn, args, kwargs, timeout):
         seq = self.store.add(f"rpc/seq/{to}", 1) - 1
@@ -147,6 +149,41 @@ class _RpcAgent:
     def stop(self):
         self._stop.set()
         self._dispatcher.join(timeout=5)
+        # Sweep own tombstones: a timed-out caller plants
+        # rpc/dead/{name}/{seq}; the dispatcher consumes it when (not)
+        # publishing that seq's reply, so only seqs it never reached —
+        # [_served, claimed): shutdown raced the dispatcher, or a
+        # crashed caller claimed a seq and never sent — can leak one in
+        # the master store forever. Fresh connection: the dispatcher may
+        # outlive join(timeout) and still own _dispatch_store's socket.
+        start = self._served
+        if self._dispatcher.is_alive():
+            # the join timed out, so the dispatcher is stuck inside a
+            # slow handler for seq _served (after stop() its get() can
+            # only block 0.25s) and will run that seq's tombstone
+            # protocol itself when the handler returns — sweeping it
+            # here would let the late reply leak instead
+            start += 1
+        conn = None
+        try:
+            conn = self._connect()
+            try:
+                # read-only probe: add(key, 0) would CREATE the seq key
+                # for an agent nobody ever called — its own leak
+                raw = conn.get(f"rpc/seq/{self.name}", timeout=0.25)
+                claimed = int.from_bytes(raw, "little")
+            except TimeoutError:
+                claimed = start     # never called: nothing to sweep
+            for seq in range(start, claimed):
+                conn.delete_key(f"rpc/dead/{self.name}/{seq}")
+                # the orphaned request payload for an unserved seq is
+                # the bigger leak (arbitrary pickled args vs 1 byte)
+                conn.delete_key(f"rpc/to/{self.name}/{seq}")
+        except Exception:
+            pass    # best-effort: the store may already be gone
+        finally:
+            if conn is not None:
+                conn.close()
         self._dispatch_store.close()
 
 

@@ -17,11 +17,21 @@ and reports scale events so a supervisor can checkpoint + relaunch.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
 
+from ..observability import metrics as _om
+
 __all__ = ["StepWatchdog", "ElasticManager", "FileStore"]
+
+_WATCHDOG_IDS = itertools.count()
+# live instances per label value: two watchdogs given the SAME explicit
+# name share one exported child, and the first stop() must not remove a
+# series the survivor still updates
+_WATCHDOG_REFS_LOCK = threading.Lock()
+_WATCHDOG_REFS: dict[str, int] = {}
 
 
 class StepWatchdog:
@@ -30,7 +40,7 @@ class StepWatchdog:
     per stall). Reference analog: CommTaskManager's timeout loop."""
 
     def __init__(self, timeout=300.0, on_timeout=None, poll=None,
-                 abort=False):
+                 abort=False, name=None):
         self.timeout = float(timeout)
         self.on_timeout = on_timeout
         self.abort = abort
@@ -40,8 +50,37 @@ class StepWatchdog:
         self._stop = threading.Event()
         self._thread = None
         self.timeouts = 0
+        # per-instance label: two watchdogs in one process (train step +
+        # data loader) must not zero each other's exported age, so an
+        # unnamed instance gets a unique auto label
+        self.name = str(name) if name is not None \
+            else f"wd{next(_WATCHDOG_IDS)}"
+        self._m_timeouts_family = _om.counter(
+            "watchdog_timeouts_total", "step-heartbeat stalls detected",
+            labelnames=("watchdog",))
+        self._m_age_family = _om.gauge(
+            "watchdog_heartbeat_age_seconds",
+            "seconds since the last step heartbeat",
+            labelnames=("watchdog",))
+        self._m_timeouts = self._m_timeouts_family.labels(self.name)
+        self._m_age = self._m_age_family.labels(self.name)
+        self._started = False
+        self._stopped = False
 
     def start(self):
+        # the ref is taken here, not in __init__: a constructed-but-
+        # abandoned instance must not pin the name forever and block a
+        # later same-named watchdog's stop()-time series removal
+        if not self._started:
+            self._started = True
+            with _WATCHDOG_REFS_LOCK:
+                _WATCHDOG_REFS[self.name] = \
+                    _WATCHDOG_REFS.get(self.name, 0) + 1
+            # re-resolve the children: a same-named sibling's stop() may
+            # have removed the ones bound at construction, and updates to
+            # an orphaned child would never be exported
+            self._m_timeouts = self._m_timeouts_family.labels(self.name)
+            self._m_age = self._m_age_family.labels(self.name)
         self._last = time.monotonic()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -50,15 +89,20 @@ class StepWatchdog:
     def beat(self):
         self._last = time.monotonic()
         self._fired = False
+        self._m_age.set(0.0)
 
     def _loop(self):
         while not self._stop.wait(self._poll):
-            if self._last is None or self._fired:
+            if self._last is None:
                 continue
             gap = time.monotonic() - self._last
+            self._m_age.set(gap)
+            if self._fired:
+                continue
             if gap > self.timeout:
                 self._fired = True
                 self.timeouts += 1
+                self._m_timeouts.inc()
                 if self.on_timeout is not None:
                     self.on_timeout(gap)
                 if self.abort:
@@ -68,6 +112,26 @@ class StepWatchdog:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._stopped:
+            return
+        self._stopped = True
+        with _WATCHDOG_REFS_LOCK:
+            if self._started:
+                remaining = _WATCHDOG_REFS[self.name] = \
+                    _WATCHDOG_REFS.get(self.name, 1) - 1
+                if remaining <= 0:
+                    _WATCHDOG_REFS.pop(self.name, None)
+            else:
+                remaining = _WATCHDOG_REFS.get(self.name, 0)
+        if remaining > 0:
+            return      # a same-named sibling still exports this series
+        # a stopped watchdog must not keep exporting a frozen heartbeat
+        # age (an age > timeout would alert forever); drop zero-count
+        # timeout children too so per-fit auto-named instances don't
+        # grow label cardinality without bound
+        self._m_age_family.remove(self.name)
+        if self._m_timeouts.value == 0:
+            self._m_timeouts_family.remove(self.name)
 
     def __enter__(self):
         return self.start()
@@ -116,6 +180,11 @@ class ElasticManager:
         self.expected = int(expected_hosts)
         self.on_scale_event = on_scale_event
         self._stop = threading.Event()
+        self._m_events = _om.counter(
+            "elastic_scale_events_total",
+            "membership deviations observed", labelnames=("kind",))
+        self._m_live = _om.gauge(
+            "elastic_live_hosts", "hosts currently registered")
 
     def register(self):
         self.store.register(self.host_id)
@@ -126,9 +195,12 @@ class ElasticManager:
 
     def watch_once(self):
         live = self.store.hosts()
+        self._m_live.set(len(live))
         if len(live) < self.expected:
+            self._m_events.labels("scale_down").inc()
             return "scale_down"
         if len(live) > self.expected:
+            self._m_events.labels("scale_up").inc()
             return "scale_up"
         return "normal"
 

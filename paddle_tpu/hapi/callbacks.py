@@ -8,7 +8,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "History", "config_callbacks"]
+           "LRScheduler", "History", "MetricsCallback", "config_callbacks"]
 
 
 class Callback:
@@ -186,6 +186,82 @@ class EarlyStopping(Callback):
             if self.wait >= self.patience:
                 self.stopped_epoch = epoch
                 self.model.stop_training = True
+
+
+class MetricsCallback(Callback):
+    """Publishes training-loop signals into the observability registry
+    (``paddle_tpu.observability``): per-step wall time, instantaneous
+    ips, and an MFU estimate.
+
+    - ``batch_size``: samples per step; enables the ``train_ips`` gauge.
+    - ``flops_per_sample``: forward FLOPs for ONE sample. If omitted but
+      ``input_size`` is given (a full input shape with batch dim 1, e.g.
+      ``(1, 4)``), it is estimated at ``on_train_begin`` via
+      ``hapi.model_summary.flops``.
+    - ``peak_flops``: the accelerator's peak FLOP/s; enables the
+      ``train_mfu`` gauge as ``train_flops_multiplier * flops_per_sample
+      * batch_size / step_time / peak_flops`` (the multiplier defaults
+      to 3.0 — forward + backward ~= 2x forward).
+
+    Metric names: ``train_steps_total``, ``train_step_seconds``,
+    ``train_ips``, ``train_mfu``, ``train_loss``.
+    """
+
+    #: step-time buckets: 1ms .. 60s
+    STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+    def __init__(self, batch_size=None, flops_per_sample=None,
+                 input_size=None, peak_flops=None,
+                 train_flops_multiplier=3.0, registry=None):
+        super().__init__()
+        from ..observability import metrics as om
+        reg = registry if registry is not None else om.default_registry()
+        self.batch_size = batch_size
+        self.flops_per_sample = flops_per_sample
+        self.input_size = input_size
+        self.peak_flops = peak_flops
+        self.train_flops_multiplier = float(train_flops_multiplier)
+        self._steps = reg.counter("train_steps_total",
+                                  "optimizer steps taken")
+        self._step_time = reg.histogram("train_step_seconds",
+                                        "wall time per train step",
+                                        buckets=self.STEP_BUCKETS)
+        self._ips = reg.gauge("train_ips",
+                              "instantaneous samples per second")
+        self._mfu = reg.gauge("train_mfu",
+                              "model FLOPs utilization estimate (0..1)")
+        self._loss = reg.gauge("train_loss", "last train-step loss")
+        self._t0 = None
+
+    def on_train_begin(self, logs=None):
+        if self.flops_per_sample is None and self.input_size is not None:
+            from .model_summary import flops as _flops
+            net = getattr(self.model, "network", self.model)
+            try:
+                self.flops_per_sample = _flops(net, self.input_size)
+            except Exception:
+                self.flops_per_sample = None   # un-hookable nets: no MFU
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self._steps.inc()
+        self._step_time.observe(dt)
+        loss = (logs or {}).get("loss")
+        if loss is not None:
+            self._loss.set(float(np.asarray(loss).reshape(-1)[0]))
+        if self.batch_size and dt > 0:
+            self._ips.set(self.batch_size / dt)
+            if self.flops_per_sample and self.peak_flops:
+                achieved = (self.train_flops_multiplier
+                            * self.flops_per_sample * self.batch_size / dt)
+                self._mfu.set(achieved / self.peak_flops)
 
 
 class LRScheduler(Callback):

@@ -35,6 +35,7 @@ Design (TPU-first):
 from __future__ import annotations
 
 import math
+import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -42,10 +43,49 @@ import numpy as np
 from ..framework.tensor import Tensor, no_grad, run_op
 from ..incubate.nn import functional as FI
 from ..nn import functional as F
+from ..observability import metrics as _om
+from ..observability.trace import span as _span
 from ..ops.paged_attention import paged_attention
 from .paged_cache import PageAllocator
 
 __all__ = ["LlamaServingEngine", "Request"]
+
+#: latency buckets tuned for serving (TTFT / per-token): 1ms .. 10s
+_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _serving_metrics():
+    """Standard serving metric set on the default registry (no-ops when
+    ``PADDLE_TPU_METRICS=0``). Counters aggregate across engines in the
+    process; gauges reflect the engine that last updated them."""
+    return {
+        "admitted": _om.counter(
+            "serving_requests_admitted_total",
+            "requests admitted into the continuous batch"),
+        "completed": _om.counter(
+            "serving_requests_completed_total",
+            "requests retired (EOS or max_new_tokens)"),
+        "evicted": _om.counter(
+            "serving_requests_evicted_total",
+            "admission rejections (engine full / KV pages exhausted)"),
+        "queue_depth": _om.gauge(
+            "serving_queue_depth", "live requests in the engine"),
+        "kv_util": _om.gauge(
+            "serving_kv_page_utilization",
+            "fraction of KV-cache pages in use (0 when idle)"),
+        "ttft": _om.histogram(
+            "serving_ttft_seconds",
+            "admission -> first emitted token", buckets=_LATENCY_BUCKETS),
+        "tpot": _om.histogram(
+            "serving_token_latency_seconds",
+            "per-token decode latency (burst dispatches amortized)",
+            buckets=_LATENCY_BUCKETS),
+        "prefill_tokens": _om.counter(
+            "serving_prefill_tokens_total", "prompt tokens prefilled"),
+        "generated": _om.counter(
+            "serving_generated_tokens_total", "tokens emitted by decode"),
+    }
 
 
 def _page_write(pages, new, page_ids, offs):
@@ -87,6 +127,7 @@ class Request:
         self.output_ids: list[int] = []
         self.seq_id = None
         self.done = False
+        self._t_admit = None          # set at admission; drives TTFT
 
 
 class LlamaServingEngine:
@@ -121,9 +162,11 @@ class LlamaServingEngine:
         self.v_pools = [Tensor(jnp.zeros(shape, jnp.dtype(str(dt))))
                         for _ in range(cfg.num_hidden_layers)]
         self._live: dict[int, Request] = {}
+        self._m = _serving_metrics()
         self._next_id = 0
         self._decode_static = None
         self._prefill_static = None
+        self._prefill_warm_buckets: set[int] = set()
         self._burst_static: dict[int, object] = {}  # burst length -> program
 
     def __state_tensors__(self):
@@ -209,7 +252,33 @@ class LlamaServingEngine:
                 self._prefill_forward, state=[self.model], warmup="once",
                 donate_inputs=True)
             self._prefill_static._warmed_any = True
-        with no_grad():
+        if self._m["ttft"] is not _om.NULL \
+                and bucket not in self._prefill_warm_buckets:
+            # compile this bucket's program OUTSIDE the TTFT window: a
+            # dummy dispatch (all page writes land in the trash page,
+            # emitted tokens discarded) triggers the one-time trace +
+            # compile, and the wave's admission stamps shift past it so
+            # TTFT keeps one sample per request without the multi-second
+            # compile skewing the histogram's +Inf bucket forever. Under
+            # PADDLE_TPU_METRICS=0 this is skipped (zero-cost mandate).
+            t_w = time.perf_counter()
+            with no_grad():
+                _, wk, wv = self._prefill_static(
+                    Tensor(jnp.asarray(np.zeros((b, bucket), np.int64))),
+                    Tensor(jnp.asarray(np.zeros((b,), np.int32))),
+                    Tensor(jnp.asarray(np.full((b, bucket),
+                                               self.trash_page,
+                                               np.int32))),
+                    Tensor(jnp.asarray(np.zeros((b, bucket), np.int32))),
+                    self.k_pools, self.v_pools)
+            self.k_pools, self.v_pools = list(wk), list(wv)
+            warm_dur = time.perf_counter() - t_w
+            for r in reqs:
+                if r._t_admit is not None:
+                    r._t_admit += warm_dur
+            self._prefill_warm_buckets.add(bucket)
+        with no_grad(), _span("serving.prefill_wave", wave=len(reqs),
+                              bucket=bucket):
             nxt, new_k, new_v = self._prefill_static(
                 Tensor(jnp.asarray(padded)),
                 Tensor(jnp.asarray(last_pos)),
@@ -219,6 +288,7 @@ class LlamaServingEngine:
         first = np.asarray(nxt._data).reshape(-1)
         for i, r in enumerate(reqs):
             self._emit(r, int(first[i]))
+        self._set_pool_gauges()
 
     # ------------------------------------------------------------------
     # decode
@@ -272,14 +342,28 @@ class LlamaServingEngine:
     # ------------------------------------------------------------------
     # scheduling
     # ------------------------------------------------------------------
+    def _set_pool_gauges(self):
+        self._m["queue_depth"].set(len(self._live))
+        self._m["kv_util"].set(
+            1.0 - self.alloc.free_pages / self.alloc.num_pages)
+
     def _admit(self, req):
         if len(self._live) >= self.max_batch:
+            self._m["evicted"].inc()
             raise MemoryError(
                 f"engine full ({self.max_batch} live requests)")
         req.seq_id = self._next_id
         self._next_id += 1
-        self.alloc.admit(req.seq_id, len(req.prompt_ids))
+        try:
+            self.alloc.admit(req.seq_id, len(req.prompt_ids))
+        except MemoryError:
+            self._m["evicted"].inc()
+            raise
         self._live[req.seq_id] = req
+        req._t_admit = time.perf_counter()
+        self._m["admitted"].inc()
+        self._m["prefill_tokens"].inc(len(req.prompt_ids))
+        self._set_pool_gauges()
         return req.seq_id
 
     def add_request(self, req):
@@ -289,12 +373,20 @@ class LlamaServingEngine:
         return sid
 
     def _emit(self, req, token):
+        first = not req.output_ids
         req.output_ids.append(token)
+        if first and req._t_admit is not None:
+            self._m["ttft"].observe(time.perf_counter() - req._t_admit)
+        self._m["generated"].inc()
         if (req.eos_token_id is not None and token == req.eos_token_id) \
                 or len(req.output_ids) >= req.max_new_tokens:
             req.done = True
             self.alloc.release(req.seq_id)
             del self._live[req.seq_id]
+            self._m["completed"].inc()
+        # pool gauges are refreshed once per wave/step/burst by the
+        # caller, not per emitted token — only the post-loop value is
+        # observable anyway
 
     def _views_np(self, live):
         """Padded (tokens?, tables, lens) numpy views for the full
@@ -321,6 +413,11 @@ class LlamaServingEngine:
         live = [r for r in self._live.values() if not r.done]
         if not live:
             return 0
+        # a cold call traces + compiles inside the timed window; that
+        # one-time multi-second sample would skew the tpot histogram
+        # (top bucket 10s) forever, so it is not observed
+        cold = self._decode_static is None
+        t0 = time.perf_counter()
         # account the new token BEFORE building views: the write offset
         # and the kernel's context length both include it
         for r in live:
@@ -331,13 +428,17 @@ class LlamaServingEngine:
                 else r.prompt_ids[-1]
         tables, lens = self._views_np(live)
         step = self._ensure_decode_compiled()
-        nxt, new_k, new_v = step(
-            Tensor(jnp.asarray(tokens)), Tensor(jnp.asarray(tables)),
-            Tensor(jnp.asarray(lens)), self.k_pools, self.v_pools)
+        with _span("serving.decode_step", live=len(live)):
+            nxt, new_k, new_v = step(
+                Tensor(jnp.asarray(tokens)), Tensor(jnp.asarray(tables)),
+                Tensor(jnp.asarray(lens)), self.k_pools, self.v_pools)
         self.k_pools, self.v_pools = list(new_k), list(new_v)
         out = np.asarray(nxt._data).reshape(-1)
+        if not cold:
+            self._m["tpot"].observe(time.perf_counter() - t0)
         for i, r in enumerate(live):
             self._emit(r, int(out[i]))
+        self._set_pool_gauges()
         return len(live)
 
     # ------------------------------------------------------------------
@@ -395,6 +496,10 @@ class LlamaServingEngine:
         live = [r for r in self._live.values() if not r.done]
         if not live or n <= 0:
             return 0
+        # as in step(): each new burst length compiles on its first
+        # call — don't let that land n inflated samples in tpot
+        cold = n not in self._burst_static
+        t0 = time.perf_counter()
         start_lens = {r.seq_id: self.alloc._lens[r.seq_id] for r in live}
         for r in live:
             self.alloc.extend(r.seq_id, n)
@@ -409,7 +514,8 @@ class LlamaServingEngine:
             tokens[i, 0] = r.output_ids[-1] if r.output_ids \
                 else r.prompt_ids[-1]
         sf = self._ensure_burst_compiled(n)
-        with no_grad():
+        with no_grad(), _span("serving.decode_burst", live=len(live),
+                              burst=n):
             out = sf(
                 Tensor(jnp.asarray(tokens)), Tensor(jnp.asarray(tables)),
                 Tensor(jnp.asarray(lens)), self.k_pools, self.v_pools)
@@ -418,6 +524,12 @@ class LlamaServingEngine:
         self.k_pools = list(out[1:1 + n_layers])
         self.v_pools = list(out[1 + n_layers:])
         all_tokens = np.asarray(toks._data)          # one D2H
+        # one scan tick serves every live row: per-token latency is the
+        # dispatch wall time amortized over the n ticks
+        if not cold:
+            tick = (time.perf_counter() - t0) / n
+            for _ in range(n):
+                self._m["tpot"].observe(tick)
         served = 0
         for i, r in enumerate(live):
             for t in range(n):
@@ -425,6 +537,7 @@ class LlamaServingEngine:
                     break
                 self._emit(r, int(all_tokens[i, t]))
                 served += 1
+        self._set_pool_gauges()
         return served
 
     def _burst_fits(self, live, n):

@@ -467,8 +467,10 @@ def class_center_sample(label, num_classes, num_samples, group=None,
     sampled index space. Sampling is host-side bookkeeping (the result
     feeds a partial FC layer); returns (remapped_label,
     sampled_class_center)."""
+    import jax as _jax
     import numpy as _np
 
+    from ...framework import random as _framework_random
     from ...framework.tensor import Tensor as _T
 
     lbl = _np.asarray(getattr(label, "_data", label)).reshape(-1)
@@ -478,7 +480,11 @@ def class_center_sample(label, num_classes, num_samples, group=None,
     else:
         neg_pool = _np.setdiff1d(_np.arange(num_classes), pos,
                                  assume_unique=True)
-        extra = _np.random.permutation(neg_pool)[:num_samples - len(pos)]
+        # negatives drawn through framework.random: paddle.seed()
+        # controls the sample like every other random op
+        perm = _np.asarray(_jax.random.permutation(
+            _framework_random.next_key(), len(neg_pool)))
+        extra = neg_pool[perm[:num_samples - len(pos)]]
         sampled = _np.sort(_np.concatenate([pos, extra]))
     remap = _np.full((num_classes,), -1, _np.int64)
     remap[sampled] = _np.arange(len(sampled))

@@ -84,8 +84,14 @@ def _reduce_pool(x, kernel, stride, padding, nd, channel_last, init, op,
         (window, strides, pads)
 
 
+def _is_channel_last(data_format):
+    """One classification shared by the pooling dispatch and the
+    return_mask guards, so an accepted alias can't drift between them."""
+    return data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+
+
 def _max_pool(x, kernel, stride, padding, nd, data_format, ceil_mode):
-    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    channel_last = _is_channel_last(data_format)
     neg = _reduce_init(jax.lax.max, x.dtype)
     out, _ = _reduce_pool(x, kernel, stride, padding, nd, channel_last,
                           neg, jax.lax.max, ceil_mode)
@@ -94,7 +100,7 @@ def _max_pool(x, kernel, stride, padding, nd, data_format, ceil_mode):
 
 def _avg_pool(x, kernel, stride, padding, nd, data_format, exclusive,
               ceil_mode):
-    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    channel_last = _is_channel_last(data_format)
     summed, (window, strides, pads) = _reduce_pool(
         x, kernel, stride, padding, nd, channel_last, 0.0, jax.lax.add,
         ceil_mode)
@@ -110,6 +116,11 @@ def _avg_pool(x, kernel, stride, padding, nd, data_format, exclusive,
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCL"):
     if return_mask:
+        if _is_channel_last(data_format):
+            raise ValueError(
+                "max_pool1d(return_mask=True) requires data_format='NCL'; "
+                f"got {data_format!r} (the mask path pools channel-first "
+                "axes)")
         k = _tuple(kernel_size, 1)
         st = _tuple(stride, 1) if stride is not None else k
         dims = _fixed_window_dims(x.shape[2:], k, st, _tuple(padding, 1),
@@ -123,6 +134,11 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW"):
     if return_mask:
+        if _is_channel_last(data_format):
+            raise ValueError(
+                "max_pool2d(return_mask=True) requires data_format="
+                f"'NCHW'; got {data_format!r} (the mask path pools "
+                "channel-first axes)")
         k = _tuple(kernel_size, 2)
         st = _tuple(stride, 2) if stride is not None else k
         dims = _fixed_window_dims(x.shape[2:], k, st, _tuple(padding, 2),
@@ -136,6 +152,11 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW"):
     if return_mask:
+        if _is_channel_last(data_format):
+            raise ValueError(
+                "max_pool3d(return_mask=True) requires data_format="
+                f"'NCDHW'; got {data_format!r} (the mask path pools "
+                "channel-first axes)")
         k = _tuple(kernel_size, 3)
         st = _tuple(stride, 3) if stride is not None else k
         dims = _fixed_window_dims(x.shape[2:], k, st, _tuple(padding, 3),
@@ -336,6 +357,18 @@ def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
     return _windowed_max(x, dims, True)
 
 
+def _default_random_u():
+    """Draw the fractional-pool offset from ``framework.random`` so
+    ``paddle.seed()`` controls it like every other random op. The value
+    is consumed by host-side window construction, so it is concretized
+    here (tracing without an explicit ``random_u`` is an error, as it
+    would bake one draw into the compiled program)."""
+    from ...framework import random as framework_random
+
+    key = framework_random.next_key()
+    return float(jax.random.uniform(key, (), minval=0.1, maxval=0.9))
+
+
 def _fractional_dims(spatial, out_sizes, kernel, u):
     """Reference fractional windows (`phi/kernels/funcs/pooling.h`
     FractionalStartIndex/EndIndex + FractionalRationalU)."""
@@ -368,8 +401,7 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
     offset; otherwise one is drawn per call."""
     o = _tuple(output_size, 2)
     k = _tuple(kernel_size, 2) if kernel_size is not None else None
-    u = float(random_u) if random_u is not None \
-        else float(np.random.uniform(0.1, 0.9))
+    u = float(random_u) if random_u is not None else _default_random_u()
     dims = _fractional_dims(x.shape[2:], o, k, u)
     out, idx = _windowed_max(x, dims, return_mask)
     return (out, idx) if return_mask else out
@@ -382,8 +414,7 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
     `fractional_max_pool3d`)."""
     o = _tuple(output_size, 3)
     k = _tuple(kernel_size, 3) if kernel_size is not None else None
-    u = float(random_u) if random_u is not None \
-        else float(np.random.uniform(0.1, 0.9))
+    u = float(random_u) if random_u is not None else _default_random_u()
     dims = _fractional_dims(x.shape[2:], o, k, u)
     out, idx = _windowed_max(x, dims, return_mask)
     return (out, idx) if return_mask else out

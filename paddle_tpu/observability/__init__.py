@@ -1,0 +1,28 @@
+"""``paddle_tpu.observability`` — unified runtime metrics + tracing.
+
+The measurement substrate for the serving engine, elastic launcher, and
+training loop: a thread-safe metric registry (`metrics`), a host-span
+tracer with chrome-trace export (`trace`), and Prometheus/JSON/HTTP
+exporters (`export`). ``PADDLE_TPU_METRICS=0`` turns the whole layer
+into no-ops. See README "Observability" for the standard metric names.
+"""
+
+from . import export, metrics, trace  # noqa: F401
+from .export import (  # noqa: F401
+    json_snapshot, prometheus_text, snapshot_to_prometheus,
+    start_http_server,
+)
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, counter, default_registry,
+    enabled, gauge, histogram,
+)
+from .trace import export_chrome_trace, span  # noqa: F401
+
+__all__ = [
+    "metrics", "trace", "export",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "counter", "gauge", "histogram", "default_registry", "enabled",
+    "span", "export_chrome_trace",
+    "prometheus_text", "json_snapshot", "snapshot_to_prometheus",
+    "start_http_server",
+]

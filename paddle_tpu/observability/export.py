@@ -1,0 +1,164 @@
+"""Exporters: Prometheus text format, JSON snapshots, HTTP scrape.
+
+- :func:`json_snapshot` — a pure-data (JSON-serializable) dump of a
+  registry; :func:`snapshot_to_prometheus` renders such a snapshot to
+  Prometheus text, and :func:`prometheus_text` composes the two — so
+  text output round-trips exactly through the JSON snapshot layer
+  (serialize, ship, re-render identically on another host).
+- :func:`start_http_server` — an optional stdlib ``http.server`` scrape
+  endpoint (``/metrics`` text, ``/metrics.json`` snapshot) for the
+  serving engine; returns a handle with ``.port`` / ``.url`` / ``.stop``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+
+from .metrics import default_registry
+
+__all__ = ["json_snapshot", "snapshot_to_prometheus", "prometheus_text",
+           "start_http_server", "ScrapeServer"]
+
+
+def _fmt_value(v):
+    if isinstance(v, str):
+        return v    # non-finite marker straight from a JSON snapshot
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _json_value(v):
+    """Float for the snapshot, except non-finite values become their
+    Prometheus markers ("+Inf"/"-Inf"/"NaN"): json.dumps would emit bare
+    Infinity/NaN — invalid JSON that strict parsers (JSON.parse, jq, Go)
+    reject, breaking the documented cross-host snapshot round-trip."""
+    v = float(v)
+    if not math.isfinite(v):
+        return _fmt_value(v)
+    return v
+
+
+def _escape(v):
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def _label_str(labelnames, values, extra=()):
+    pairs = list(zip(labelnames, values)) + list(extra)
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"' for k, v in pairs) + "}"
+
+
+def json_snapshot(registry=None):
+    """List of metric dicts (name/help/type/labelnames/samples) holding
+    only JSON-native values — ``json.dumps`` round-trips it losslessly."""
+    reg = registry if registry is not None else default_registry()
+    out = []
+    for m in reg.collect():
+        entry = {"name": m.name, "help": m.help, "type": m.kind,
+                 "labelnames": list(m.labelnames), "samples": []}
+        for values, leaf in m.samples():
+            sample = {"labels": list(values)}
+            if m.kind == "histogram":
+                counts, total = leaf.snapshot()
+                sample.update(buckets=list(leaf.buckets),
+                              counts=counts,
+                              sum=_json_value(total),
+                              count=int(sum(counts)))
+            else:
+                sample["value"] = _json_value(leaf.value)
+            entry["samples"].append(sample)
+        out.append(entry)
+    return out
+
+
+def snapshot_to_prometheus(snapshot):
+    """Render a :func:`json_snapshot` (or its JSON round-trip) to
+    Prometheus exposition text (version 0.0.4)."""
+    lines = []
+    for entry in snapshot:
+        name, kind = entry["name"], entry["type"]
+        labelnames = entry.get("labelnames", [])
+        if entry.get("help"):
+            lines.append(f"# HELP {name} {_escape(entry['help'])}")
+        lines.append(f"# TYPE {name} {kind}")
+        for sample in entry["samples"]:
+            values = sample.get("labels", [])
+            if kind == "histogram":
+                acc = 0
+                bounds = list(sample["buckets"]) + ["+Inf"]
+                for bound, c in zip(bounds, sample["counts"]):
+                    acc += c
+                    le = "+Inf" if bound == "+Inf" else _fmt_value(bound)
+                    ls = _label_str(labelnames, values, [("le", le)])
+                    lines.append(f"{name}_bucket{ls} {acc}")
+                ls = _label_str(labelnames, values)
+                lines.append(f"{name}_sum{ls} {_fmt_value(sample['sum'])}")
+                lines.append(f"{name}_count{ls} {sample['count']}")
+            else:
+                ls = _label_str(labelnames, values)
+                lines.append(f"{name}{ls} {_fmt_value(sample['value'])}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def prometheus_text(registry=None):
+    """Prometheus text for a registry (the scrape-endpoint body)."""
+    return snapshot_to_prometheus(json_snapshot(registry))
+
+
+class ScrapeServer:
+    """Handle for a running scrape endpoint."""
+
+    def __init__(self, httpd, thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.port = httpd.server_address[1]
+        self.url = f"http://{httpd.server_address[0]}:{self.port}/metrics"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_http_server(port=0, addr="127.0.0.1", registry=None):
+    """Serve ``/metrics`` (Prometheus text) and ``/metrics.json`` on a
+    daemon thread; ``port=0`` picks a free port. Returns
+    :class:`ScrapeServer`."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry if registry is not None else default_registry()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path in ("/", "/metrics"):
+                body = prometheus_text(reg).encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path == "/metrics.json":
+                body = json.dumps(json_snapshot(reg)).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # scrapes must not spam stderr
+            pass
+
+    httpd = ThreadingHTTPServer((addr, port), Handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return ScrapeServer(httpd, thread)

@@ -1,0 +1,144 @@
+"""Structured host-span tracing: a ring buffer + chrome-trace export.
+
+``span(name)`` is a context manager AND a decorator that records a
+wall-time host span (complete event) into a bounded ring buffer — cheap
+enough for scheduler/launcher hot paths where the XLA device tracer
+(`paddle_tpu.profiler`) is too heavy. Export writes chrome-trace JSON
+under the same ``<log_dir>/plugins/profile/<run>/`` layout the profiler
+uses, so TensorBoard's profile plugin and Perfetto load host spans next
+to device traces.
+
+Tracing obeys the same kill switch as metrics: ``PADDLE_TPU_METRICS=0``
+makes ``span`` a no-op and records nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .metrics import enabled
+
+__all__ = ["span", "TraceBuffer", "default_buffer", "get_events", "clear",
+           "export_chrome_trace"]
+
+#: process epoch — span timestamps are microseconds since this point
+_EPOCH = time.perf_counter()
+
+
+class TraceBuffer:
+    """Bounded, thread-safe ring of chrome-trace events (oldest spans
+    fall off the back once ``capacity`` is reached)."""
+
+    def __init__(self, capacity=4096):
+        self._events = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+
+    def add(self, event):
+        with self._lock:
+            self._events.append(event)
+
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._events)
+
+
+_default_buffer = TraceBuffer()
+
+
+def default_buffer():
+    return _default_buffer
+
+
+def get_events():
+    return _default_buffer.events()
+
+
+def clear():
+    _default_buffer.clear()
+
+
+class span:
+    """Record a named host span.
+
+    Context manager::
+
+        with span("serving.prefill", batch=4):
+            ...
+
+    Decorator (a fresh span per call)::
+
+        @span("engine.step")
+        def step(...): ...
+    """
+
+    __slots__ = ("name", "args", "buffer", "_t0")
+
+    def __init__(self, name, buffer=None, **args):
+        self.name = name
+        self.args = args or None
+        self.buffer = buffer
+        self._t0 = None
+
+    def __enter__(self):
+        if enabled():
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t0 = self._t0
+        if t0 is None:
+            return False
+        now = time.perf_counter()
+        event = {
+            "name": self.name,
+            "ph": "X",
+            "ts": (t0 - _EPOCH) * 1e6,
+            "dur": (now - t0) * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if self.args:
+            event["args"] = dict(self.args)
+        # explicit None-check: an empty TraceBuffer is falsy (__len__)
+        buf = self.buffer if self.buffer is not None else _default_buffer
+        buf.add(event)
+        self._t0 = None
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with span(self.name, buffer=self.buffer, **(self.args or {})):
+                return fn(*a, **kw)
+
+        return wrapper
+
+
+def export_chrome_trace(dir_name, worker_name=None, buffer=None):
+    """Write buffered spans as chrome-trace JSON into the profiler's
+    output layout: ``<dir_name>/plugins/profile/<run>/<worker>.
+    host_spans.trace.json``. Returns the written path."""
+    # explicit None-check: an empty TraceBuffer is falsy (__len__)
+    buf = buffer if buffer is not None else _default_buffer
+    run = time.strftime("%Y_%m_%d_%H_%M_%S")
+    out_dir = os.path.join(dir_name, "plugins", "profile", run)
+    os.makedirs(out_dir, exist_ok=True)
+    worker = worker_name or f"host_{os.getpid()}"
+    path = os.path.join(out_dir, f"{worker}.host_spans.trace.json")
+    with open(path, "w") as f:
+        json.dump({"traceEvents": buf.events(),
+                   "displayTimeUnit": "ms"}, f)
+    return path

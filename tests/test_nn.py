@@ -296,3 +296,59 @@ class TestExtraLosses:
             cos, paddle.to_tensor(np.array([1, 3]))).backward()
         assert cos.grad is not None
         assert float(np.abs(cos.grad.numpy()).sum()) > 0
+
+
+class TestPoolingRandomnessRegressions:
+    """ISSUE 1 satellites: return_mask + channel-last must raise, and
+    fractional pooling / class_center_sample must obey paddle.seed()."""
+
+    def test_max_pool_return_mask_rejects_channel_last(self):
+        rng = np.random.RandomState(0)
+        cases = [
+            (F.max_pool1d, t(rng.randn(1, 2, 8)), "NLC"),
+            (F.max_pool2d, t(rng.randn(1, 2, 8, 8)), "NHWC"),
+            (F.max_pool3d, t(rng.randn(1, 2, 4, 4, 4)), "NDHWC"),
+        ]
+        for fn, x, fmt in cases:
+            with pytest.raises(ValueError):
+                fn(x, 2, return_mask=True, data_format=fmt)
+            out, idx = fn(x, 2, return_mask=True)   # NC* path still works
+            assert out.shape[1] == 2
+
+    def test_fractional_pool_default_u_obeys_seed(self):
+        from paddle_tpu.nn.functional.pooling import _default_random_u
+
+        paddle.seed(7)
+        u1, u2 = _default_random_u(), _default_random_u()
+        paddle.seed(7)
+        assert _default_random_u() == u1
+        assert u1 != u2                      # stream advances
+        assert 0.1 <= u1 <= 0.9
+        x = t(np.random.RandomState(0).randn(1, 2, 8, 8))
+        paddle.seed(7)
+        a = F.fractional_max_pool2d(x, 3)
+        paddle.seed(7)
+        b = F.fractional_max_pool2d(x, 3)
+        np.testing.assert_array_equal(np.asarray(a._data),
+                                      np.asarray(b._data))
+        paddle.seed(7)
+        c = F.fractional_max_pool3d(t(np.random.RandomState(1)
+                                      .randn(1, 2, 4, 4, 4)), 2)
+        paddle.seed(7)
+        d = F.fractional_max_pool3d(t(np.random.RandomState(1)
+                                      .randn(1, 2, 4, 4, 4)), 2)
+        np.testing.assert_array_equal(np.asarray(c._data),
+                                      np.asarray(d._data))
+
+    def test_class_center_sample_obeys_seed(self):
+        lbl = paddle.to_tensor(np.asarray([1, 5, 9], np.int64))
+        paddle.seed(3)
+        _, s1 = F.class_center_sample(lbl, 40, 8)
+        paddle.seed(3)
+        _, s2 = F.class_center_sample(lbl, 40, 8)
+        np.testing.assert_array_equal(np.asarray(s1._data),
+                                      np.asarray(s2._data))
+        # every positive kept, fill is from the negative pool
+        sampled = set(np.asarray(s1._data).tolist())
+        assert {1, 5, 9} <= sampled
+        assert len(sampled) == 8

@@ -178,3 +178,36 @@ class TestDiskSparseTable:
         out = t.pull([7])
         t.push([7], np.ones((1, 2), np.float32) * 0.5)
         np.testing.assert_allclose(t.pull([7]), out - 0.05, atol=1e-6)
+
+
+class TestDiskTableEvictionDurability:
+    """ISSUE 1 satellite: evictions must COMMIT — the documented
+    write-through has to survive a crash (a second sqlite connection
+    only sees committed rows)."""
+
+    def test_evicted_rows_visible_to_fresh_connection(self, tmp_path):
+        import sqlite3
+
+        from paddle_tpu.distributed.ps import DiskSparseTable
+
+        path = str(tmp_path / "durable.db")
+        t = DiskSparseTable(4, path, seed=0, cache_rows=2)
+        for i in range(6):          # 4 evictions past the cache limit
+            t.pull([i])
+        # no flush()/close(): simulate a crash by reading through an
+        # independent connection, which sees only committed data
+        other = sqlite3.connect(path)
+        try:
+            n = other.execute("SELECT COUNT(*) FROM rows").fetchone()[0]
+        finally:
+            other.close()
+        assert n >= 4
+
+    def test_eviction_preserves_values(self, tmp_path):
+        from paddle_tpu.distributed.ps import DiskSparseTable
+
+        path = str(tmp_path / "vals.db")
+        t = DiskSparseTable(3, path, seed=2, cache_rows=1)
+        want = t.pull([10])[0].copy()
+        t.pull([11]); t.pull([12])   # force 10 out of the cache
+        np.testing.assert_allclose(t.pull([10])[0], want, atol=0)

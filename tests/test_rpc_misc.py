@@ -188,3 +188,56 @@ class TestAmpDebugging:
         x = paddle.to_tensor(np.linspace(0, 1, 8).astype(np.float32))
         rep = dbg.compare_accuracy(lambda a: a * 1.5, [x])
         assert rep["bfloat16"][0]["max_abs_err"] < 0.05
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native store")
+def test_shutdown_sweeps_own_tombstones():
+    """ISSUE 1 satellite: a caller-planted tombstone for a request the
+    agent never served must not leak in the master store after the
+    agent stops."""
+    from paddle_tpu.distributed import rpc as rpc_mod
+
+    assert rpc_mod._agent is None
+    rpc_mod.init_rpc("sweeper", rank=0, world_size=1,
+                     master_endpoint="127.0.0.1:0")
+    ag = rpc_mod._agent
+    try:
+        # a caller claims a seq, then times out BEFORE writing the
+        # request payload: only its tombstone is ever planted, so the
+        # dispatcher never reaches that seq to consume it
+        seq = ag.store.add("rpc/seq/sweeper", 1) - 1
+        ag.store.set(f"rpc/dead/sweeper/{seq}", b"1")
+        assert ag.store.get(f"rpc/dead/sweeper/{seq}", timeout=5) == b"1"
+        # a second claimed-but-unserved seq WITH its payload written:
+        # the sweep must reap the orphaned request body too
+        seq2 = ag.store.add("rpc/seq/sweeper", 1) - 1
+        ag.store.set(f"rpc/to/sweeper/{seq2}", b"payload")
+        ag.store.set(f"rpc/dead/sweeper/{seq2}", b"1")
+        ag.stop()    # sweep runs here, before the store goes away
+        for key in (f"rpc/dead/sweeper/{seq}", f"rpc/dead/sweeper/{seq2}",
+                    f"rpc/to/sweeper/{seq2}"):
+            with pytest.raises(TimeoutError):
+                ag.store.get(key, timeout=0.3)
+    finally:
+        ag.store.close()
+        rpc_mod._agent = None
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native store")
+def test_shutdown_of_idle_agent_creates_no_seq_key():
+    """The sweep's read of rpc/seq/{name} must be a non-creating probe:
+    an agent nobody ever called has no seq key and must not leave one
+    behind on stop."""
+    from paddle_tpu.distributed import rpc as rpc_mod
+
+    assert rpc_mod._agent is None
+    rpc_mod.init_rpc("idle", rank=0, world_size=1,
+                     master_endpoint="127.0.0.1:0")
+    ag = rpc_mod._agent
+    try:
+        ag.stop()
+        with pytest.raises(TimeoutError):
+            ag.store.get("rpc/seq/idle", timeout=0.3)
+    finally:
+        ag.store.close()
+        rpc_mod._agent = None
