@@ -459,6 +459,35 @@ def main():
             "vs_baseline": round(mfu / 0.40, 4)}
     line.update(result)
     print(json.dumps(line), flush=True)
+    try:
+        write_metrics_snapshot(line)
+    except Exception as e:
+        log(f"metrics snapshot failed: {e!r:.200}")
+
+
+def write_metrics_snapshot(result,
+                           path="BENCH_observability_snapshot.json"):
+    """Publish the per-run bench numbers as observability gauges
+    (``bench_<key>``) and write the registry snapshot through
+    ``observability.export.json_snapshot`` next to the BENCH_*.json
+    outputs — strict JSON (``allow_nan=False``), so downstream scrapers
+    consume bench history with the exact parser they point at the
+    serving /metrics.json endpoint. Returns the path, or None under
+    ``PADDLE_TPU_METRICS=0`` (the kill switch writes no files)."""
+    from paddle_tpu.observability import metrics as om
+    from paddle_tpu.observability.export import json_snapshot
+
+    if not om.enabled():
+        return None
+    reg = om.MetricsRegistry()      # private: bench numbers only
+    for key, value in result.items():
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        reg.gauge(f"bench_{key}", "bench.py per-run number") \
+            .set(float(value))
+    with open(path, "w") as f:
+        json.dump(json_snapshot(reg), f, indent=2, allow_nan=False)
+    return path
 
 
 

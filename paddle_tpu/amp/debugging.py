@@ -99,14 +99,25 @@ def disable_tensor_checker():
 
 
 def check_numerics(tensor, op_name="", var_name=""):
-    """Returns (num_nan, num_inf) as int tensors-like values; prints a
-    reference-style line when anything is found."""
+    """Returns (num_nan, num_inf) as int tensors-like values. A hit
+    prints the reference-style line, increments
+    ``paddle_tpu_nan_inf_detected_total{op,var}``, and triggers the
+    crash flight recorder when one is installed (a NaN blow-up is
+    exactly the moment the recent-spans/compiles/metrics ring matters)."""
     arr = np.asarray(getattr(tensor, "_data", tensor), np.float64)
     n_nan = int(np.isnan(arr).sum())
     n_inf = int(np.isinf(arr).sum())
     if n_nan or n_inf:
         print(f"[check_numerics] op={op_name} var={var_name} "
               f"num_nan={n_nan} num_inf={n_inf}")
+        from ..observability import flight_recorder as _fr
+        from ..observability import metrics as _om
+        _om.counter("paddle_tpu_nan_inf_detected_total",
+                    "non-finite values caught by check_numerics",
+                    labelnames=("op", "var")) \
+            .labels(op_name or "(unknown)", var_name or "(unknown)").inc()
+        _fr.on_fatal("check_numerics", op=op_name, var=var_name,
+                     num_nan=n_nan, num_inf=n_inf)
     return n_nan, n_inf
 
 

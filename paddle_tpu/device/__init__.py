@@ -190,13 +190,16 @@ def _device_obj(device=None):
     return device
 
 
-def memory_stats(device=None):
+def memory_stats(device=None, live_arrays=None):
     """Raw allocator statistics for a device. On real TPU/GPU backends
     this is the PJRT allocator report (``bytes_in_use``,
     ``peak_bytes_in_use``, ``bytes_limit``, ...); where the backend does
     not report (CPU, tunneled devices), live on-device arrays are summed
     instead and the dict carries ``{"bytes_in_use": ..., "source":
-    "live_arrays"}``."""
+    "live_arrays"}``. ``live_arrays`` optionally supplies an already-
+    fetched ``jax.live_arrays()`` list so callers that walk it anyway
+    (the observability memory sampler) don't pay the enumeration
+    twice."""
     d = _device_obj(device)
     stats = None
     try:
@@ -204,9 +207,15 @@ def memory_stats(device=None):
     except Exception:
         stats = None
     if stats:
-        return dict(stats)
+        out = dict(stats)
+        # tag the provenance on BOTH paths so consumers (the
+        # observability memory sampler, dashboards) can tell an
+        # allocator-reported figure from a live-array estimate
+        out.setdefault("source", "allocator")
+        return out
+    live = jax.live_arrays() if live_arrays is None else live_arrays
     in_use = sum(
-        x.nbytes for x in jax.live_arrays()
+        x.nbytes for x in live
         if any(dd == d for dd in x.devices()))
     return {"bytes_in_use": in_use, "source": "live_arrays"}
 

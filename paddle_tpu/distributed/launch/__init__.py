@@ -90,6 +90,9 @@ def launch(script_args, nnodes=1, node_rank=0, nproc_per_node=1,
                 pending.discard(i)
                 if ret != 0 and exit_code == 0:
                     exit_code = ret
+                    from ...observability import flight_recorder as _fr
+                    _fr.on_fatal("worker_failure", local_rank=i,
+                                 exit_code=ret)
                     for j in pending:
                         procs[j].send_signal(signal.SIGTERM)
             time.sleep(0.2)
@@ -193,6 +196,13 @@ def _elastic_round(script_args, nproc, master, log_dir, env_extra,
                 if ret != 0:
                     if metrics is not None:
                         metrics["failures"].inc()
+                    # supervisor-side post-mortem of the generation: the
+                    # dead rank's own recorder (if any) dumped in its
+                    # process; this bundle captures the fleet view
+                    from ...observability import flight_recorder as _fr
+                    _fr.on_fatal("elastic_worker_failure", rank=i,
+                                 exit_code=ret, restarts=restarts,
+                                 world=world)
                     if exit_code == 0:
                         exit_code = ret
             if exit_code and manager.watch_once() == "scale_down":

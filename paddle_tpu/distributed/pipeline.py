@@ -53,7 +53,8 @@ def stack_stage_params(param_trees):
 
 
 def pipeline_spmd(stage_fn, stacked_params, x, *, mesh, axis="pp",
-                  num_microbatches, remat=False, num_virtual_stages=1):
+                  num_microbatches, remat=False, num_virtual_stages=1,
+                  watch_name="distributed.pipeline_spmd"):
     """Run ``stage_fn`` as a P-stage pipeline over ``num_microbatches``.
 
     Args:
@@ -70,6 +71,9 @@ def pipeline_spmd(stage_fn, stacked_params, x, *, mesh, axis="pp",
         remat: checkpoint each stage application (1F1B-like memory:
             activations recompute in the backward sweep instead of M
             microbatches of them being stored).
+        watch_name: compile-watch label for this pipeline's programs
+            (callers owning a model, e.g. ``LlamaForCausalLMPipe``, pass
+            their own so compile metrics attribute to the model).
         num_virtual_stages: V > 1 runs the interleaved (VPP) schedule of
             the reference's ``PipelineParallelWithInterleave``
             (`pipeline_parallel.py:987`): layer chunk ``c`` lives on
@@ -104,12 +108,14 @@ def pipeline_spmd(stage_fn, stacked_params, x, *, mesh, axis="pp",
             [np.arange((v * P + d) * lpc, (v * P + d + 1) * lpc)
              for d in range(P) for v in range(V)])
         flat = [p[order] for p in flat]
-    run = _build_run(stage_fn, jmesh, axis, M, bool(remat), treedef, V)
+    run = _build_run(stage_fn, jmesh, axis, M, bool(remat), treedef, V,
+                     watch_name)
     return run(tuple(flat), x)
 
 
 @functools.lru_cache(maxsize=64)
-def _build_run(stage_fn, jmesh, axis, M, remat, treedef, V=1):
+def _build_run(stage_fn, jmesh, axis, M, remat, treedef, V=1,
+               watch_name="distributed.pipeline_spmd"):
     """One jitted pipeline program per (stage_fn, mesh, schedule) config —
     shard_map must live under jit (remat inside eager shard_map is
     unsupported), and the cache keeps eager steps from re-lowering."""
@@ -207,7 +213,6 @@ def _build_run(stage_fn, jmesh, axis, M, remat, treedef, V=1):
                       in_specs=(p_spec, PartitionSpec()),
                       out_specs=PartitionSpec(), check_rep=False)
 
-    @jax.jit
     def run(flat_params, x):
         params = jax.tree_util.tree_unflatten(treedef, list(flat_params))
         B = x.shape[0]
@@ -215,7 +220,8 @@ def _build_run(stage_fn, jmesh, axis, M, remat, treedef, V=1):
         y = inner(params, xm)
         return y.reshape((B,) + y.shape[2:])
 
-    return run
+    from ..observability.compile_watch import watched_jit
+    return watched_jit(run, name=watch_name)
 
 
 def pipeline_1f1b(stage_fn, loss_fn, stacked_params, x, y, *, mesh,
@@ -352,7 +358,6 @@ def _build_1f1b(stage_fn, loss_fn, jmesh, axis, M, treedef):
                       out_specs=(PartitionSpec(), p_spec),
                       check_rep=False)
 
-    @jax.jit
     def run(flat_params, x, y):
         params = jax.tree_util.tree_unflatten(treedef, list(flat_params))
         B = x.shape[0]
@@ -360,4 +365,5 @@ def _build_1f1b(stage_fn, loss_fn, jmesh, axis, M, treedef):
         ym = y.reshape((M, B // M) + y.shape[1:])
         return inner(params, xm, ym)
 
-    return run
+    from ..observability.compile_watch import watched_jit
+    return watched_jit(run, name="distributed.pipeline_1f1b")

@@ -103,6 +103,12 @@ class StepWatchdog:
                 self._fired = True
                 self.timeouts += 1
                 self._m_timeouts.inc()
+                # post-mortem BEFORE the user callback / abort: a hung
+                # rank's last spans, compiles, and metrics are exactly
+                # what the stall diagnosis needs
+                from ..observability import flight_recorder as _fr
+                _fr.on_fatal(f"watchdog_timeout:{self.name}",
+                             gap_seconds=gap, timeout=self.timeout)
                 if self.on_timeout is not None:
                     self.on_timeout(gap)
                 if self.abort:

@@ -113,7 +113,8 @@ class Model:
             return loss, outputs
 
         if self._jit:
-            return jit_mod.to_static(step, state=[net, opt])
+            return jit_mod.to_static(step, state=[net, opt],
+                                     name="hapi.train_step")
         return step
 
     def train_batch(self, inputs, labels=None):

@@ -202,6 +202,11 @@ class MetricsCallback(Callback):
       ``train_mfu`` gauge as ``train_flops_multiplier * flops_per_sample
       * batch_size / step_time / peak_flops`` (the multiplier defaults
       to 3.0 — forward + backward ~= 2x forward).
+    - ``sample_memory`` (default True): per-step device-memory gauges
+      (``paddle_tpu_device_bytes_in_use`` / ``..._live_array_bytes``,
+      see ``observability.compile_watch.sample_device_memory``) plus a
+      rate-limited flight-recorder metrics snapshot — host metadata
+      walks only, no device sync.
 
     Metric names: ``train_steps_total``, ``train_step_seconds``,
     ``train_ips``, ``train_mfu``, ``train_loss``.
@@ -213,10 +218,13 @@ class MetricsCallback(Callback):
 
     def __init__(self, batch_size=None, flops_per_sample=None,
                  input_size=None, peak_flops=None,
-                 train_flops_multiplier=3.0, registry=None):
+                 train_flops_multiplier=3.0, registry=None,
+                 sample_memory=True):
         super().__init__()
         from ..observability import metrics as om
         reg = registry if registry is not None else om.default_registry()
+        self.sample_memory = bool(sample_memory)
+        self._registry = registry
         self.batch_size = batch_size
         self.flops_per_sample = flops_per_sample
         self.input_size = input_size
@@ -262,6 +270,12 @@ class MetricsCallback(Callback):
                 achieved = (self.train_flops_multiplier
                             * self.flops_per_sample * self.batch_size / dt)
                 self._mfu.set(achieved / self.peak_flops)
+        if self.sample_memory:
+            from ..observability import compile_watch, flight_recorder
+            if compile_watch.enabled():
+                compile_watch.sample_device_memory(self._registry,
+                                                   min_interval=1.0)
+                flight_recorder.periodic_snapshot()
 
 
 class LRScheduler(Callback):

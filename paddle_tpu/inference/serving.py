@@ -43,6 +43,8 @@ import numpy as np
 from ..framework.tensor import Tensor, no_grad, run_op
 from ..incubate.nn import functional as FI
 from ..nn import functional as F
+from ..observability import compile_watch as _cw
+from ..observability import flight_recorder as _fr
 from ..observability import metrics as _om
 from ..observability.trace import span as _span
 from ..ops.paged_attention import paged_attention
@@ -86,6 +88,33 @@ def _serving_metrics():
         "generated": _om.counter(
             "serving_generated_tokens_total", "tokens emitted by decode"),
     }
+
+
+def _fatal_guard(origin):
+    """Decorator: a crash inside an engine entry point dumps a
+    flight-recorder post-mortem (when one is installed) before the
+    exception reaches the caller — the serving analog of a rank dying
+    under the elastic watchdog. Each exception dumps at most once."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            try:
+                return fn(*args, **kwargs)
+            except MemoryError:
+                # admission control (engine full / KV pages exhausted)
+                # raises MemoryError as a ROUTINE rejection — already
+                # counted by the evicted metric; it must not burn the
+                # recorder's bounded dump budget. A real device OOM
+                # surfaces as XlaRuntimeError and still dumps.
+                raise
+            except Exception as e:
+                _fr.on_fatal(origin, e)
+                raise
+        return wrapper
+
+    return deco
 
 
 def _page_write(pages, new, page_ids, offs):
@@ -222,6 +251,7 @@ class LlamaServingEngine:
 
     PREFILL_BUCKET = 32
 
+    @_fatal_guard("serving.prefill_wave")
     def _prefill_wave(self, reqs):
         """Prefill 1..max_batch admitted requests in ONE compiled call."""
         if not reqs:
@@ -250,7 +280,7 @@ class LlamaServingEngine:
             # page writes
             self._prefill_static = StaticFunction(
                 self._prefill_forward, state=[self.model], warmup="once",
-                donate_inputs=True)
+                donate_inputs=True, name="serving.prefill")
             self._prefill_static._warmed_any = True
         if self._m["ttft"] is not _om.NULL \
                 and bucket not in self._prefill_warm_buckets:
@@ -346,6 +376,13 @@ class LlamaServingEngine:
         self._m["queue_depth"].set(len(self._live))
         self._m["kv_util"].set(
             1.0 - self.alloc.free_pages / self.alloc.num_pages)
+        if _om.enabled():
+            # per-wave device-memory accounting (host metadata walks
+            # only, no sync), throttled so the live-array enumeration
+            # never rides the per-token decode path, + a rate-limited
+            # flight-recorder snapshot
+            _cw.sample_device_memory(min_interval=1.0)
+            _fr.periodic_snapshot()
 
     def _admit(self, req):
         if len(self._live) >= self.max_batch:
@@ -404,9 +441,11 @@ class LlamaServingEngine:
         if self._decode_static is None:
             from .. import jit
             self._decode_static = jit.to_static(
-                self._decode_step, state=[self.model], warmup="once")
+                self._decode_step, state=[self.model], warmup="once",
+                name="serving.decode_step")
         return self._decode_static
 
+    @_fatal_guard("serving.step")
     def step(self):
         """Decode one token for every live request. Returns the number of
         live requests served."""
@@ -480,7 +519,8 @@ class LlamaServingEngine:
 
             sf = StaticFunction(self._decode_burst_fn(n),
                                 state=[self.model], warmup="once",
-                                donate_inputs=True)
+                                donate_inputs=True,
+                                name=f"serving.decode_burst[{n}]")
             # no lazy state to materialize (params exist; no optimizer):
             # skip the eager warmup — n scanned steps of per-op dispatch
             # would cost more than the compile it avoids
@@ -488,6 +528,7 @@ class LlamaServingEngine:
             self._burst_static[n] = sf
         return sf
 
+    @_fatal_guard("serving.burst")
     def _burst(self, n):
         """Decode ``n`` tokens for every live request in one dispatch.
         Pages for all n tokens are reserved up front; requests that
@@ -582,6 +623,7 @@ class LlamaServingEngine:
                 n -= 1
         return served
 
+    @_fatal_guard("serving.generate")
     def generate(self, prompts, max_new_tokens=16, eos_token_id=None):
         """Convenience batch API: admit all prompts (continuous batching
         handles ragged finish times), run to completion, return output id

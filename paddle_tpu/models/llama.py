@@ -406,7 +406,8 @@ class LlamaForCausalLM(nn.Layer):
                 return self._decode_step(tokens, cache_len, caches,
                                          rng_key, sampler)
             self._decode_static = jit.StaticFunction(
-                step_fn, state=[self], warmup="once", donate_inputs=True)
+                step_fn, state=[self], warmup="once", donate_inputs=True,
+                name="llama.generate_step")
             self._decode_param_key = param_key
         step = self._decode_static
         base_key = jax.random.key(seed) if seed is not None \

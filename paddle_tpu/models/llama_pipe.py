@@ -151,7 +151,8 @@ class LlamaForCausalLMPipe(nn.Layer):
         def pipe(*arrays):
             params = dict(zip(_PARAM_KEYS, arrays[:-1]))
             return pipeline_spmd(stage_fn, params, arrays[-1], mesh=mesh,
-                                 axis=axis, num_microbatches=M, remat=remat)
+                                 axis=axis, num_microbatches=M, remat=remat,
+                                 watch_name="llama_pipe.pipeline")
 
         return pipe
 

@@ -2,12 +2,19 @@
 
 The measurement substrate for the serving engine, elastic launcher, and
 training loop: a thread-safe metric registry (`metrics`), a host-span
-tracer with chrome-trace export (`trace`), and Prometheus/JSON/HTTP
-exporters (`export`). ``PADDLE_TPU_METRICS=0`` turns the whole layer
-into no-ops. See README "Observability" for the standard metric names.
+tracer with chrome-trace export (`trace`), Prometheus/JSON/HTTP
+exporters (`export`), the XLA compile watcher + device-memory gauges
+(`compile_watch`), and the crash flight recorder (`flight_recorder`).
+``PADDLE_TPU_METRICS=0`` turns the whole layer into no-ops. See README
+"Observability" for the standard metric names.
 """
 
-from . import export, metrics, trace  # noqa: F401
+from . import (  # noqa: F401
+    compile_watch, export, flight_recorder, metrics, trace,
+)
+from .compile_watch import (  # noqa: F401
+    sample_device_memory, watch, watched_jit,
+)
 from .export import (  # noqa: F401
     json_snapshot, prometheus_text, snapshot_to_prometheus,
     start_http_server,
@@ -19,10 +26,11 @@ from .metrics import (  # noqa: F401
 from .trace import export_chrome_trace, span  # noqa: F401
 
 __all__ = [
-    "metrics", "trace", "export",
+    "metrics", "trace", "export", "compile_watch", "flight_recorder",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "counter", "gauge", "histogram", "default_registry", "enabled",
     "span", "export_chrome_trace",
     "prometheus_text", "json_snapshot", "snapshot_to_prometheus",
     "start_http_server",
+    "watch", "watched_jit", "sample_device_memory",
 ]

@@ -6,15 +6,18 @@
   text output round-trips exactly through the JSON snapshot layer
   (serialize, ship, re-render identically on another host).
 - :func:`start_http_server` — an optional stdlib ``http.server`` scrape
-  endpoint (``/metrics`` text, ``/metrics.json`` snapshot) for the
-  serving engine; returns a handle with ``.port`` / ``.url`` / ``.stop``.
+  endpoint (``/metrics`` text + HEAD, ``/metrics.json`` snapshot,
+  ``/healthz`` liveness probe) for the serving engine; returns a handle
+  with ``.port`` / ``.url`` / ``.stop``.
 """
 
 from __future__ import annotations
 
 import json
 import math
+import os
 import threading
+import time
 
 from .metrics import default_registry
 
@@ -131,29 +134,52 @@ class ScrapeServer:
 
 
 def start_http_server(port=0, addr="127.0.0.1", registry=None):
-    """Serve ``/metrics`` (Prometheus text) and ``/metrics.json`` on a
-    daemon thread; ``port=0`` picks a free port. Returns
-    :class:`ScrapeServer`."""
+    """Serve ``/metrics`` (Prometheus text; HEAD supported for cheap
+    reachability checks), ``/metrics.json``, and ``/healthz`` (200 +
+    uptime/pid JSON — the liveness probe serving deployments point at
+    the same port) on a daemon thread; ``port=0`` picks a free port.
+    Returns :class:`ScrapeServer`."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry if registry is not None else default_registry()
+    t_start = time.monotonic()
 
     class Handler(BaseHTTPRequestHandler):
-        def do_GET(self):
+        def _payload(self):
+            """(body, content-type) for the path, or None -> 404."""
             if self.path in ("/", "/metrics"):
-                body = prometheus_text(reg).encode()
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
-            elif self.path == "/metrics.json":
-                body = json.dumps(json_snapshot(reg)).encode()
-                ctype = "application/json"
-            else:
+                return (prometheus_text(reg).encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+            if self.path == "/metrics.json":
+                return (json.dumps(json_snapshot(reg)).encode(),
+                        "application/json")
+            if self.path == "/healthz":
+                doc = {"status": "ok", "pid": os.getpid(),
+                       "uptime_seconds": round(
+                           time.monotonic() - t_start, 3)}
+                return json.dumps(doc).encode(), "application/json"
+            return None
+
+        def _respond(self, head_only):
+            payload = self._payload()
+            if payload is None:
                 self.send_error(404)
                 return
+            body, ctype = payload
             self.send_response(200)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
-            self.wfile.write(body)
+            if not head_only:
+                self.wfile.write(body)
+
+        def do_GET(self):
+            self._respond(head_only=False)
+
+        def do_HEAD(self):
+            # probes use HEAD to skip the body; the full text is still
+            # rendered so Content-Length matches a subsequent GET
+            self._respond(head_only=True)
 
         def log_message(self, *args):  # scrapes must not spam stderr
             pass

@@ -15,6 +15,7 @@ makes ``span`` a no-op and records nothing.
 from __future__ import annotations
 
 import functools
+import itertools
 import json
 import os
 import threading
@@ -24,7 +25,7 @@ from collections import deque
 from .metrics import enabled
 
 __all__ = ["span", "TraceBuffer", "default_buffer", "get_events", "clear",
-           "export_chrome_trace"]
+           "export_chrome_trace", "unique_run_name"]
 
 #: process epoch — span timestamps are microseconds since this point
 _EPOCH = time.perf_counter()
@@ -127,13 +128,26 @@ class span:
         return wrapper
 
 
+#: per-process run sequence: two runs within one strftime second must
+#: not collide on the run dir and silently overwrite each other
+_RUN_SEQ = itertools.count()
+
+
+def unique_run_name():
+    """Collision-proof run-directory name: wall-clock timestamp plus a
+    pid + per-process monotonic suffix (shared by chrome-trace exports
+    and flight-recorder bundles)."""
+    return (f"{time.strftime('%Y_%m_%d_%H_%M_%S')}"
+            f"_pid{os.getpid()}_{next(_RUN_SEQ)}")
+
+
 def export_chrome_trace(dir_name, worker_name=None, buffer=None):
     """Write buffered spans as chrome-trace JSON into the profiler's
     output layout: ``<dir_name>/plugins/profile/<run>/<worker>.
     host_spans.trace.json``. Returns the written path."""
     # explicit None-check: an empty TraceBuffer is falsy (__len__)
     buf = buffer if buffer is not None else _default_buffer
-    run = time.strftime("%Y_%m_%d_%H_%M_%S")
+    run = unique_run_name()
     out_dir = os.path.join(dir_name, "plugins", "profile", run)
     os.makedirs(out_dir, exist_ok=True)
     worker = worker_name or f"host_{os.getpid()}"

@@ -37,12 +37,21 @@ class ProfilerTarget:
 def export_chrome_tracing(dir_name, worker_name=None):
     """Returns an on_trace_ready handler that keeps traces under
     ``dir_name`` (reference profiler.py export_chrome_tracing). The JAX
-    profiler already writes chrome json; the handler reports its path."""
+    profiler already writes chrome json; the handler reports its paths —
+    only from runs created by THIS profiler session. ``dir_name`` is a
+    long-lived log directory, so a bare glob would resurrect every run
+    any previous session ever wrote there; runs present at ``start()``
+    (recorded in ``prof._preexisting_runs``) are excluded."""
 
     def handle(prof):
-        prof._last_chrome_traces = sorted(glob.glob(
-            os.path.join(dir_name, "plugins", "profile", "*",
-                         "*.trace.json.gz")))
+        stale = getattr(prof, "_preexisting_runs", set())
+        prof._last_chrome_traces = sorted(
+            trace
+            for run in glob.glob(
+                os.path.join(dir_name, "plugins", "profile", "*"))
+            if run not in stale
+            for trace in glob.glob(
+                os.path.join(run, "*.trace.json.gz")))
         return prof._last_chrome_traces
 
     handle._log_dir = dir_name
@@ -89,6 +98,7 @@ class Profiler:
         self._step_times = []
         self._t0 = None
         self._last_chrome_traces = []
+        self._preexisting_runs = set()
 
     # -- lifecycle -----------------------------------------------------------
     def _want_trace(self, step):
@@ -109,6 +119,11 @@ class Profiler:
 
     def start(self):
         self._t0 = time.perf_counter()
+        # snapshot the runs already under the log dir: on_trace_ready
+        # handlers report only runs this session creates, not a previous
+        # session's leftovers
+        self._preexisting_runs = set(glob.glob(
+            os.path.join(self._log_dir, "plugins", "profile", "*")))
         self._set_tracing(self._want_trace(self._steps))
         return self
 

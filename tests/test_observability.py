@@ -11,6 +11,8 @@ serving path produces byte-identical outputs and registers no metrics.
 """
 
 import json
+import os
+import sys
 import threading
 import time
 import urllib.request
@@ -20,7 +22,9 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import observability as obs
+from paddle_tpu.observability import compile_watch as ocw
 from paddle_tpu.observability import export as oexport
+from paddle_tpu.observability import flight_recorder as ofr
 from paddle_tpu.observability import metrics as om
 from paddle_tpu.observability import trace as otrace
 
@@ -29,9 +33,22 @@ from paddle_tpu.observability import trace as otrace
 def _fresh_default_registry():
     om.default_registry().clear()
     otrace.clear()
+    ocw.reset()
+    ofr.uninstall()
     yield
     om.default_registry().clear()
     otrace.clear()
+    ocw.reset()
+    ofr.uninstall()
+
+
+def _strict_loads(text):
+    """json.loads that rejects the non-standard Infinity/NaN literals —
+    the parser profile of jq / Go / JSON.parse."""
+    def _reject(value):
+        raise ValueError(f"non-strict JSON constant {value!r}")
+
+    return json.loads(text, parse_constant=_reject)
 
 
 # ---------------------------------------------------------------------------
@@ -552,3 +569,485 @@ class TestAmpWatchdogIntegration:
         age = om.default_registry().get("watchdog_heartbeat_age_seconds")
         assert age.labels("stalled").value == 40.0
         assert age.labels(healthy.name).value == 0.0
+
+
+# ---------------------------------------------------------------------------
+# compile watcher (ISSUE 2 tentpole)
+# ---------------------------------------------------------------------------
+def _tiny_train_step(name, hidden=8):
+    """A to_static-compiled SGD step over a tiny MLP + a batch factory."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu import jit
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, hidden), nn.ReLU(),
+                        nn.Linear(hidden, 2))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    def step(x, y):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    sf = jit.to_static(step, state=[net, opt], name=name)
+    rng = np.random.RandomState(0)
+
+    def batch(b):
+        return (paddle.to_tensor(rng.randn(b, 4).astype("float32")),
+                paddle.to_tensor(rng.randint(0, 2, (b,)).astype("int64")))
+
+    return sf, batch
+
+
+class TestCompileWatch:
+    def test_same_shape_loop_compiles_exactly_once(self):
+        sf, batch = _tiny_train_step("cw.same_shape")
+        x, y = batch(8)
+        for _ in range(3):
+            sf(x, y)
+        reg = om.default_registry()
+        assert reg.get("paddle_tpu_xla_compile_total") \
+            .labels("cw.same_shape").value == 1
+        assert reg.get("paddle_tpu_xla_distinct_signatures") \
+            .labels("cw.same_shape").value == 1
+        assert reg.get("paddle_tpu_xla_compile_seconds") \
+            .labels("cw.same_shape").count == 1
+        # zero recompile-storm events: the family is never even created
+        storms = reg.get("paddle_tpu_xla_recompile_storm_total")
+        assert storms is None or storms.labels("cw.same_shape").value == 0
+        # static program analysis gauges are populated
+        assert reg.get("paddle_tpu_xla_program_flops") \
+            .labels("cw.same_shape").value > 0
+        assert reg.get("paddle_tpu_xla_program_bytes_accessed") \
+            .labels("cw.same_shape").value > 0
+        # the process-wide backend tally saw (at least) this compile
+        assert reg.get("paddle_tpu_xla_backend_compile_total").value >= 1
+
+    def test_recompile_storm_names_churning_arg(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_RECOMPILE_STORM_SIGS", "2")
+        sf, batch = _tiny_train_step("cw.churn")
+        batches = {b: batch(b) for b in (2, 3, 4, 5)}
+        for _ in range(2):          # pass 1 warms eagerly, pass 2 compiles
+            for b in (2, 3, 4, 5):
+                sf(*batches[b])
+        reg = om.default_registry()
+        assert reg.get("paddle_tpu_xla_compile_total") \
+            .labels("cw.churn").value == 4
+        assert reg.get("paddle_tpu_xla_recompile_storm_total") \
+            .labels("cw.churn").value >= 1
+        diag = ocw.watch("cw.churn").last_diagnosis
+        assert diag is not None and "cw.churn" in diag
+        assert "arg0" in diag and "float32[2,4]" in diag
+
+    def test_disabled_leaves_jit_cache_untouched(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_METRICS", "0")
+        sf, batch = _tiny_train_step("cw.ghost")
+        x, y = batch(8)
+        for _ in range(3):
+            sf(x, y)
+        # the jit cache holds the plain jitted entry; no AOT executables,
+        # no signature state, no registered metrics
+        assert len(sf._cache) == 1
+        assert sf._aot == {}
+        assert om.default_registry().collect() == []
+        assert ocw.watch("cw.ghost") is ocw.NULL_WATCH
+
+    def test_watched_jit_counts_per_signature(self):
+        import jax.numpy as jnp
+
+        calls = []
+
+        def f(x):
+            calls.append(1)
+            return x * 2
+
+        g = obs.watched_jit(f, name="cw.watched")
+        a = jnp.ones((3,))
+        np.testing.assert_allclose(np.asarray(g(a)), 2 * np.ones(3))
+        g(a)                       # same signature: cached executable
+        g(jnp.ones((4,)))          # new signature: second compile
+        reg = om.default_registry()
+        assert reg.get("paddle_tpu_xla_compile_total") \
+            .labels("cw.watched").value == 2
+        assert reg.get("paddle_tpu_xla_distinct_signatures") \
+            .labels("cw.watched").value == 2
+
+    def test_watched_jit_scalars_key_on_type_not_value(self):
+        import jax.numpy as jnp
+
+        g = obs.watched_jit(lambda x, lr: x * lr, name="cw.scalar")
+        a = jnp.ones((3,))
+        np.testing.assert_allclose(np.asarray(g(a, 0.5)), 0.5 * np.ones(3))
+        np.testing.assert_allclose(np.asarray(g(a, 0.25)),
+                                   0.25 * np.ones(3))
+        g(a, 0.125)
+        # jax.jit compiles once per scalar TYPE; a changing learning
+        # rate must not AOT-compile a program per value
+        assert om.default_registry() \
+            .get("paddle_tpu_xla_compile_total") \
+            .labels("cw.scalar").value == 1
+
+    def test_watched_jit_keys_on_binding_structure(self):
+        import jax.numpy as jnp
+
+        g = obs.watched_jit(lambda x, s: x * s, name="cw.binding")
+        a = jnp.ones((3,))
+        r1 = np.asarray(g(a, jnp.asarray(2.0)))       # positional
+        r2 = np.asarray(g(a, s=jnp.asarray(3.0)))     # keyword binding
+        np.testing.assert_allclose(r1, 2.0)
+        np.testing.assert_allclose(r2, 3.0)           # not the stale exe
+        # distinct pytree structures are distinct signatures, and both
+        # stay on the watched AOT path (2 compiles, not a fallback)
+        assert om.default_registry() \
+            .get("paddle_tpu_xla_compile_total") \
+            .labels("cw.binding").value == 2
+
+    def test_watched_jit_static_args_count_without_double_compile(self):
+        import jax.numpy as jnp
+
+        calls = []
+
+        def f(x, n):
+            calls.append(1)
+            return x * n
+
+        g = obs.watched_jit(f, name="cw.static", static_argnums=1)
+        a = jnp.ones((3,))
+        np.testing.assert_allclose(np.asarray(g(a, 2)), 2.0)
+        np.testing.assert_allclose(np.asarray(g(a, 2)), 2.0)
+        np.testing.assert_allclose(np.asarray(g(a, 3)), 3.0)
+        reg = om.default_registry()
+        # one compile per distinct static value — and one TRACE per
+        # program (a discarded AOT attempt would have traced f twice)
+        assert reg.get("paddle_tpu_xla_compile_total") \
+            .labels("cw.static").value == 2
+        assert len(calls) == 2
+
+    def test_watched_jit_disabled_is_plain_jit(self, monkeypatch):
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("PADDLE_TPU_METRICS", "0")
+        g = obs.watched_jit(lambda x: x + 1, name="cw.plain")
+        np.testing.assert_allclose(np.asarray(g(jnp.zeros(2))), np.ones(2))
+        assert om.default_registry().collect() == []
+
+    def test_sample_device_memory_gauges(self):
+        import jax.numpy as jnp
+
+        keep = jnp.ones((64, 64), jnp.float32)   # noqa: F841  live bytes
+        sample = obs.sample_device_memory()
+        assert sample["live_array_count"] >= 1
+        assert sample["live_array_bytes"] >= keep.nbytes
+        reg = om.default_registry()
+        assert reg.get("paddle_tpu_live_array_bytes").value \
+            >= keep.nbytes
+        assert reg.get("paddle_tpu_device_bytes_in_use").value >= 0
+        assert reg.get("paddle_tpu_device_peak_bytes_in_use").value \
+            >= reg.get("paddle_tpu_device_bytes_in_use").value * 0
+
+    def test_sample_device_memory_disabled(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_METRICS", "0")
+        assert obs.sample_device_memory() is None
+        assert om.default_registry().collect() == []
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (ISSUE 2 tentpole)
+# ---------------------------------------------------------------------------
+def _bundle_dirs(log_dir):
+    root = os.path.join(str(log_dir), "postmortem")
+    if not os.path.isdir(root):
+        return []
+    return sorted(os.path.join(root, d) for d in os.listdir(root))
+
+
+class TestFlightRecorder:
+    def test_dump_bundle_is_loadable(self, tmp_path):
+        import jax.numpy as jnp
+
+        rec = ofr.install(log_dir=str(tmp_path))
+        with obs.span("fr.work", step=1):
+            pass
+        # the blow-up case the recorder exists for: a NaN span arg (and
+        # an unserializable one) must not make trace.json unloadable
+        with obs.span("fr.nan", loss=float("nan"), cfg=object()):
+            pass
+        g = obs.watched_jit(lambda x: x * 3, name="fr.compiled")
+        g(jnp.ones((2,)))
+        om.counter("fr_steps_total").inc(5)
+        rec.note_snapshot(force=True)
+        out = ofr.dump(reason="unit-test")
+        assert out is not None and os.path.isdir(out)
+        # chrome trace: spans AND compile events, Perfetto-loadable JSON
+        with open(os.path.join(out, "trace.json")) as f:
+            doc = _strict_loads(f.read())
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "fr.work" in names
+        assert any(n.startswith("xla_compile:fr.compiled") for n in names)
+        assert all({"ph", "ts"} <= set(e) for e in doc["traceEvents"])
+        (nan_ev,) = [e for e in doc["traceEvents"]
+                     if e["name"] == "fr.nan"]
+        assert nan_ev["args"]["loss"] == "NaN"     # marker, not bare NaN
+        # metrics snapshot: strict JSON, round-trips, carries the counter
+        with open(os.path.join(out, "metrics.json")) as f:
+            metrics_doc = _strict_loads(f.read())
+        snap_names = {e["name"] for e in metrics_doc["snapshot"]}
+        assert "fr_steps_total" in snap_names
+        assert len(metrics_doc["history"]) == 1
+        # compile log + env
+        with open(os.path.join(out, "compile_log.txt")) as f:
+            assert "fr.compiled" in f.read()
+        with open(os.path.join(out, "env.json")) as f:
+            env_doc = _strict_loads(f.read())
+        assert env_doc["reason"] == "unit-test"
+        assert env_doc["pid"] == os.getpid()
+
+    def test_excepthook_dumps_and_chains(self, tmp_path):
+        seen = []
+        prev = sys.excepthook
+        sys.excepthook = lambda *a: seen.append(a)
+        try:
+            ofr.install(log_dir=str(tmp_path))
+            try:
+                raise RuntimeError("mid-step crash")
+            except RuntimeError:
+                sys.excepthook(*sys.exc_info())   # what the interpreter does
+        finally:
+            ofr.uninstall()
+            sys.excepthook = prev
+        assert len(seen) == 1                     # chained to the prior hook
+        (bundle,) = _bundle_dirs(tmp_path)
+        with open(os.path.join(bundle, "error.txt")) as f:
+            assert "mid-step crash" in f.read()
+        with open(os.path.join(bundle, "metrics.json")) as f:
+            _strict_loads(f.read())               # strict-JSON round-trip
+
+    def test_exception_mid_train_step_leaves_bundle(self, tmp_path):
+        ofr.install(log_dir=str(tmp_path))
+        sf, batch = _tiny_train_step("fr.train")
+        x, y = batch(8)
+        sf(x, y)
+        sf(x, y)                                  # compiled steady state
+        try:
+            raise MemoryError("RESOURCE_EXHAUSTED: OOM mid-step")
+        except MemoryError:
+            sys.excepthook(*sys.exc_info())
+        (bundle,) = _bundle_dirs(tmp_path)
+        with open(os.path.join(bundle, "trace.json")) as f:
+            doc = _strict_loads(f.read())
+        assert any(e["name"] == "xla_compile:fr.train"
+                   for e in doc["traceEvents"])
+        with open(os.path.join(bundle, "metrics.json")) as f:
+            metrics_doc = _strict_loads(f.read())
+        names = {e["name"] for e in metrics_doc["snapshot"]}
+        assert "paddle_tpu_xla_compile_total" in names
+
+    def test_exception_dumped_once_across_nested_paths(self, tmp_path):
+        ofr.install(log_dir=str(tmp_path))
+        err = RuntimeError("boom")
+        assert ofr.on_fatal("serving.step", err) is not None
+        assert ofr.on_fatal("serving.generate", err) is None
+        assert len(_bundle_dirs(tmp_path)) == 1
+        # a storm of DISTINCT exceptions from one origin (a too-large
+        # prompt rejected per request) is rate-limited per origin — it
+        # must not burn the dump budget
+        assert ofr.on_fatal("serving.step", RuntimeError("again")) is None
+        assert len(_bundle_dirs(tmp_path)) == 1
+
+    def test_disabled_is_noop_no_files(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_METRICS", "0")
+        assert ofr.install(log_dir=str(tmp_path)) is None
+        assert ofr.dump(reason="nope") is None
+        assert ofr.on_fatal("nope") is None
+        assert not os.path.exists(os.path.join(str(tmp_path),
+                                               "postmortem"))
+
+    def test_serving_fatal_path_dumps(self, tmp_path, model):
+        from paddle_tpu.inference.serving import (LlamaServingEngine,
+                                                  Request)
+
+        ofr.install(log_dir=str(tmp_path))
+        engine = LlamaServingEngine(model, max_batch=2, page_size=8,
+                                    num_pages=16)
+        engine.add_request(Request([1, 2, 3], max_new_tokens=4))
+
+        def explode():
+            raise RuntimeError("decode died")
+
+        engine._ensure_decode_compiled = explode
+        with pytest.raises(RuntimeError, match="decode died"):
+            engine.step()
+        (bundle,) = _bundle_dirs(tmp_path)
+        with open(os.path.join(bundle, "env.json")) as f:
+            assert _strict_loads(f.read())["reason"] == "serving.step"
+
+    def test_watchdog_timeout_dumps(self, tmp_path):
+        from paddle_tpu.distributed.watchdog import StepWatchdog
+
+        ofr.install(log_dir=str(tmp_path))
+        fired = []
+        with StepWatchdog(timeout=0.05, poll=0.02,
+                          on_timeout=fired.append):
+            time.sleep(0.3)
+        assert fired
+        bundles = _bundle_dirs(tmp_path)
+        assert len(bundles) >= 1
+        with open(os.path.join(bundles[0], "env.json")) as f:
+            doc = _strict_loads(f.read())
+        assert doc["reason"].startswith("watchdog_timeout:")
+        assert doc["info"]["gap_seconds"] > 0.05
+
+    def test_check_numerics_counter_and_dump(self, tmp_path):
+        from paddle_tpu.amp.debugging import check_numerics
+
+        ofr.install(log_dir=str(tmp_path))
+        bad = paddle.to_tensor(np.asarray([1.0, np.nan, np.inf],
+                                          "float32"))
+        n_nan, n_inf = check_numerics(bad, op_name="matmul",
+                                      var_name="out")
+        assert (n_nan, n_inf) == (1, 1)
+        reg = om.default_registry()
+        assert reg.get("paddle_tpu_nan_inf_detected_total") \
+            .labels("matmul", "out").value == 1
+        (bundle,) = _bundle_dirs(tmp_path)
+        with open(os.path.join(bundle, "env.json")) as f:
+            doc = _strict_loads(f.read())
+        assert doc["reason"] == "check_numerics"
+        assert doc["info"]["num_nan"] == 1
+        # a clean tensor neither counts nor dumps
+        check_numerics(paddle.to_tensor(np.ones(3, "float32")),
+                       op_name="matmul", var_name="out")
+        assert reg.get("paddle_tpu_nan_inf_detected_total") \
+            .labels("matmul", "out").value == 1
+        assert len(_bundle_dirs(tmp_path)) == 1
+        # a NaN storm (more hits within the per-origin interval) keeps
+        # counting but must not burn the dump budget on duplicates
+        check_numerics(bad, op_name="softmax", var_name="probs")
+        assert reg.get("paddle_tpu_nan_inf_detected_total") \
+            .labels("softmax", "probs").value == 1
+        assert len(_bundle_dirs(tmp_path)) == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions: exporter health endpoint, trace run collisions,
+# profiler stale runs, bench snapshot
+# ---------------------------------------------------------------------------
+class TestSatellites:
+    def test_healthz_and_head_support(self):
+        r = _demo_registry()
+        srv = oexport.start_http_server(port=0, registry=r)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(f"{base}/healthz") as resp:
+                doc = _strict_loads(resp.read().decode())
+            assert doc["status"] == "ok"
+            assert doc["pid"] == os.getpid()
+            assert doc["uptime_seconds"] >= 0
+            # HEAD /metrics: headers only, Content-Length matches GET
+            get_body = urllib.request.urlopen(f"{base}/metrics").read()
+            head = urllib.request.Request(f"{base}/metrics",
+                                          method="HEAD")
+            with urllib.request.urlopen(head) as resp:
+                assert resp.status == 200
+                assert int(resp.headers["Content-Length"]) \
+                    == len(get_body)
+                assert resp.read() == b""
+            head404 = urllib.request.Request(f"{base}/nope",
+                                             method="HEAD")
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(head404)
+        finally:
+            srv.stop()
+
+    def test_chrome_trace_exports_never_collide(self, tmp_path):
+        with obs.span("one"):
+            pass
+        # two exports inside the same strftime second must land in two
+        # run dirs (the old second-granularity name silently overwrote)
+        p1 = obs.export_chrome_trace(str(tmp_path), worker_name="w")
+        p2 = obs.export_chrome_trace(str(tmp_path), worker_name="w")
+        assert p1 != p2
+        assert os.path.exists(p1) and os.path.exists(p2)
+
+    def test_profiler_reports_only_this_sessions_runs(self, tmp_path):
+        from paddle_tpu import profiler as prof_mod
+
+        # a leftover run from a "previous session"
+        stale_run = os.path.join(str(tmp_path), "plugins", "profile",
+                                 "2001_01_01_00_00_00")
+        os.makedirs(stale_run)
+        with open(os.path.join(stale_run, "old.trace.json.gz"), "wb") as f:
+            f.write(b"stale")
+        handler = prof_mod.export_chrome_tracing(str(tmp_path))
+        p = prof_mod.Profiler(timer_only=True, on_trace_ready=handler)
+        p.start()
+        # a run created DURING this session (what jax.profiler would
+        # write on stop_trace)
+        new_run = os.path.join(str(tmp_path), "plugins", "profile",
+                               "2031_01_01_00_00_00")
+        os.makedirs(new_run)
+        new_trace = os.path.join(new_run, "host.trace.json.gz")
+        with open(new_trace, "wb") as f:
+            f.write(b"fresh")
+        p.step()
+        p.stop()
+        assert p.chrome_trace_paths() == [new_trace]
+
+    def test_bench_snapshot_is_strict_json(self, tmp_path):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(os.path.dirname(__file__), "..",
+                                  "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        out = str(tmp_path / "BENCH_observability_snapshot.json")
+        result = {"metric": "llama_train_mfu", "mfu": 0.41,
+                  "step_time_ms": 123.4, "device": "TPU v5e",
+                  "flash_parity_ok": True, "n_params": 123456}
+        path = bench.write_metrics_snapshot(result, path=out)
+        assert path == out
+        with open(out) as f:
+            snap = _strict_loads(f.read())
+        names = {e["name"] for e in snap}
+        assert {"bench_mfu", "bench_step_time_ms",
+                "bench_n_params"} <= names
+        # non-numeric / bool keys are excluded from the gauge dump
+        assert "bench_device" not in names
+        assert "bench_flash_parity_ok" not in names
+        # the kill switch writes no files
+        os.environ["PADDLE_TPU_METRICS"] = "0"
+        try:
+            assert bench.write_metrics_snapshot(
+                result, path=str(tmp_path / "nope.json")) is None
+            assert not os.path.exists(str(tmp_path / "nope.json"))
+        finally:
+            os.environ.pop("PADDLE_TPU_METRICS")
+
+
+# ---------------------------------------------------------------------------
+# serving + hapi memory-gauge integration
+# ---------------------------------------------------------------------------
+class TestMemoryIntegration:
+    def test_serving_wave_samples_memory(self, model):
+        from paddle_tpu.inference.serving import LlamaServingEngine
+
+        engine = LlamaServingEngine(model, max_batch=2, page_size=8,
+                                    num_pages=16)
+        engine.generate(_prompts(2)[:2], max_new_tokens=2)
+        reg = om.default_registry()
+        assert reg.get("paddle_tpu_live_array_bytes").value > 0
+        assert reg.get("paddle_tpu_live_array_count").value > 0
+
+    def test_hapi_step_samples_memory(self):
+        from paddle_tpu.hapi import MetricsCallback
+
+        cb = MetricsCallback(batch_size=8)
+        TestHapiIntegration()._fit(cb)
+        reg = om.default_registry()
+        assert reg.get("paddle_tpu_live_array_bytes").value > 0
+        assert reg.get("paddle_tpu_device_bytes_in_use").value >= 0
