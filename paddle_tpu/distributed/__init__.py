@@ -30,6 +30,8 @@ from . import p2p  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from . import checkpoint_manager  # noqa: F401
+from .checkpoint_manager import CheckpointManager  # noqa: F401
 from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
 from .recompute import recompute  # noqa: F401
 from . import fleet  # noqa: F401
@@ -53,7 +55,7 @@ __all__ = [
     "broadcast", "reduce", "scatter", "barrier", "send", "recv",
     "isend", "irecv", "wait",
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
-    "is_initialized",
+    "is_initialized", "CheckpointManager",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "p2p",
 ]

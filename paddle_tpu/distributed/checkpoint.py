@@ -35,7 +35,8 @@ import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "collect_state_shards",
+           "write_state_shards"]
 
 
 def _json_safe(v):
@@ -81,14 +82,17 @@ def _flatten(state_dict, prefix=""):
     return out, owners
 
 
-def save_state_dict(state_dict, path, process_index=None):
-    """Write each tensor's addressable shards + global metadata.
+def collect_state_shards(state_dict, process_index=None):
+    """Snapshot ``state_dict`` to host memory: ``(proc, meta, data)``.
 
-    Reference: save_state_dict.py:104. ``state_dict`` maps names to
-    Tensors (dist or dense; nested dicts flatten with dotted keys).
+    The D2H copy happens HERE (``np.asarray`` of each addressable
+    shard), so once this returns the caller may keep mutating the device
+    tensors — the synchronous phase of an async checkpoint
+    (:class:`~paddle_tpu.distributed.checkpoint_manager
+    .CheckpointManager` writes the returned snapshot in a background
+    thread).
     """
     flat, _ = _flatten(state_dict)
-    os.makedirs(path, exist_ok=True)
     proc = jax.process_index() if process_index is None else process_index
     meta = {"tensors": {}}
     data = {}
@@ -115,11 +119,52 @@ def save_state_dict(state_dict, path, process_index=None):
                 {"box": [list(b) for b in box], "array": name,
                  "file": f"shards_p{proc}.npz", "dtype": dt})
         meta["tensors"][key] = entry
-    np.savez(os.path.join(path, f"shards_p{proc}.npz"), **data)
-    # every process writes its OWN metadata slice; load merges them —
-    # a multi-host checkpoint must index every process's shards
-    with open(os.path.join(path, f"metadata_p{proc}.json"), "w") as f:
+    return proc, meta, data
+
+
+def write_state_shards(path, proc, meta, data, fsync=False):
+    """Write one process's collected snapshot under ``path``; returns
+    the file basenames written. With ``fsync=True`` each file is flushed
+    to stable storage before returning (the durability half of the
+    checkpoint manager's two-phase commit)."""
+    from ..testing import faults as _faults
+
+    os.makedirs(path, exist_ok=True)
+    shard_name = f"shards_p{proc}.npz"
+    meta_name = f"metadata_p{proc}.json"
+    shard_path = os.path.join(path, shard_name)
+    _faults.fire("ckpt.write", path=shard_path)
+    with open(shard_path, "wb") as f:
+        np.savez(f, **data)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    meta_path = os.path.join(path, meta_name)
+    _faults.fire("ckpt.write", path=meta_path)
+    with open(meta_path, "w") as f:
         json.dump(meta, f, default=_json_safe)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    return [shard_name, meta_name]
+
+
+def save_state_dict(state_dict, path, process_index=None):
+    """Write each tensor's addressable shards + global metadata.
+
+    Reference: save_state_dict.py:104. ``state_dict`` maps names to
+    Tensors (dist or dense; nested dicts flatten with dotted keys).
+    Each process writes its OWN metadata slice; load merges them — a
+    multi-host checkpoint must index every process's shards.
+
+    NOTE: this writes straight into ``path``; a crash mid-save leaves a
+    torn checkpoint. For durable training checkpoints use
+    :class:`~paddle_tpu.distributed.checkpoint_manager
+    .CheckpointManager`, which wraps this format in an atomic
+    two-phase commit.
+    """
+    proc, meta, data = collect_state_shards(state_dict, process_index)
+    write_state_shards(path, proc, meta, data)
 
 
 def load_state_dict(state_dict, path):
@@ -155,46 +200,60 @@ def load_state_dict(state_dict, path):
             files[fname] = np.load(os.path.join(path, fname))
         return _from_numpy(files[fname][sh["array"]], sh["dtype"])
 
-    missing = []
-    for key, t in flat.items():
-        if not isinstance(t, Tensor):
-            # objects restore by writeback into the owning dict
-            if key in meta["objects"]:
-                d, k = owners[key]
-                d[k] = _json_restore(meta["objects"][key])
-            else:
+    try:
+        missing = []
+        for key, t in flat.items():
+            if not isinstance(t, Tensor):
+                # objects restore by writeback into the owning dict
+                if key in meta["objects"]:
+                    d, k = owners[key]
+                    d[k] = _json_restore(meta["objects"][key])
+                else:
+                    missing.append(key)
+                continue
+            entry = meta["tensors"].get(key)
+            if entry is None:
                 missing.append(key)
-            continue
-        entry = meta["tensors"].get(key)
-        if entry is None:
-            missing.append(key)
-            continue
-        if list(entry["shape"]) != list(t._data.shape):
-            raise ValueError(
-                f"checkpoint tensor {key!r} has shape {entry['shape']}, "
-                f"target expects {list(t._data.shape)}")
-        # reassemble the global array from shard boxes
-        full = np.empty(entry["shape"],
-                        np.asarray(shard_data(entry["shards"][0])).dtype)
-        covered = np.zeros(entry["shape"], dtype=bool) \
-            if entry["shards"] else None
-        for sh in entry["shards"]:
-            slices = tuple(slice(b[0], b[1]) for b in sh["box"])
-            full[slices] = shard_data(sh)
-            covered[slices] = True
-        if covered is not None and not covered.all():
-            raise ValueError(
-                f"checkpoint for {key!r} does not cover the full tensor "
-                "(multi-host checkpoint loaded without all shard files?)")
-        arr = jnp.asarray(full)
-        # reshard to the tensor's CURRENT placement — the load-time analog
-        # of the reference's overlap computation
-        sharding = getattr(t._data, "sharding", None)
-        if sharding is not None and getattr(t, "is_dist", False):
-            arr = jax.device_put(arr, sharding)
-        t._data = arr.astype(t._data.dtype)
-    if missing:
-        raise KeyError(
-            f"checkpoint at {path} is missing tensors: {missing[:5]}"
-            + ("..." if len(missing) > 5 else ""))
+                continue
+            if list(entry["shape"]) != list(t._data.shape):
+                raise ValueError(
+                    f"checkpoint tensor {key!r} has shape "
+                    f"{entry['shape']}, "
+                    f"target expects {list(t._data.shape)}")
+            if not entry["shards"]:
+                raise ValueError(
+                    f"checkpoint tensor {key!r} has no shards in the "
+                    f"metadata under {path} — the checkpoint is likely "
+                    "incomplete (truncated metadata, or a multi-host "
+                    "save missing a process's metadata slice)")
+            # reassemble the global array from shard boxes
+            full = np.empty(entry["shape"],
+                            np.asarray(shard_data(entry["shards"][0])).dtype)
+            covered = np.zeros(entry["shape"], dtype=bool)
+            for sh in entry["shards"]:
+                slices = tuple(slice(b[0], b[1]) for b in sh["box"])
+                full[slices] = shard_data(sh)
+                covered[slices] = True
+            if not covered.all():
+                raise ValueError(
+                    f"checkpoint for {key!r} does not cover the full "
+                    "tensor (multi-host checkpoint loaded without all "
+                    "shard files?)")
+            arr = jnp.asarray(full)
+            # reshard to the tensor's CURRENT placement — the load-time
+            # analog of the reference's overlap computation
+            sharding = getattr(t._data, "sharding", None)
+            if sharding is not None and getattr(t, "is_dist", False):
+                arr = jax.device_put(arr, sharding)
+            t._data = arr.astype(t._data.dtype)
+        if missing:
+            raise KeyError(
+                f"checkpoint at {path} is missing tensors: {missing[:5]}"
+                + ("..." if len(missing) > 5 else ""))
+    finally:
+        # np.load keeps the zip handle open for lazy member reads; a
+        # resume loop that retries restores must not leak one fd per
+        # shard file per attempt
+        for f in files.values():
+            f.close()
     return state_dict

@@ -32,6 +32,31 @@ from ...observability import metrics as _om
 
 __all__ = ["launch", "launch_elastic", "main"]
 
+#: seconds a SIGTERM'd worker gets before SIGKILL — survivors parked in
+#: a blocking collective shrug off SIGTERM
+_TERM_GRACE = 10.0
+
+
+def _terminate_survivors(procs, pending, grace=_TERM_GRACE):
+    """SIGTERM every still-running worker in ``pending``, then SIGKILL
+    whatever outlives the grace period (reference watcher behavior,
+    escalated — a worker stuck in a hung collective must not stall the
+    launcher forever)."""
+    for j in pending:
+        if procs[j].poll() is None:
+            procs[j].send_signal(signal.SIGTERM)
+    deadline = time.monotonic() + grace
+    for j in pending:
+        left = deadline - time.monotonic()
+        try:
+            procs[j].wait(timeout=max(0.1, left))
+        except subprocess.TimeoutExpired:
+            procs[j].kill()
+            try:
+                procs[j].wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+
 
 def _launch_metrics():
     """Supervisor-side elastic counters (live in the launcher process)."""
@@ -79,7 +104,8 @@ def launch(script_args, nnodes=1, node_rank=0, nproc_per_node=1,
             procs.append(subprocess.Popen(
                 [sys.executable] + list(script_args),
                 env=env, stdout=log, stderr=subprocess.STDOUT))
-        # wait; on any failure kill the rest (reference watcher behavior)
+        # wait; on any failure tear down the rest (reference watcher
+        # behavior, escalated SIGTERM -> grace -> SIGKILL)
         exit_code = 0
         pending = set(range(len(procs)))
         while pending:
@@ -88,13 +114,13 @@ def launch(script_args, nnodes=1, node_rank=0, nproc_per_node=1,
                 if ret is None:
                     continue
                 pending.discard(i)
+                logs[i].close()
                 if ret != 0 and exit_code == 0:
                     exit_code = ret
                     from ...observability import flight_recorder as _fr
                     _fr.on_fatal("worker_failure", local_rank=i,
                                  exit_code=ret)
-                    for j in pending:
-                        procs[j].send_signal(signal.SIGTERM)
+                    _terminate_survivors(procs, pending)
             time.sleep(0.2)
         return exit_code
     finally:
@@ -102,12 +128,14 @@ def launch(script_args, nnodes=1, node_rank=0, nproc_per_node=1,
             if p.poll() is None:
                 p.kill()
         for log in logs:
-            log.close()
+            if not log.closed:
+                log.close()
 
 
 def launch_elastic(script_args, nproc_per_node=2, max_restarts=3,
                    min_nproc=1, master=None, log_dir="log",
-                   env_extra=None, store_dir=None, env_base=None):
+                   env_extra=None, store_dir=None, env_base=None,
+                   resume_dir=None):
     """Elastic supervisor: the loop the reference closes in
     `fleet/elastic/manager.py:594` (watch membership -> on scale event,
     tear down, relaunch, resume from checkpoint).
@@ -120,6 +148,13 @@ def launch_elastic(script_args, nproc_per_node=2, max_restarts=3,
     script resumes from its last checkpoint (`distributed.checkpoint` /
     ``paddle.save``). After a failed retry at the same size the world
     shrinks by one (elastic scale-down) until ``min_nproc``.
+
+    ``resume_dir`` is exported to every generation as
+    ``PADDLE_TPU_RESUME_DIR``: a worker that drives a
+    :class:`~paddle_tpu.distributed.checkpoint_manager
+    .CheckpointManager` (or the hapi ``CheckpointCallback``) on that
+    directory resumes at ``latest_step() + 1`` instead of step 0, so a
+    relaunch costs at most one save interval of work.
 
     Returns the final exit code (0 once a round completes cleanly).
     """
@@ -136,7 +171,7 @@ def launch_elastic(script_args, nproc_per_node=2, max_restarts=3,
         code = _elastic_round(script_args, nproc, master, log_dir,
                               dict(env_extra or {}), restarts, store_dir,
                               ElasticManager, FileStore, env_base,
-                              metrics)
+                              metrics, resume_dir)
         if code == 0:
             return 0
         restarts += 1
@@ -149,7 +184,7 @@ def launch_elastic(script_args, nproc_per_node=2, max_restarts=3,
 
 def _elastic_round(script_args, nproc, master, log_dir, env_extra,
                    restarts, store_dir, ElasticManager, FileStore,
-                   env_base=None, metrics=None):
+                   env_base=None, metrics=None, resume_dir=None):
     """One supervised generation: spawn, watch membership, tear down on
     the first scale event."""
     world = nproc
@@ -175,6 +210,8 @@ def _elastic_round(script_args, nproc, master, log_dir, env_extra,
                 "PADDLE_ELASTIC": "1",
                 "FLAGS_selected_devices": str(rank),
             })
+            if resume_dir:
+                env["PADDLE_TPU_RESUME_DIR"] = str(resume_dir)
             if master:
                 env["PADDLE_MASTER"] = master
             log = open(os.path.join(log_dir,
@@ -192,6 +229,7 @@ def _elastic_round(script_args, nproc, master, log_dir, env_extra,
                 if ret is None:
                     continue
                 pending.discard(i)
+                logs[i].close()
                 store.deregister(str(i))
                 if ret != 0:
                     if metrics is not None:
@@ -211,14 +249,9 @@ def _elastic_round(script_args, nproc, master, log_dir, env_extra,
                 # Survivors may be parked in a blocking collective that
                 # shrugs off SIGTERM — escalate to SIGKILL after a grace
                 # period.
+                _terminate_survivors(procs, pending)
                 for j in pending:
-                    procs[j].send_signal(signal.SIGTERM)
-                for j in pending:
-                    try:
-                        procs[j].wait(timeout=10)
-                    except subprocess.TimeoutExpired:
-                        procs[j].kill()
-                        procs[j].wait(timeout=30)
+                    logs[j].close()
                     store.deregister(str(j))
                 pending.clear()
             time.sleep(0.2)
@@ -228,7 +261,8 @@ def _elastic_round(script_args, nproc, master, log_dir, env_extra,
             if p.poll() is None:
                 p.kill()
         for log in logs:
-            log.close()
+            if not log.closed:
+                log.close()
 
 
 def main(argv=None):
@@ -249,6 +283,11 @@ def main(argv=None):
                          "(reference fleet/elastic)")
     ap.add_argument("--max_restarts", type=int, default=3)
     ap.add_argument("--min_nproc", type=int, default=1)
+    ap.add_argument("--resume_dir",
+                    default=os.environ.get("PADDLE_TPU_RESUME_DIR"),
+                    help="checkpoint root exported to workers as "
+                         "PADDLE_TPU_RESUME_DIR; an elastic relaunch "
+                         "resumes from its latest committed step")
     ap.add_argument("script", nargs=argparse.REMAINDER,
                     help="training script and its arguments")
     args = ap.parse_args(argv)
@@ -259,7 +298,8 @@ def main(argv=None):
                               nproc_per_node=args.nproc_per_node,
                               max_restarts=args.max_restarts,
                               min_nproc=args.min_nproc,
-                              master=args.master, log_dir=args.log_dir)
+                              master=args.master, log_dir=args.log_dir,
+                              resume_dir=args.resume_dir)
     else:
         code = launch(args.script, nnodes=args.nnodes,
                       node_rank=args.node_rank,

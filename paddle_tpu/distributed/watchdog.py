@@ -149,15 +149,33 @@ class StepWatchdog:
 
 class FileStore:
     """Shared-filesystem membership store (the test/simple deployment
-    analog of the reference's ETCD registry)."""
+    analog of the reference's ETCD registry, which expires leases the
+    same way — `fleet/elastic/manager.py` np_etcd lease TTL).
 
-    def __init__(self, path):
+    ``register()`` stamps the current time; with a ``ttl`` (seconds), a
+    host whose stamp ages past it stops appearing in :meth:`hosts` — a
+    crashed host that never deregistered is treated as dead, and an
+    :class:`ElasticManager` watching the store reports ``scale_down``.
+    Re-registering (:meth:`heartbeat`) refreshes the stamp."""
+
+    def __init__(self, path, ttl=None):
         self.path = path
+        self.ttl = None if ttl is None else float(ttl)
         os.makedirs(path, exist_ok=True)
 
     def register(self, host_id):
-        with open(os.path.join(self.path, str(host_id)), "w") as f:
+        # stamp atomically (write-aside + replace): open(.., "w") would
+        # truncate first, and a concurrent hosts() scan reading the
+        # empty file would expire a perfectly healthy host
+        final = os.path.join(self.path, str(host_id))
+        tmp = os.path.join(self.path, f".stamp.{host_id}.{os.getpid()}")
+        with open(tmp, "w") as f:
             f.write(str(time.time()))
+        os.replace(tmp, final)
+
+    def heartbeat(self, host_id):
+        """Refresh a live host's timestamp so it outlives the ttl."""
+        self.register(host_id)
 
     def deregister(self, host_id):
         try:
@@ -166,7 +184,27 @@ class FileStore:
             pass
 
     def hosts(self):
-        return sorted(os.listdir(self.path))
+        now = time.time()
+        out = []
+        for name in sorted(os.listdir(self.path)):
+            if name.startswith("."):
+                continue            # in-flight stamp writes
+            if self.ttl is not None:
+                p = os.path.join(self.path, name)
+                try:
+                    with open(p) as f:
+                        stamp = float(f.read().strip() or "0")
+                except (OSError, ValueError):
+                    # unreadable/half-written registration: age by mtime
+                    # so it still expires instead of living forever
+                    try:
+                        stamp = os.path.getmtime(p)
+                    except OSError:
+                        continue        # vanished mid-scan
+                if now - stamp > self.ttl:
+                    continue
+            out.append(name)
+        return out
 
 
 class ElasticManager:
@@ -174,9 +212,12 @@ class ElasticManager:
 
     ``watch_once()`` compares live membership against the expected world
     and returns one of "normal" / "scale_down" / "scale_up"; ``watch``
-    loops until a scale event or stop. A supervisor reacts by
-    checkpointing (distributed.checkpoint) and relaunching with the new
-    world size — the reference's recovery model.
+    loops until a scale event or stop. A store with a ``ttl`` ages out
+    crashed hosts that never deregistered, so a stale registration
+    surfaces here as ``scale_down`` rather than a live host forever. A
+    supervisor reacts by checkpointing
+    (distributed.checkpoint_manager) and relaunching with the new world
+    size — the reference's recovery model.
     """
 
     def __init__(self, store, host_id, expected_hosts,

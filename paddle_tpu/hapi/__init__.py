@@ -18,12 +18,12 @@ from ..metric import Metric
 from . import callbacks as callbacks_mod
 from .callbacks import (  # noqa: F401
     Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
-    History, MetricsCallback, config_callbacks,
+    History, MetricsCallback, CheckpointCallback, config_callbacks,
 )
 
 __all__ = ["Model", "Input", "Callback", "ProgBarLogger",
            "ModelCheckpoint", "EarlyStopping", "LRScheduler", "History",
-           "MetricsCallback"]
+           "MetricsCallback", "CheckpointCallback"]
 
 
 class Input:

@@ -8,7 +8,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "History", "MetricsCallback", "config_callbacks"]
+           "LRScheduler", "History", "MetricsCallback",
+           "CheckpointCallback", "config_callbacks"]
 
 
 class Callback:
@@ -276,6 +277,134 @@ class MetricsCallback(Callback):
                 compile_watch.sample_device_memory(self._registry,
                                                    min_interval=1.0)
                 flight_recorder.periodic_snapshot()
+
+
+class CheckpointCallback(Callback):
+    """Fault-tolerant, step-granular checkpointing through
+    :class:`~paddle_tpu.distributed.checkpoint_manager
+    .CheckpointManager` — the training-side half of the elastic recovery
+    loop (reference: `fleet/elastic/manager.py` checkpoint-and-relaunch;
+    compare :class:`ModelCheckpoint`, which writes per-epoch and not
+    atomically).
+
+    - every ``save_freq_steps`` optimizer steps the network state is
+      committed atomically; with ``async_save`` the fit loop is blocked
+      only for the device-to-host snapshot.
+    - on ``on_train_begin`` the latest committed step is restored in
+      place (parameters AND the step counter), so a relaunched worker
+      continues at ``restored_step + 1``. The checkpoint root comes
+      from ``dir`` or ``$PADDLE_TPU_RESUME_DIR`` — what
+      ``launch_elastic(resume_dir=...)`` exports to every generation.
+    - on SIGTERM (the TPU preemption notice / the elastic supervisor's
+      teardown) the handler only sets a flag; the emergency save runs
+      at the NEXT batch boundary — a signal landing mid-optimizer-step
+      would otherwise snapshot half-updated parameters into a
+      checksum-valid checkpoint — then the process exits.
+    - only ``save_rank`` (default 0) commits: every worker of a
+      generation receives the same ``PADDLE_TPU_RESUME_DIR``, and
+      concurrent commits to one directory would tear each other's
+      saves. All ranks restore. (``save_rank=None`` saves everywhere —
+      only for distinct per-rank directories.)
+
+    ``global_step`` counts completed optimizer steps monotonically
+    across epochs; restore refreshes weights and that counter, while
+    epoch/dataloader positioning stays the caller's concern.
+    """
+
+    def __init__(self, dir=None, save_freq_steps=100, max_to_keep=5,
+                 async_save=True, restore=True, on_preemption=True,
+                 manager=None, save_rank=0):
+        super().__init__()
+        if manager is None:
+            from ..distributed.checkpoint_manager import (
+                CheckpointManager, resume_dir_from_env)
+            root = dir or resume_dir_from_env()
+            if not root:
+                raise ValueError(
+                    "CheckpointCallback needs dir=..., manager=..., or "
+                    "$PADDLE_TPU_RESUME_DIR (set by "
+                    "launch_elastic(resume_dir=...))")
+            manager = CheckpointManager(root, max_to_keep=max_to_keep,
+                                        async_save=async_save)
+        self.manager = manager
+        self.save_freq_steps = int(save_freq_steps)
+        self.restore = restore
+        self.on_preemption = on_preemption
+        self.save_rank = save_rank
+        self.global_step = 0
+        self.restored_step = None
+        self._dirty = False
+        self._preempt_signum = None
+        self._prev_sigterm = None
+
+    def _net(self):
+        return getattr(self.model, "network", self.model)
+
+    def _state(self):
+        return {"model": self._net().state_dict()}
+
+    def _is_saver(self):
+        if self.save_rank is None:
+            return True
+        rank = os.environ.get("PADDLE_TRAINER_ID")
+        if rank is None:
+            try:
+                import jax
+                rank = jax.process_index()
+            except Exception:
+                rank = 0
+        return int(rank) == int(self.save_rank)
+
+    def on_train_begin(self, logs=None):
+        if self.restore:
+            # state_dict() returns the live parameter Tensors, so
+            # restore_latest fills the network in place
+            step = self.manager.restore_latest(self._state())
+            if step is not None:
+                self.restored_step = step
+                self.global_step = step + 1
+        if self.on_preemption:
+            import signal
+            self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                               self._on_preempt_signal)
+
+    def _on_preempt_signal(self, signum, frame):
+        # flag only: a mid-optimizer-step save would commit parameters
+        # half old-step, half new-step — consistent-looking on disk,
+        # corresponding to no step boundary. The next batch boundary
+        # saves and exits.
+        self._preempt_signum = signum
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..testing import faults as _faults
+        _faults.fire("train.step", step=self.global_step)
+        done = self.global_step          # the step just completed
+        self.global_step += 1
+        self._dirty = True
+        saver = self._is_saver()
+        if saver and (done + 1) % self.save_freq_steps == 0:
+            self.manager.save(self._state(), done)
+            self._dirty = False
+        if self._preempt_signum is not None:
+            if saver:
+                self.manager._m_preempt.inc()
+                try:
+                    self.manager.save(self._state(), done,
+                                      blocking=True)
+                except Exception:
+                    pass             # exiting anyway; already counted
+            os._exit(128 + self._preempt_signum)
+
+    def on_train_end(self, logs=None):
+        if self._is_saver() and self._dirty and self.global_step > 0:
+            self.manager.save(self._state(), self.global_step - 1,
+                              blocking=True)
+            self._dirty = False
+        self.manager.wait()
+        if self.on_preemption and self._prev_sigterm is not None:
+            import signal
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
 
 
 class LRScheduler(Callback):

@@ -18,6 +18,7 @@ allocator with a single pool for the one-layer case.
 from __future__ import annotations
 
 import math
+import threading
 
 import jax.numpy as jnp
 import numpy as np
@@ -37,6 +38,11 @@ class PageAllocator:
         self._free = list(range(num_pages - 1, -1, -1))
         self._tables: dict[int, list[int]] = {}
         self._lens: dict[int, int] = {}
+        # free-list mutations are check-then-pop; the serving engine's
+        # admission backoff explicitly supports a second thread driving
+        # step()/burst, so allocate/free must be atomic or a race leaks
+        # popped pages (and escapes the MemoryError contract)
+        self._lock = threading.Lock()
 
     @property
     def free_pages(self):
@@ -47,42 +53,47 @@ class PageAllocator:
 
     def admit(self, seq_id, n_tokens):
         """Reserve pages for a new sequence of ``n_tokens`` (prefill)."""
-        if seq_id in self._tables:
-            raise ValueError(f"sequence {seq_id} already admitted")
-        need = max(1, math.ceil(n_tokens / self.page_size))
-        if need > self.max_pages_per_seq:
-            raise ValueError(
-                f"{n_tokens} tokens needs {need} pages > max_pages_per_seq "
-                f"({self.max_pages_per_seq})")
-        if need > len(self._free):
-            raise MemoryError(
-                f"paged cache exhausted: need {need} pages, "
-                f"{len(self._free)} free")
-        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
-        self._lens[seq_id] = n_tokens
-        return list(self._tables[seq_id])
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id} already admitted")
+            need = max(1, math.ceil(n_tokens / self.page_size))
+            if need > self.max_pages_per_seq:
+                raise ValueError(
+                    f"{n_tokens} tokens needs {need} pages > "
+                    f"max_pages_per_seq ({self.max_pages_per_seq})")
+            if need > len(self._free):
+                raise MemoryError(
+                    f"paged cache exhausted: need {need} pages, "
+                    f"{len(self._free)} free")
+            self._tables[seq_id] = [self._free.pop()
+                                    for _ in range(need)]
+            self._lens[seq_id] = n_tokens
+            return list(self._tables[seq_id])
 
     def extend(self, seq_id, n_tokens=1):
         """Grow a sequence by ``n_tokens`` (decode), allocating pages as
         page boundaries are crossed. Returns the previous length (the
         write offset of the first new token)."""
-        table, ln = self._tables[seq_id], self._lens[seq_id]
-        new_len = ln + n_tokens
-        need = max(1, math.ceil(new_len / self.page_size))
-        if need > self.max_pages_per_seq:
-            raise ValueError(f"sequence {seq_id} exceeds max_pages_per_seq")
-        while len(table) < need:
-            if not self._free:
-                raise MemoryError("paged cache exhausted on extend")
-            table.append(self._free.pop())
-        self._lens[seq_id] = new_len
-        return ln
+        with self._lock:
+            table, ln = self._tables[seq_id], self._lens[seq_id]
+            new_len = ln + n_tokens
+            need = max(1, math.ceil(new_len / self.page_size))
+            if need > self.max_pages_per_seq:
+                raise ValueError(
+                    f"sequence {seq_id} exceeds max_pages_per_seq")
+            while len(table) < need:
+                if not self._free:
+                    raise MemoryError("paged cache exhausted on extend")
+                table.append(self._free.pop())
+            self._lens[seq_id] = new_len
+            return ln
 
     def release(self, seq_id):
         """Return a finished sequence's pages to the free list."""
-        for p in self._tables.pop(seq_id):
-            self._free.append(p)
-        del self._lens[seq_id]
+        with self._lock:
+            for p in self._tables.pop(seq_id):
+                self._free.append(p)
+            del self._lens[seq_id]
 
     def context_len(self, seq_id):
         return self._lens[seq_id]

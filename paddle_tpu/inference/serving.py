@@ -50,7 +50,31 @@ from ..observability.trace import span as _span
 from ..ops.paged_attention import paged_attention
 from .paged_cache import PageAllocator
 
-__all__ = ["LlamaServingEngine", "Request"]
+__all__ = ["LlamaServingEngine", "Request", "AdmissionError"]
+
+
+class AdmissionError(MemoryError):
+    """Typed admission rejection carrying queue/pool stats so callers
+    can shed load (429, redirect, re-queue) instead of crashing.
+
+    Subclasses :class:`MemoryError` for backward compatibility with
+    callers catching the engine's old bare raise; the serving
+    ``_fatal_guard`` likewise treats it as a routine rejection, not a
+    crash worth a flight-recorder dump.
+    """
+
+    def __init__(self, reason, live, max_batch, free_pages, num_pages,
+                 retries):
+        super().__init__(
+            f"{reason} (live={live}/{max_batch}, "
+            f"free_pages={free_pages}/{num_pages}, "
+            f"retries={retries})")
+        self.reason = reason
+        self.live = live
+        self.max_batch = max_batch
+        self.free_pages = free_pages
+        self.num_pages = num_pages
+        self.retries = retries
 
 #: latency buckets tuned for serving (TTFT / per-token): 1ms .. 10s
 _LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -71,6 +95,10 @@ def _serving_metrics():
         "evicted": _om.counter(
             "serving_requests_evicted_total",
             "admission rejections (engine full / KV pages exhausted)"),
+        "admit_retries": _om.counter(
+            "serving_admission_retries_total",
+            "admission attempts retried after backoff while waiting "
+            "for capacity"),
         "queue_depth": _om.gauge(
             "serving_queue_depth", "live requests in the engine"),
         "kv_util": _om.gauge(
@@ -165,7 +193,8 @@ class LlamaServingEngine:
     BURST = 16
 
     def __init__(self, model, max_batch=16, page_size=16, num_pages=None,
-                 max_pages_per_seq=None, burst=None):
+                 max_pages_per_seq=None, burst=None, admit_retries=0,
+                 admit_backoff=0.005):
         if num_pages is None:
             num_pages = max_batch * 24 + 8
         self.model = model
@@ -177,6 +206,13 @@ class LlamaServingEngine:
         # pool pays a grid step (and an HBM->VMEM page fetch) per UNUSED
         # table slot. max_pages_per_seq is the knob.
         self.burst = int(burst) if burst else self.BURST
+        # admission backpressure: retry this many times (exponential
+        # backoff from admit_backoff seconds) before a typed rejection.
+        # Default 0 (instant rejection): retries only help when another
+        # thread drives step()/burst and can retire a request
+        # mid-backoff — opt in for such multithreaded deployments.
+        self.admit_retries = int(admit_retries)
+        self.admit_backoff = float(admit_backoff)
         # page num_pages-1 is the trash page for inactive batch slots
         self.alloc = PageAllocator(num_pages - 1, page_size,
                                    max_pages_per_seq)
@@ -385,17 +421,33 @@ class LlamaServingEngine:
             _fr.periodic_snapshot()
 
     def _admit(self, req):
-        if len(self._live) >= self.max_batch:
-            self._m["evicted"].inc()
-            raise MemoryError(
-                f"engine full ({self.max_batch} live requests)")
-        req.seq_id = self._next_id
-        self._next_id += 1
-        try:
-            self.alloc.admit(req.seq_id, len(req.prompt_ids))
-        except MemoryError:
-            self._m["evicted"].inc()
-            raise
+        attempt = 0
+        while True:
+            reason = None
+            if len(self._live) >= self.max_batch:
+                reason = "engine full"
+            else:
+                if req.seq_id is None:
+                    req.seq_id = self._next_id
+                    self._next_id += 1
+                try:
+                    self.alloc.admit(req.seq_id, len(req.prompt_ids))
+                except MemoryError:
+                    reason = "KV page pool exhausted"
+            if reason is None:
+                break
+            if attempt >= self.admit_retries:
+                self._m["evicted"].inc()
+                raise AdmissionError(
+                    reason, live=len(self._live),
+                    max_batch=self.max_batch,
+                    free_pages=self.alloc.free_pages,
+                    num_pages=self.alloc.num_pages, retries=attempt)
+            # bounded backoff: a concurrent step()/burst may retire a
+            # request and release its pages before the retry
+            attempt += 1
+            self._m["admit_retries"].inc()
+            time.sleep(self.admit_backoff * (2 ** (attempt - 1)))
         self._live[req.seq_id] = req
         req._t_admit = time.perf_counter()
         self._m["admitted"].inc()

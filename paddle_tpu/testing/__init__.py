@@ -1,0 +1,10 @@
+"""``paddle_tpu.testing`` — deterministic test harnesses.
+
+Currently home to :mod:`paddle_tpu.testing.faults`, the fault-injection
+plan that crash/recovery tests (checkpoint manager, elastic launch) use
+to kill, hang, or corrupt a process at an exact instrumented point.
+"""
+
+from . import faults  # noqa: F401
+
+__all__ = ["faults"]

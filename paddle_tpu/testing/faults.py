@@ -1,0 +1,188 @@
+"""Deterministic fault injection for crash/recovery tests.
+
+Reference: the recovery model of `fleet/elastic/manager.py` (detect a
+failure, relaunch, resume from checkpoint) is only provable if the
+failure itself is reproducible. This module makes failures first-class
+test inputs: production code calls :func:`fire` at named points
+("ckpt.write", "rename", "train.step", ...) and a *fault plan* — a JSON
+list of rules in the ``PADDLE_TPU_FAULTS`` environment variable —
+decides, deterministically, what happens there: nothing (the default,
+one dict lookup when no plan is set), a crash, a signal, a hang, a
+slow-down, an injected ``OSError``, or a bit-flip of a file.
+
+Because the plan travels through the environment, subprocess tests
+activate it without patching any code: the launcher test sets
+``PADDLE_TPU_FAULTS='[{"point": "rename", "step": 3, "action":
+"sigkill"}]'`` and the worker under test dies mid-save of step 3,
+exactly once, every run.
+
+Rule fields (all optional except ``point`` and ``action``):
+
+- ``point``: instrumented point name (exact match).
+- ``action``: one of ``crash`` (``os._exit``), ``sigkill``, ``sigterm``
+  (signal self), ``hang`` (sleep ~forever), ``sleep`` (slow-down, then
+  continue), ``raise`` (``OSError``), ``bitflip`` (corrupt the file at
+  the point's ``path``).
+- ``step``: only fire when the call site passes this step number.
+- ``path``: fnmatch glob matched against the call site's path (full
+  path or basename).
+- ``env``: ``{name: value}`` — only fire when every named environment
+  variable currently has that value (e.g. restrict a kill to elastic
+  generation 0 via ``{"PADDLE_RESTART_COUNT": "0"}``).
+- ``count``: fire at most this many times per process (default:
+  unlimited).
+- ``seconds``: duration for ``sleep`` / ``hang`` (defaults 0.1 / 3600).
+- ``exit_code``: for ``crash`` (default 23).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import signal
+import time
+
+__all__ = ["PLAN_ENV", "FaultRule", "FaultPlan", "plan", "reset",
+           "active", "fire", "rename", "bitflip"]
+
+#: environment variable holding the JSON fault plan
+PLAN_ENV = "PADDLE_TPU_FAULTS"
+
+_ACTIONS = ("crash", "sigkill", "sigterm", "hang", "sleep", "raise",
+            "bitflip")
+
+
+class FaultRule:
+    """One parsed plan entry. Matching is pure; firing performs the
+    action (and may not return)."""
+
+    def __init__(self, spec):
+        self.point = spec["point"]
+        self.action = spec["action"]
+        if self.action not in _ACTIONS:
+            raise ValueError(
+                f"unknown fault action {self.action!r}; expected one of "
+                f"{_ACTIONS}")
+        self.step = spec.get("step")
+        self.path = spec.get("path")
+        self.env = spec.get("env") or {}
+        self.count = spec.get("count")
+        self.seconds = spec.get("seconds")
+        self.exit_code = int(spec.get("exit_code", 23))
+        self.fired = 0
+
+    def matches(self, point, step, path):
+        if point != self.point:
+            return False
+        if self.count is not None and self.fired >= self.count:
+            return False
+        if self.step is not None and step != self.step:
+            return False
+        if self.path is not None:
+            if path is None:
+                return False
+            if not (fnmatch.fnmatch(path, self.path)
+                    or fnmatch.fnmatch(os.path.basename(path), self.path)):
+                return False
+        for k, v in self.env.items():
+            if os.environ.get(k) != str(v):
+                return False
+        return True
+
+    def perform(self, point, step, path):
+        self.fired += 1
+        if self.action == "crash":
+            os._exit(self.exit_code)
+        elif self.action == "sigkill":
+            os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(30)          # SIGKILL needs no handler; just wait
+        elif self.action == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif self.action == "hang":
+            time.sleep(self.seconds if self.seconds is not None else 3600)
+        elif self.action == "sleep":
+            time.sleep(self.seconds if self.seconds is not None else 0.1)
+        elif self.action == "raise":
+            raise OSError(
+                f"fault injected at {point!r}"
+                + (f" step={step}" if step is not None else "")
+                + (f" path={path}" if path is not None else ""))
+        elif self.action == "bitflip":
+            if path is None:
+                raise ValueError(
+                    f"bitflip rule at {point!r} fired without a path")
+            bitflip(path)
+
+
+class FaultPlan:
+    def __init__(self, rules):
+        self.rules = [r if isinstance(r, FaultRule) else FaultRule(r)
+                      for r in rules]
+
+    def fire(self, point, step=None, path=None):
+        for rule in self.rules:
+            if rule.matches(point, step, path):
+                rule.perform(point, step, path)
+
+
+_plan: "FaultPlan | None" = None
+_parsed = False
+
+
+def plan():
+    """The process fault plan parsed (once) from ``PADDLE_TPU_FAULTS``,
+    or None when the variable is unset/empty."""
+    global _plan, _parsed
+    if not _parsed:
+        raw = os.environ.get(PLAN_ENV)
+        _plan = FaultPlan(json.loads(raw)) if raw else None
+        _parsed = True
+    return _plan
+
+
+def reset():
+    """Forget the cached plan so the next :func:`fire` re-reads the
+    environment (test hook; also clears per-rule fire counts)."""
+    global _plan, _parsed
+    _plan = None
+    _parsed = False
+
+
+def active():
+    return plan() is not None
+
+
+def fire(point, step=None, path=None):
+    """Instrumented-point hook: no-op (one cached-None check) without a
+    plan; otherwise every matching rule performs its action in plan
+    order. ``raise`` rules propagate; crash-family rules never return."""
+    p = plan()
+    if p is not None:
+        p.fire(point, step=step, path=path)
+
+
+def rename(src, dst, step=None):
+    """``os.rename`` with an injection point in front: a plan rule at
+    point ``"rename"`` can delay (``sleep``), fail (``raise``), or kill
+    the process (``sigkill``/``crash``) before the rename happens — the
+    torn-commit cases an atomic checkpoint must survive."""
+    fire("rename", step=step, path=dst)
+    os.rename(src, dst)
+
+
+def bitflip(path, offset=None, mask=0xFF):
+    """Flip bits of one byte of ``path`` in place (default: the middle
+    byte). The minimal storage corruption a checksum must catch."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot bitflip empty file {path}")
+    if offset is None:
+        offset = size // 2
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ mask]))
+        f.flush()
+        os.fsync(f.fileno())

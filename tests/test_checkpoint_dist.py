@@ -213,6 +213,49 @@ class TestDistributedCheckpoint:
         assert float(target["lr"]) == 0.5
         np.testing.assert_array_equal(target["hist"], np.arange(3))
 
+    def test_zero_shard_entry_raises_clear_error(self, tmp_path):
+        """Truncated metadata (a tensor entry with zero shards) names
+        the tensor instead of dying with an opaque IndexError."""
+        import json as J, os
+        t = paddle.to_tensor(np.ones((4, 2), np.float32))
+        save_state_dict({"t": t}, str(tmp_path))
+        mf = os.path.join(str(tmp_path), "metadata_p0.json")
+        meta = J.load(open(mf))
+        meta["tensors"]["t"]["shards"] = []
+        J.dump(meta, open(mf, "w"))
+        dst = paddle.to_tensor(np.zeros((4, 2), np.float32))
+        with pytest.raises(ValueError, match="'t'.*no shards"):
+            load_state_dict({"t": dst}, str(tmp_path))
+
+    def test_load_closes_npz_handles(self, tmp_path, monkeypatch):
+        """A resume loop must not leak one fd per shard file per
+        restore: load closes every NpzFile it opened, on success AND on
+        failure."""
+        t = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        save_state_dict({"t": t}, str(tmp_path))
+        opened = []
+        orig = np.load
+
+        def spy(*a, **k):
+            r = orig(*a, **k)
+            opened.append(r)
+            return r
+
+        monkeypatch.setattr(np, "load", spy)
+        dst = paddle.to_tensor(np.zeros((4, 4), np.float32))
+        load_state_dict({"t": dst}, str(tmp_path))
+        assert opened and all(o.zip is None and o.fid is None
+                              for o in opened)
+        # failure path: 't' loads (opens the shard file) before the
+        # missing-key error fires — the handle must still be closed
+        opened.clear()
+        dst2 = paddle.to_tensor(np.zeros((4, 4), np.float32))
+        extra = paddle.to_tensor(np.zeros((2,), np.float32))
+        with pytest.raises(KeyError):
+            load_state_dict({"t": dst2, "nope": extra}, str(tmp_path))
+        assert opened and all(o.zip is None and o.fid is None
+                              for o in opened)
+
     def test_merge_multi_process_metadata(self, tmp_path):
         # simulate a 2-host save: each "process" writes only half the
         # shards; load must merge both metadata slices

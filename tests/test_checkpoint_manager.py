@@ -1,0 +1,546 @@
+"""Atomic/async CheckpointManager + deterministic fault injection.
+
+Bars (ISSUE 4): a save is all-or-nothing — a crash at ANY point of the
+write leaves the previous committed step loadable and bitwise intact;
+restore skips torn ``.tmp`` dirs and checksum-failing steps; retention
+never GCs the newest committed step; async save blocks training only
+for the D2H snapshot. Reference: `fleet/elastic/manager.py`
+(checkpoint-and-relaunch) + `distributed/checkpoint/save_state_dict.py`
+(the sharded format the manager wraps).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint_manager import (
+    CheckpointManager, CheckpointCorruptError)
+from paddle_tpu.testing import faults
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fault_plan(monkeypatch):
+    """Each test sees only its own plan (and never inherits one)."""
+    monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _state(val, dtype=np.float32):
+    return {"w": paddle.to_tensor(np.full((4, 3), val, dtype)),
+            "b": paddle.to_tensor(np.arange(3, dtype=dtype) + val)}
+
+
+class TestAtomicCommit:
+    def test_commit_layout_and_roundtrip(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(_state(1.5), 0)
+        assert mgr.latest_step() == 0
+        d = mgr.step_dir(0)
+        marker = json.load(open(os.path.join(d, "COMMITTED")))
+        assert marker["step"] == 0
+        assert set(marker["files"]) == {"shards_p0.npz",
+                                        "metadata_p0.json"}
+        for name, rec in marker["files"].items():
+            assert os.path.getsize(os.path.join(d, name)) == rec["size"]
+        dst = _state(0.0)
+        assert mgr.restore_latest(dst) == 0
+        np.testing.assert_array_equal(dst["w"].numpy(),
+                                      _state(1.5)["w"].numpy())
+        assert not os.path.exists(d + ".tmp")
+
+    def test_torn_tmp_is_invisible_and_swept(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        torn = mgr.step_dir(5) + ".tmp"
+        os.makedirs(torn)
+        with open(os.path.join(torn, "shards_p0.npz"), "wb") as f:
+            f.write(b"partial garbage")
+        assert mgr.latest_step() is None
+        assert mgr.restore_latest(_state(0.0)) is None
+        mgr.save(_state(2.0), 0)        # the post-commit GC sweeps it
+        assert not os.path.exists(torn)
+        assert mgr.committed_steps() == [0]
+
+    def test_checksum_rejects_bitflip_and_falls_back(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(_state(1.0), 0)
+        mgr.save(_state(2.0), 1)
+        faults.bitflip(os.path.join(mgr.step_dir(1), "shards_p0.npz"))
+        with pytest.raises(CheckpointCorruptError, match="checksum"):
+            mgr.verify_step(1)
+        dst = _state(0.0)
+        assert mgr.restore_latest(dst) == 0       # previous step wins
+        np.testing.assert_array_equal(dst["w"].numpy(),
+                                      _state(1.0)["w"].numpy())
+
+    def test_all_steps_corrupt_raises(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(_state(1.0), 0)
+        faults.bitflip(os.path.join(mgr.step_dir(0), "metadata_p0.json"))
+        with pytest.raises(RuntimeError, match="no restorable"):
+            mgr.restore_latest(_state(0.0))
+
+    def test_missing_committed_file_detected(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(_state(1.0), 0)
+        os.remove(os.path.join(mgr.step_dir(0), "shards_p0.npz"))
+        with pytest.raises(CheckpointCorruptError, match="missing"):
+            mgr.verify_step(0)
+
+    def test_resave_same_step_overwrites(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(_state(1.0), 0)
+        mgr.save(_state(9.0), 0)
+        dst = _state(0.0)
+        assert mgr.restore_latest(dst) == 0
+        np.testing.assert_array_equal(dst["w"].numpy(),
+                                      _state(9.0)["w"].numpy())
+        assert not os.path.exists(mgr.step_dir(0) + ".old")
+
+    def test_resave_crash_mid_write_keeps_previous_commit(
+            self, tmp_path, monkeypatch):
+        """A same-step re-save (e.g. an emergency save of an
+        already-committed step) that dies mid-write must leave the
+        original commit untouched — it is only swapped out once the
+        replacement is fully durable."""
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(_state(1.0), 0)
+        monkeypatch.setenv(faults.PLAN_ENV, json.dumps(
+            [{"point": "ckpt.write", "action": "raise", "count": 1}]))
+        faults.reset()
+        with pytest.raises(OSError):
+            mgr.save(_state(9.0), 0)
+        dst = _state(0.0)
+        assert mgr.restore_latest(dst) == 0
+        np.testing.assert_array_equal(dst["w"].numpy(),
+                                      _state(1.0)["w"].numpy())
+
+    def test_resave_crash_between_renames_recovers_aside(
+            self, tmp_path, monkeypatch):
+        """The only re-save crash window is between the aside rename
+        and the commit rename; discovery promotes the fully-valid aside
+        back to final."""
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(_state(1.0), 0)
+        monkeypatch.setenv(faults.PLAN_ENV, json.dumps(
+            [{"point": "rename", "action": "raise", "count": 1}]))
+        faults.reset()
+        with pytest.raises(OSError):
+            mgr.save(_state(9.0), 0)
+        # final was moved aside before the failed commit rename
+        dst = _state(0.0)
+        assert mgr.restore_latest(dst) == 0
+        np.testing.assert_array_equal(dst["w"].numpy(),
+                                      _state(1.0)["w"].numpy())
+        # a fresh manager (a relaunched process) also recovers it
+        mgr2 = CheckpointManager(str(tmp_path), async_save=False)
+        assert mgr2.latest_step() == 0
+
+
+class TestRetention:
+    def test_gc_keeps_max_to_keep_newest(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=2,
+                                async_save=False)
+        for s in range(5):
+            mgr.save(_state(float(s)), s)
+        assert mgr.committed_steps() == [3, 4]
+        assert mgr.latest_step() == 4
+
+    def test_gc_never_removes_newest(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=1,
+                                async_save=False)
+        for s in range(3):
+            mgr.save(_state(float(s)), s)
+        assert mgr.committed_steps() == [2]
+
+    def test_keep_all_with_none(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=None,
+                                async_save=False)
+        for s in range(4):
+            mgr.save(_state(float(s)), s)
+        assert mgr.committed_steps() == [0, 1, 2, 3]
+
+    def test_max_to_keep_zero_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="max_to_keep"):
+            CheckpointManager(str(tmp_path), max_to_keep=0)
+
+
+class TestAsyncSave:
+    def test_snapshot_isolates_training_mutation(self, tmp_path):
+        """The D2H snapshot is synchronous: mutating parameters right
+        after save() must not leak into the committed bytes."""
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        st = _state(3.0)
+        mgr.save(st, 0)
+        st["w"]._data = st["w"]._data + 100.0   # the next train step
+        st["b"]._data = st["b"]._data * 0.0
+        mgr.wait()
+        dst = _state(0.0)
+        assert mgr.restore_latest(dst) == 0
+        np.testing.assert_array_equal(dst["w"].numpy(),
+                                      _state(3.0)["w"].numpy())
+        np.testing.assert_array_equal(dst["b"].numpy(),
+                                      _state(3.0)["b"].numpy())
+
+    def test_async_failure_surfaces_on_wait(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(faults.PLAN_ENV, json.dumps(
+            [{"point": "rename", "action": "raise"}]))
+        faults.reset()
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save(_state(1.0), 0)
+        with pytest.raises(OSError, match="fault injected"):
+            mgr.wait()
+        assert mgr.latest_step() is None        # nothing committed
+
+    def test_async_failure_surfaces_on_next_save(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv(faults.PLAN_ENV, json.dumps(
+            [{"point": "rename", "action": "raise", "count": 1}]))
+        faults.reset()
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save(_state(1.0), 0)
+        with pytest.raises(OSError, match="fault injected"):
+            mgr.save(_state(2.0), 1)
+        mgr.save(_state(2.0), 1)                # plan exhausted: works
+        mgr.wait()
+        assert mgr.latest_step() == 1
+
+
+class TestFaultHarness:
+    def test_rule_count_limits_fires(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(faults.PLAN_ENV, json.dumps(
+            [{"point": "x", "action": "raise", "count": 2}]))
+        faults.reset()
+        for _ in range(2):
+            with pytest.raises(OSError):
+                faults.fire("x")
+        faults.fire("x")                        # count exhausted: no-op
+
+    def test_step_and_point_filters(self, monkeypatch):
+        monkeypatch.setenv(faults.PLAN_ENV, json.dumps(
+            [{"point": "train.step", "action": "raise", "step": 3}]))
+        faults.reset()
+        faults.fire("train.step", step=2)
+        faults.fire("other", step=3)
+        with pytest.raises(OSError):
+            faults.fire("train.step", step=3)
+
+    def test_env_condition_gates_rule(self, monkeypatch):
+        monkeypatch.setenv(faults.PLAN_ENV, json.dumps(
+            [{"point": "x", "action": "raise",
+              "env": {"PADDLE_RESTART_COUNT": "0"}}]))
+        faults.reset()
+        monkeypatch.delenv("PADDLE_RESTART_COUNT", raising=False)
+        faults.fire("x")                        # env mismatch: inactive
+        monkeypatch.setenv("PADDLE_RESTART_COUNT", "0")
+        with pytest.raises(OSError):
+            faults.fire("x")
+
+    def test_path_glob_matches_basename(self, monkeypatch):
+        monkeypatch.setenv(faults.PLAN_ENV, json.dumps(
+            [{"point": "ckpt.write", "action": "raise",
+              "path": "shards_*.npz"}]))
+        faults.reset()
+        faults.fire("ckpt.write", path="/a/b/metadata_p0.json")
+        with pytest.raises(OSError):
+            faults.fire("ckpt.write", path="/a/b/shards_p0.npz")
+
+    def test_no_plan_is_noop(self):
+        assert not faults.active()
+        faults.fire("anything", step=1, path="/x")
+
+    def test_bitflip_changes_one_byte(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        with open(p, "wb") as f:
+            f.write(bytes(range(16)))
+        faults.bitflip(p, offset=4)
+        data = open(p, "rb").read()
+        assert data[4] == (4 ^ 0xFF)
+        assert bytes(data[:4]) == bytes(range(4))
+        assert bytes(data[5:]) == bytes(range(5, 16))
+
+
+class TestMetricsKillSwitch:
+    def test_disabled_metrics_are_null_and_save_still_works(
+            self, tmp_path, monkeypatch):
+        """ISSUE acceptance: PADDLE_TPU_METRICS=0 makes the new
+        instrumentation a no-op (NULL metrics, no postmortem files) —
+        the checkpoint itself still commits."""
+        from paddle_tpu.observability import metrics as om
+        monkeypatch.setenv("PADDLE_TPU_METRICS", "0")
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        assert mgr._m_saves is om.NULL
+        assert mgr._m_save_seconds is om.NULL
+        assert mgr._m_last is om.NULL
+        mgr.save(_state(1.0), 0)
+        dst = _state(0.0)
+        assert mgr.restore_latest(dst) == 0
+        names = os.listdir(str(tmp_path))
+        assert names == [os.path.basename(mgr.step_dir(0))]
+
+    def test_enabled_metrics_count_saves_and_restores(self, tmp_path):
+        from paddle_tpu.observability import metrics as om
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        saves0 = mgr._m_saves.value
+        restores0 = mgr._m_restores.value
+        mgr.save(_state(1.0), 0)
+        mgr.restore_latest(_state(0.0))
+        assert mgr._m_saves.value == saves0 + 1
+        assert mgr._m_restores.value == restores0 + 1
+        assert om.default_registry().get(
+            "checkpoint_last_committed_step").value == 0
+
+
+# ---------------------------------------------------------------------------
+# subprocess crash tests: the worker really dies (SIGKILL/SIGTERM), so it
+# runs out of process; the training update is pure float64 math, so the
+# parent recomputes the exact expected weights bitwise
+# ---------------------------------------------------------------------------
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, %r)
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint_manager import CheckpointManager
+    from paddle_tpu.testing import faults
+
+    root, steps, freq = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    mgr = CheckpointManager(root, max_to_keep=None, async_save=False)
+    state = {"w": paddle.to_tensor(np.zeros((4,), np.float64))}
+    s = mgr.restore_latest(state)
+    start = 0 if s is None else s + 1
+    print("resume_from", start, flush=True)
+    w = np.asarray(state["w"].numpy(), np.float64).copy()
+    holder = {"w": w, "next": start}
+    mgr.install_preemption_handler(
+        lambda: {"w": paddle.to_tensor(holder["w"])},
+        step_fn=lambda: holder["next"] - 1 if holder["next"] > 0 else None)
+    for step in range(start, steps):
+        faults.fire("train.step", step=step)
+        w = w * 1.5 + step
+        holder["w"] = w
+        holder["next"] = step + 1
+        if (step + 1) %% freq == 0:
+            mgr.save({"w": paddle.to_tensor(w)}, step)
+    print("final", " ".join(repr(float(x)) for x in w), flush=True)
+""") % REPO
+
+
+def _weights_through(last_step):
+    """Worker weights after completing steps 0..last_step (float64,
+    bitwise-reproducible)."""
+    w = np.zeros((4,), np.float64)
+    for step in range(last_step + 1):
+        w = w * 1.5 + step
+    return w
+
+
+def _run_worker(tmp_path, root, steps=6, freq=1, plan=None):
+    script = tmp_path / "ckpt_worker.py"
+    script.write_text(WORKER)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "XLA_FLAGS", faults.PLAN_ENV)}
+    env["JAX_PLATFORMS"] = "cpu"
+    if plan is not None:
+        env[faults.PLAN_ENV] = json.dumps(plan)
+    return subprocess.run(
+        [sys.executable, str(script), str(root), str(steps), str(freq)],
+        env=env, capture_output=True, text=True, timeout=300)
+
+
+class TestCrashMidSave:
+    @pytest.mark.parametrize("point,match", [
+        ("ckpt.write", {"path": "*step_00000003.tmp*"}),
+        ("ckpt.before_marker", {"step": 3}),
+        ("rename", {"step": 3}),
+    ])
+    def test_sigkill_mid_save_preserves_previous_step(
+            self, tmp_path, point, match):
+        """ISSUE acceptance: a worker SIGKILLed at any phase of saving
+        step 3 leaves steps 0..2 committed and verifiable; restore
+        ignores the torn state and yields step 2's weights bitwise."""
+        root = tmp_path / "ckpt"
+        res = _run_worker(tmp_path, root, plan=[
+            {"point": point, "action": "sigkill", **match}])
+        assert res.returncode == -signal.SIGKILL, res.stderr
+        assert "resume_from 0" in res.stdout
+
+        mgr = CheckpointManager(str(root), async_save=False)
+        assert mgr.latest_step() == 2
+        # crash-mid-save never leaves a COMMITTED dir that fails verify
+        for s in mgr.committed_steps():
+            mgr.verify_step(s)
+        state = {"w": paddle.to_tensor(np.zeros((4,), np.float64))}
+        assert mgr.restore_latest(state) == 2
+        got = np.asarray(state["w"].numpy(), np.float64)
+        want = _weights_through(2)
+        assert got.tobytes() == want.tobytes()   # bitwise-identical
+
+    def test_relaunch_resumes_from_committed_step(self, tmp_path):
+        root = tmp_path / "ckpt"
+        res = _run_worker(tmp_path, root, plan=[
+            {"point": "rename", "action": "sigkill", "step": 3}])
+        assert res.returncode == -signal.SIGKILL, res.stderr
+        # second generation: no fault plan — resumes past the crash
+        res2 = _run_worker(tmp_path, root)
+        assert res2.returncode == 0, res2.stdout + res2.stderr
+        assert "resume_from 3" in res2.stdout
+        want = _weights_through(5)
+        final = "final " + " ".join(repr(float(x)) for x in want)
+        assert final in res2.stdout
+        mgr = CheckpointManager(str(root), async_save=False)
+        assert mgr.latest_step() == 5
+
+    def test_sigterm_triggers_emergency_save(self, tmp_path):
+        """Preemption: SIGTERM at step 4 (periodic saves only every 3
+        steps) still commits the step-3 state before exiting 143."""
+        root = tmp_path / "ckpt"
+        res = _run_worker(tmp_path, root, steps=8, freq=3, plan=[
+            {"point": "train.step", "action": "sigterm", "step": 4}])
+        assert res.returncode == 143, (res.returncode, res.stderr)
+        mgr = CheckpointManager(str(root), async_save=False)
+        # periodic save at step 2 + the emergency save at step 3
+        assert mgr.latest_step() == 3
+        state = {"w": paddle.to_tensor(np.zeros((4,), np.float64))}
+        assert mgr.restore_latest(state) == 3
+        got = np.asarray(state["w"].numpy(), np.float64)
+        assert got.tobytes() == _weights_through(3).tobytes()
+
+    def test_sigterm_before_first_step_saves_nothing(self, tmp_path):
+        """Preempted before any optimizer step completed: committing
+        untrained initial weights as step 0 would make a relaunch skip
+        step 0's update — the emergency save must be skipped instead."""
+        root = tmp_path / "ckpt"
+        res = _run_worker(tmp_path, root, steps=6, freq=3, plan=[
+            {"point": "train.step", "action": "sigterm", "step": 0}])
+        assert res.returncode == 143, (res.returncode, res.stderr)
+        mgr = CheckpointManager(str(root), async_save=False)
+        assert mgr.latest_step() is None
+
+
+class TestCheckpointCallback:
+    def _model(self, seed):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi import Model
+        paddle.seed(seed)
+        net = nn.Linear(4, 2)
+        return Model(net), net
+
+    def test_step_saves_and_restore(self, tmp_path):
+        from paddle_tpu.hapi import CheckpointCallback
+        model, net = self._model(seed=7)
+        cb = CheckpointCallback(dir=str(tmp_path), save_freq_steps=2,
+                                async_save=False, on_preemption=False)
+        cb.set_model(model)
+        cb.on_train_begin()
+        assert cb.global_step == 0 and cb.restored_step is None
+        for i in range(5):                    # steps 0..4: saves at 1, 3
+            cb.on_train_batch_end(i)
+        cb.on_train_end()                     # final save at step 4
+        assert cb.manager.committed_steps() == [1, 3, 4]
+
+        model2, net2 = self._model(seed=99)   # different init
+        cb2 = CheckpointCallback(dir=str(tmp_path), async_save=False,
+                                 on_preemption=False)
+        cb2.set_model(model2)
+        cb2.on_train_begin()
+        assert cb2.restored_step == 4
+        assert cb2.global_step == 5           # resumes past the restore
+        np.testing.assert_array_equal(net2.weight.numpy(),
+                                      net.weight.numpy())
+        np.testing.assert_array_equal(net2.bias.numpy(),
+                                      net.bias.numpy())
+
+    def test_fit_integration(self, tmp_path):
+        """The callback rides a real Model.fit loop."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi import CheckpointCallback, Model
+        paddle.seed(3)
+        net = nn.Linear(4, 2)
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                           parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(), jit=False)
+        x = np.random.RandomState(0).randn(16, 4).astype("float32")
+        y = (x.sum(axis=1) > 0).astype("int64")
+        cb = CheckpointCallback(dir=str(tmp_path), save_freq_steps=4,
+                                async_save=False, on_preemption=False)
+        model.fit(list(zip(x, y)), batch_size=4, epochs=2, verbose=0,
+                  callbacks=[cb])
+        # 8 steps over 2 epochs: periodic saves at 3, 7 (+ final is 7)
+        assert cb.manager.latest_step() == 7
+        state = {"model": net.state_dict()}
+        assert cb.manager.restore_latest(state) == 7
+
+    def test_preemption_deferred_to_batch_boundary(self, tmp_path,
+                                                   monkeypatch):
+        """SIGTERM mid-step only flags; the save (of a consistent
+        step-boundary state) + exit happen at the next batch end."""
+        import signal as sig
+
+        from paddle_tpu.hapi import CheckpointCallback
+        model, net = self._model(seed=7)
+        cb = CheckpointCallback(dir=str(tmp_path), save_freq_steps=100,
+                                async_save=False)
+        cb.set_model(model)
+        prev = sig.getsignal(sig.SIGTERM)
+        try:
+            cb.on_train_begin()
+            cb.on_train_batch_end(0)
+            sig.raise_signal(sig.SIGTERM)        # handler: flag only
+            assert cb.manager.latest_step() is None
+            exits = []
+            monkeypatch.setattr(os, "_exit",
+                                lambda code: exits.append(code))
+            cb.on_train_batch_end(1)             # boundary: save + exit
+            assert exits == [128 + sig.SIGTERM]
+            assert cb.manager.latest_step() == 1
+        finally:
+            sig.signal(sig.SIGTERM, prev)
+
+    def test_only_save_rank_commits(self, tmp_path, monkeypatch):
+        """Every rank of a generation gets the same resume dir;
+        non-zero ranks must not race rank 0's commits."""
+        from paddle_tpu.hapi import CheckpointCallback
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        model, _ = self._model(seed=7)
+        cb = CheckpointCallback(dir=str(tmp_path), save_freq_steps=1,
+                                async_save=False, on_preemption=False)
+        cb.set_model(model)
+        cb.on_train_begin()
+        for i in range(3):
+            cb.on_train_batch_end(i)
+        cb.on_train_end()
+        assert cb.manager.latest_step() is None   # rank 1 never saves
+
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        cb0 = CheckpointCallback(dir=str(tmp_path), save_freq_steps=1,
+                                 async_save=False, on_preemption=False)
+        cb0.set_model(model)
+        cb0.on_train_begin()
+        cb0.on_train_batch_end(0)
+        assert cb0.manager.latest_step() == 0
+
+    def test_env_resume_dir_construction(self, tmp_path, monkeypatch):
+        from paddle_tpu.hapi import CheckpointCallback
+        monkeypatch.setenv("PADDLE_TPU_RESUME_DIR", str(tmp_path))
+        cb = CheckpointCallback(on_preemption=False)
+        assert cb.manager.root == str(tmp_path)
+
+    def test_missing_dir_raises(self, monkeypatch):
+        from paddle_tpu.hapi import CheckpointCallback
+        monkeypatch.delenv("PADDLE_TPU_RESUME_DIR", raising=False)
+        with pytest.raises(ValueError, match="PADDLE_TPU_RESUME_DIR"):
+            CheckpointCallback()

@@ -183,6 +183,45 @@ class TestElastic:
             ElasticManager(store, i, 2).register()
         assert ElasticManager(store, 0, 2).watch_once() == "scale_up"
 
+    def test_filestore_ttl_ages_out_crashed_hosts(self, tmp_path):
+        """A host that crashed without deregistering must not count as
+        live forever: with a ttl its stale heartbeat ages out and the
+        manager reports scale_down."""
+        import os
+
+        store = FileStore(str(tmp_path), ttl=30.0)
+        store.register("a")
+        store.register("b")
+        assert store.hosts() == ["a", "b"]
+        # backdate b's heartbeat past the ttl (a crash never refreshes)
+        with open(os.path.join(str(tmp_path), "b"), "w") as f:
+            f.write(str(time.time() - 120.0))
+        assert store.hosts() == ["a"]
+        m = ElasticManager(store, "a", 2)
+        assert m.watch_once() == "scale_down"
+        store.heartbeat("b")            # a fresh beat revives it
+        assert m.watch_once() == "normal"
+
+    def test_filestore_hosts_skips_inflight_stamp_files(self, tmp_path):
+        """register() writes the stamp aside + os.replace (no truncate
+        window); a leftover aside file never shows up as a host."""
+        import os
+
+        store = FileStore(str(tmp_path), ttl=30.0)
+        store.register("a")
+        with open(os.path.join(str(tmp_path), ".stamp.b.999"), "w"):
+            pass                       # a crashed writer's aside file
+        assert store.hosts() == ["a"]
+
+    def test_filestore_no_ttl_keeps_stale_hosts(self, tmp_path):
+        import os
+
+        store = FileStore(str(tmp_path))        # ttl=None: old behavior
+        store.register("a")
+        with open(os.path.join(str(tmp_path), "a"), "w") as f:
+            f.write(str(time.time() - 1e6))
+        assert store.hosts() == ["a"]
+
 
 class TestModuleLevelAPI:
     """Reference usage surface: module-level fleet.* functions

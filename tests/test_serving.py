@@ -8,6 +8,8 @@ reference's serving stack gets from `block_multi_head_attention` +
 batch scheduling.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -94,6 +96,57 @@ def test_engine_full_raises(model):
     engine.add_request(Request([1, 2], max_new_tokens=32))
     with pytest.raises(MemoryError):
         engine.add_request(Request([3], max_new_tokens=4))
+
+
+def test_admission_error_carries_stats(model):
+    """Rejections are typed: AdmissionError (a MemoryError subclass for
+    old callers) reports queue/pool stats + retry count so a frontend
+    can shed load instead of crashing."""
+    from paddle_tpu.inference.serving import AdmissionError
+    from paddle_tpu.observability import metrics as om
+
+    engine = LlamaServingEngine(model, max_batch=1, page_size=8,
+                                num_pages=16, admit_retries=2,
+                                admit_backoff=0.001)
+    engine.add_request(Request([1, 2], max_new_tokens=32))
+    retries0 = engine._m["admit_retries"].value
+    evicted0 = engine._m["evicted"].value
+    with pytest.raises(AdmissionError) as ei:
+        engine.add_request(Request([3], max_new_tokens=4))
+    e = ei.value
+    assert isinstance(e, MemoryError)
+    assert e.reason == "engine full"
+    assert e.live == 1 and e.max_batch == 1
+    assert e.num_pages == engine.alloc.num_pages
+    assert e.retries == 2
+    if engine._m["admit_retries"] is not om.NULL:
+        assert engine._m["admit_retries"].value == retries0 + 2
+        assert engine._m["evicted"].value == evicted0 + 1
+
+
+def test_admission_retry_succeeds_after_release(model):
+    """The bounded backoff admits a request once capacity frees up
+    mid-retry (the concurrent-retirement case)."""
+    import threading
+
+    engine = LlamaServingEngine(model, max_batch=1, page_size=8,
+                                num_pages=16, admit_retries=20,
+                                admit_backoff=0.01)
+    r1 = Request([1, 2], max_new_tokens=32)
+    engine.add_request(r1)
+
+    def retire():
+        time.sleep(0.03)
+        r1.done = True
+        engine.alloc.release(r1.seq_id)
+        del engine._live[r1.seq_id]
+
+    t = threading.Thread(target=retire)
+    t.start()
+    r2 = Request([3], max_new_tokens=1)
+    sid = engine._admit(r2)           # blocks in backoff, then admits
+    t.join()
+    assert sid is not None and r2.seq_id in engine._live
 
 
 def test_page_boundary_crossing(model):
