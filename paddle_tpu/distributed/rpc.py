@@ -18,7 +18,29 @@ import threading
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_current_worker_info", "get_worker_info",
-           "get_all_worker_infos", "WorkerInfo"]
+           "get_all_worker_infos", "WorkerInfo", "RpcTimeoutError"]
+
+
+class RpcTimeoutError(TimeoutError):
+    """A synchronous wait on an RPC reply exceeded its ``timeout`` —
+    the peer is dead, unreachable, or its handler is stuck. Carries the
+    peer name, sequence number and budget so a supervisor can decide to
+    retry, reroute, or declare the worker failed instead of blocking
+    forever."""
+
+    def __init__(self, to=None, seq=None, timeout=None):
+        super().__init__(
+            f"rpc to worker {to!r} (seq {seq}) timed out after "
+            f"{timeout}s — peer dead or handler stuck")
+        self.to = to
+        self.seq = seq
+        self.timeout = timeout
+
+    def __reduce__(self):
+        # a handler's own nested rpc timeout travels back pickled in
+        # the error reply; reconstruct from the typed fields, not the
+        # formatted message
+        return (type(self), (self.to, self.seq, self.timeout))
 
 
 class WorkerInfo:
@@ -31,18 +53,26 @@ class WorkerInfo:
 
 
 class _FutureReply:
-    def __init__(self):
+    def __init__(self, to=None, seq=None, timeout=None):
         self._event = threading.Event()
         self._value = None
         self._error = None
+        self._to = to
+        self._seq = seq
+        self._timeout = timeout
 
     def _set(self, value, error):
         self._value, self._error = value, error
         self._event.set()
 
     def wait(self, timeout=None):
+        """Block for the reply. ``timeout=None`` falls back to the
+        call's own timeout; expiry raises :class:`RpcTimeoutError`
+        (typed — never an indefinite block on a dead peer)."""
+        if timeout is None:
+            timeout = self._timeout
         if not self._event.wait(timeout):
-            raise TimeoutError("rpc reply timed out")
+            raise RpcTimeoutError(self._to, self._seq, timeout)
         if self._error is not None:
             raise self._error
         return self._value
@@ -113,7 +143,7 @@ class _RpcAgent:
         seq = self.store.add(f"rpc/seq/{to}", 1) - 1
         self.store.set(f"rpc/to/{to}/{seq}",
                        pickle.dumps((fn, args or (), kwargs or {})))
-        fut = _FutureReply()
+        fut = _FutureReply(to=to, seq=seq, timeout=timeout)
 
         def waiter():
             # per-call connection: the blocking reply-get must not pin
@@ -128,6 +158,11 @@ class _RpcAgent:
                 else:
                     fut._set(pickle.loads(rsp[3:]), None)
             except Exception as e:
+                if isinstance(e, TimeoutError) \
+                        and not isinstance(e, RpcTimeoutError):
+                    # the store's bare TimeoutError means no reply
+                    # appeared within budget: surface it typed
+                    e = RpcTimeoutError(to, seq, timeout)
                 fut._set(None, e)
                 # Plant a tombstone so the (probably still running)
                 # handler skips publishing its reply; if the reply beat
@@ -214,7 +249,12 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 
 
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=30.0):
-    """Blocking call of ``fn(*args, **kwargs)`` on worker ``to``."""
+    """Blocking call of ``fn(*args, **kwargs)`` on worker ``to``.
+
+    ``timeout`` (seconds) bounds the synchronous wait: a dead peer or a
+    stuck handler raises :class:`RpcTimeoutError` (a
+    :class:`TimeoutError` subclass naming peer/seq/budget) instead of
+    blocking forever."""
     return rpc_async(to, fn, args, kwargs, timeout).wait(timeout)
 
 

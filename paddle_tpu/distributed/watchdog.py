@@ -91,6 +91,24 @@ class StepWatchdog:
         self._fired = False
         self._m_age.set(0.0)
 
+    def arm(self, timeout):
+        """Re-aim the running watchdog at one bounded operation: restart
+        the clock, THEN set the fresh ``timeout`` — the other order
+        lets the poll thread compare the new (small) timeout against a
+        stale idle-period heartbeat and fire spuriously. Lets a single
+        long-lived instance guard operations whose budget varies call
+        to call (e.g. the serving engine's stuck-dispatch detector,
+        whose timeout tracks the dispatch-latency P99)."""
+        self.beat()
+        self.timeout = float(timeout)
+
+    def disarm(self):
+        """Stand down between operations: an infinite timeout never
+        fires, so idle gaps (an engine waiting for traffic) are not
+        stalls. The heartbeat-age gauge keeps exporting."""
+        self.timeout = float("inf")
+        self.beat()
+
     def _loop(self):
         while not self._stop.wait(self._poll):
             if self._last is None:

@@ -19,10 +19,12 @@ from __future__ import annotations
 
 import math
 import threading
+import warnings
 
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import metrics as _om
 from ..ops.paged_attention import paged_attention, paged_attention_xla
 
 __all__ = ["PageAllocator", "PagedKVCache"]
@@ -36,8 +38,18 @@ class PageAllocator:
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq or num_pages
         self._free = list(range(num_pages - 1, -1, -1))
+        self._free_set = set(self._free)
         self._tables: dict[int, list[int]] = {}
         self._lens: dict[int, int] = {}
+        # double-free accounting: release() is idempotent (cancellation
+        # racing a natural completion must not corrupt the free list),
+        # but every ignored release is counted — a growing count means
+        # a caller's lifecycle bookkeeping is wrong
+        self.double_free_count = 0
+        self._m_double_free = _om.counter(
+            "kv_page_double_free_total",
+            "release() calls ignored because the sequence or page was "
+            "already free")
         # free-list mutations are check-then-pop; the serving engine's
         # admission backoff explicitly supports a second thread driving
         # step()/burst, so allocate/free must be atomic or a race leaks
@@ -65,10 +77,16 @@ class PageAllocator:
                 raise MemoryError(
                     f"paged cache exhausted: need {need} pages, "
                     f"{len(self._free)} free")
-            self._tables[seq_id] = [self._free.pop()
+            self._tables[seq_id] = [self._pop_free()
                                     for _ in range(need)]
             self._lens[seq_id] = n_tokens
             return list(self._tables[seq_id])
+
+    def _pop_free(self):
+        # caller holds self._lock
+        p = self._free.pop()
+        self._free_set.discard(p)
+        return p
 
     def extend(self, seq_id, n_tokens=1):
         """Grow a sequence by ``n_tokens`` (decode), allocating pages as
@@ -84,16 +102,41 @@ class PageAllocator:
             while len(table) < need:
                 if not self._free:
                     raise MemoryError("paged cache exhausted on extend")
-                table.append(self._free.pop())
+                table.append(self._pop_free())
             self._lens[seq_id] = new_len
             return ln
 
     def release(self, seq_id):
-        """Return a finished sequence's pages to the free list."""
+        """Return a finished sequence's pages to the free list.
+
+        Idempotent: releasing an unknown / already-released sequence —
+        or a table entry that somehow already sits in the free list —
+        is a no-op counted by ``double_free_count`` (and the
+        ``kv_page_double_free_total`` metric) with a
+        :class:`RuntimeWarning`, so a cancellation racing a natural
+        completion can never corrupt the free list by double-inserting
+        page ids."""
         with self._lock:
-            for p in self._tables.pop(seq_id):
+            table = self._tables.pop(seq_id, None)
+            if table is None:
+                self.double_free_count += 1
+                self._m_double_free.inc()
+                warnings.warn(
+                    f"release of unknown or already-released sequence "
+                    f"{seq_id} ignored", RuntimeWarning, stacklevel=2)
+                return
+            self._lens.pop(seq_id, None)
+            for p in table:
+                if p in self._free_set:
+                    self.double_free_count += 1
+                    self._m_double_free.inc()
+                    warnings.warn(
+                        f"page {p} of sequence {seq_id} already free; "
+                        f"skipping double insert", RuntimeWarning,
+                        stacklevel=2)
+                    continue
                 self._free.append(p)
-            del self._lens[seq_id]
+                self._free_set.add(p)
 
     def context_len(self, seq_id):
         return self._lens[seq_id]

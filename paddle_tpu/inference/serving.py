@@ -30,11 +30,50 @@ Design (TPU-first):
   trip (the dominant cost of dispatch-per-token serving) is amortized
   away. Pages for the whole burst are reserved up front; sequence
   lengths advance on device as the scan carry.
+
+Request lifecycle (robustness layer):
+- Every request moves through ``status``: ``pending`` → ``live`` →
+  one of ``completed`` / ``deadline_exceeded`` / ``cancelled`` /
+  ``requeued`` (evicted under pressure, will retry) / ``evicted``
+  (retry budget exhausted). Terminal failures carry a typed exception
+  in ``req.error`` — never a silently truncated output.
+- **Deadlines**: ``Request(deadline=...)`` (wall-clock TTL from
+  admission) and ``Request(token_budget=...)`` (seconds per generated
+  token) are enforced at wave/step/burst boundaries; an expired
+  request's pages go back to the :class:`PageAllocator` and the next
+  wave can admit into them.
+- **Cancellation**: :meth:`LlamaServingEngine.cancel` is thread-safe
+  and idempotent — safe to fire from a client-abandon callback while
+  another thread drives ``step()``; page release is deferred past any
+  in-flight dispatch so compiled batch shapes are never disturbed.
+- **Degradation ladder**: under admission pressure the engine first
+  *trims* (truncate a lower-priority request's ``max_new_tokens`` to
+  what it already produced, retiring it with partial output), then
+  *evicts* (reclaim the lowest-priority request's pages and re-queue
+  it against its ``retry_budget``), then *sheds* with a typed
+  :class:`AdmissionError` carrying a ``retry_after`` hint.
+- **Graceful drain**: :meth:`LlamaServingEngine.drain` stops admission
+  and finishes or expires the in-flight set within a grace window;
+  :meth:`install_drain_handler` wires that to SIGTERM (the preemption
+  notice) for a clean exit — the serving analog of the checkpoint
+  manager's preemption handler.
+- **Stuck-dispatch watchdog**: a warm decode dispatch exceeding
+  ``stuck_factor`` × its observed P99 trips a
+  :class:`~paddle_tpu.distributed.watchdog.StepWatchdog`, which dumps
+  a flight-recorder post-mortem.
+Fault points ``serve.admit`` / ``serve.decode`` / ``serve.drain``
+(:mod:`paddle_tpu.testing.faults`) make each path reproducibly
+testable.
 """
 
 from __future__ import annotations
 
+import collections
+import contextlib
 import math
+import os
+import signal as _signal
+import threading
 import time
 
 import jax.numpy as jnp
@@ -48,9 +87,11 @@ from ..observability import flight_recorder as _fr
 from ..observability import metrics as _om
 from ..observability.trace import span as _span
 from ..ops.paged_attention import paged_attention
+from ..testing import faults as _faults
 from .paged_cache import PageAllocator
 
-__all__ = ["LlamaServingEngine", "Request", "AdmissionError"]
+__all__ = ["LlamaServingEngine", "Request", "AdmissionError",
+           "DeadlineExceeded"]
 
 
 class AdmissionError(MemoryError):
@@ -61,20 +102,44 @@ class AdmissionError(MemoryError):
     callers catching the engine's old bare raise; the serving
     ``_fatal_guard`` likewise treats it as a routine rejection, not a
     crash worth a flight-recorder dump.
+
+    ``retry_after`` (seconds, may be None) estimates when capacity
+    frees up — derived from the live set's shortest remaining token
+    budget and recent per-token latency — so a frontend can answer
+    with ``Retry-After`` instead of guessing.
     """
 
     def __init__(self, reason, live, max_batch, free_pages, num_pages,
-                 retries):
-        super().__init__(
-            f"{reason} (live={live}/{max_batch}, "
-            f"free_pages={free_pages}/{num_pages}, "
-            f"retries={retries})")
+                 retries, retry_after=None):
+        msg = (f"{reason} (live={live}/{max_batch}, "
+               f"free_pages={free_pages}/{num_pages}, "
+               f"retries={retries})")
+        if retry_after is not None:
+            msg += f" — retry after {retry_after:.3f}s"
+        super().__init__(msg)
         self.reason = reason
         self.live = live
         self.max_batch = max_batch
         self.free_pages = free_pages
         self.num_pages = num_pages
         self.retries = retries
+        self.retry_after = retry_after
+
+
+class DeadlineExceeded(TimeoutError):
+    """Typed terminal result of a request that ran out of wall-clock
+    budget (TTL, per-token budget, or the drain grace window). The
+    partial output stays on ``request.output_ids``; this error on
+    ``request.error`` says *why* it is partial — never a silent
+    truncation."""
+
+    def __init__(self, msg, seq_id=None, elapsed=None, tokens_emitted=0,
+                 reason="deadline"):
+        super().__init__(msg)
+        self.seq_id = seq_id
+        self.elapsed = elapsed
+        self.tokens_emitted = tokens_emitted
+        self.reason = reason
 
 #: latency buckets tuned for serving (TTFT / per-token): 1ms .. 10s
 _LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -99,6 +164,19 @@ def _serving_metrics():
             "serving_admission_retries_total",
             "admission attempts retried after backoff while waiting "
             "for capacity"),
+        "deadline_exceeded": _om.counter(
+            "serving_deadline_exceeded_total",
+            "requests expired by TTL / token budget / drain grace"),
+        "cancelled": _om.counter(
+            "serving_cancelled_total",
+            "requests cancelled by the client before completion"),
+        "degraded": _om.counter(
+            "serving_degraded_total",
+            "degradation-ladder actions under admission pressure",
+            labelnames=("rung",)),
+        "drain_seconds": _om.gauge(
+            "serving_drain_seconds",
+            "duration of the last graceful drain"),
         "queue_depth": _om.gauge(
             "serving_queue_depth", "live requests in the engine"),
         "kv_util": _om.gauge(
@@ -173,18 +251,61 @@ def _page_write_seq(pages, new, page_ids, offs):
 
 
 class Request:
-    """One generation request (seq_id is assigned by the engine)."""
+    """One generation request (seq_id is assigned by the engine).
 
-    def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None):
+    Args:
+        prompt_ids: non-empty 1-D sequence of prompt token ids.
+        max_new_tokens: generation budget, >= 1.
+        eos_token_id: optional early-stop token.
+        deadline: wall-clock TTL in seconds, measured from admission.
+            Past it the request is expired at the next wave/step/burst
+            boundary: its pages are released and ``error`` is set to a
+            :class:`DeadlineExceeded` (partial output preserved).
+        token_budget: seconds allowed per generated token — an
+            alternative deadline of ``token_budget * max_new_tokens``
+            from admission; the tighter of the two wins.
+        priority: higher values win under pressure — the degradation
+            ladder only trims/evicts strictly lower-priority requests.
+        retry_budget: how many times the request may be evicted and
+            re-queued before it fails permanently (status ``evicted``).
+    """
+
+    def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
+                 deadline=None, token_budget=None, priority=0,
+                 retry_budget=1):
         self.prompt_ids = np.asarray(prompt_ids, np.int64).reshape(-1)
         if self.prompt_ids.size == 0:
-            raise ValueError("empty prompt")
-        self.max_new_tokens = max_new_tokens
+            raise ValueError(
+                "prompt_ids is empty: a request needs at least one "
+                "prompt token")
+        if int(max_new_tokens) <= 0:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if deadline is not None and float(deadline) <= 0:
+            raise ValueError(f"deadline must be > 0 seconds, "
+                             f"got {deadline}")
+        if token_budget is not None and float(token_budget) <= 0:
+            raise ValueError(f"token_budget must be > 0 seconds/token, "
+                             f"got {token_budget}")
+        if int(retry_budget) < 0:
+            raise ValueError(
+                f"retry_budget must be >= 0, got {retry_budget}")
+        self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
+        self.deadline = None if deadline is None else float(deadline)
+        self.token_budget = None if token_budget is None \
+            else float(token_budget)
+        self.priority = int(priority)
+        self.retry_budget = int(retry_budget)
         self.output_ids: list[int] = []
         self.seq_id = None
         self.done = False
+        self.status = "pending"
+        self.error = None             # typed terminal failure, or None
+        self.trimmed = False          # budget cut by the ladder
         self._t_admit = None          # set at admission; drives TTFT
+        self._expires_at = None       # perf_counter stamp, or None
+        self._cancel_requested = False  # honored at (re-)admission
 
 
 class LlamaServingEngine:
@@ -194,7 +315,8 @@ class LlamaServingEngine:
 
     def __init__(self, model, max_batch=16, page_size=16, num_pages=None,
                  max_pages_per_seq=None, burst=None, admit_retries=0,
-                 admit_backoff=0.005):
+                 admit_backoff=0.005, stuck_factor=8.0,
+                 stuck_min_timeout=30.0):
         if num_pages is None:
             num_pages = max_batch * 24 + 8
         self.model = model
@@ -213,6 +335,12 @@ class LlamaServingEngine:
         # mid-backoff — opt in for such multithreaded deployments.
         self.admit_retries = int(admit_retries)
         self.admit_backoff = float(admit_backoff)
+        # stuck-dispatch watchdog: a WARM dispatch exceeding
+        # stuck_factor x the observed P99 (floored at stuck_min_timeout
+        # so legitimate recompiles never trip it) dumps a flight
+        # recorder post-mortem. stuck_factor=0/None disables it.
+        self.stuck_factor = stuck_factor
+        self.stuck_min_timeout = float(stuck_min_timeout)
         # page num_pages-1 is the trash page for inactive batch slots
         self.alloc = PageAllocator(num_pages - 1, page_size,
                                    max_pages_per_seq)
@@ -233,6 +361,32 @@ class LlamaServingEngine:
         self._prefill_static = None
         self._prefill_warm_buckets: set[int] = set()
         self._burst_static: dict[int, object] = {}  # burst length -> program
+        # lifecycle state: one re-entrant lock guards _live, the
+        # requeue, deferred releases and entry-depth accounting so
+        # cancel()/drain handlers may fire from any thread
+        self._lock = threading.RLock()
+        # dispatch mutex: step()/_burst()/_prefill_wave bodies are
+        # serialized — two driver threads (or a drain racing an
+        # external driver loop) must never interleave allocator extends
+        # and pool reassignments for the same sequences. Re-entrant so
+        # a step's own requeue pump may prefill.
+        self._dispatch_lock = threading.RLock()
+        self._requeue: collections.deque[Request] = collections.deque()
+        self._deferred_release: list[int] = []
+        self._in_dispatch = False
+        self._entry_depth = 0
+        self._entry_threads: dict[object, int] = {}   # thread -> depth
+        self._flushing = False
+        self._draining = False
+        self._drain_active = False
+        self._pending_drain = None    # (grace, exit_code, on_drained)
+        self._dispatch_count = 0
+        self._dispatch_times: collections.deque[float] = \
+            collections.deque(maxlen=256)
+        self._token_times: collections.deque[float] = \
+            collections.deque(maxlen=512)
+        self._wd = None
+        self._closed = False
 
     def __state_tensors__(self):
         """State-discovery override for ``to_static``: the KV pools are
@@ -241,6 +395,175 @@ class LlamaServingEngine:
         that would donate the same buffers twice. Model params enter via
         ``state=[self.model]``."""
         return []
+
+    # ------------------------------------------------------------------
+    # lifecycle plumbing
+    # ------------------------------------------------------------------
+    @contextlib.contextmanager
+    def _entry(self):
+        """Depth accounting around public entry points. Two jobs: a
+        SIGTERM that lands while an entry is in flight defers its drain
+        to the moment the outermost entry returns (state is
+        boundary-consistent there), mirroring the checkpoint callback's
+        deferred emergency save; and every thread inside an entry is
+        recorded so page releases requested while a DIFFERENT thread is
+        mid-entry (cancel, a concurrent _admit's eviction) are deferred
+        past the whole entry — the in-flight step may still be reading
+        the allocator's tables for those sequences."""
+        me = threading.current_thread()
+        with self._lock:
+            self._entry_depth += 1
+            self._entry_threads[me] = self._entry_threads.get(me, 0) + 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._entry_depth -= 1
+                c = self._entry_threads.get(me, 1) - 1
+                if c:
+                    self._entry_threads[me] = c
+                else:
+                    self._entry_threads.pop(me, None)
+                at_boundary = self._entry_depth == 0
+                if at_boundary:
+                    # the flush below releases pages outside the entry
+                    # count; this flag keeps the SIGTERM handler
+                    # deferring its drain past it (drain -> step ->
+                    # alloc.extend would deadlock on the allocator's
+                    # non-reentrant lock mid-release)
+                    self._flushing = True
+            if at_boundary:
+                try:
+                    self._flush_deferred()
+                finally:
+                    with self._lock:
+                        self._flushing = False
+                        pending = None
+                        # leave _pending_drain for drain()'s epilogue
+                        # when a manual drain is mid-flight — popping
+                        # it here would run a second (no-op) drain and
+                        # exit mid-grace-window
+                        if self._entry_depth == 0 \
+                                and not self._drain_active:
+                            pending = self._pending_drain
+                            if pending is not None:
+                                self._pending_drain = None
+                    if pending is not None:
+                        grace, exit_code, on_drained = pending
+                        self._run_drain_and_exit(grace, exit_code,
+                                                 on_drained)
+
+    def _release_pages(self, seq_id):
+        """Release a sequence's pages — deferred while a dispatch is in
+        flight (the program may still be writing K/V into them) and
+        while ANOTHER thread is inside an engine entry (its setup/emit
+        code may still be reading the allocator for this sequence), so
+        a concurrent admission can never be handed dirty pages and the
+        driving thread never sees tables vanish mid-step."""
+        if seq_id is None:
+            return
+        me = threading.current_thread()
+        with self._lock:
+            others_in_entry = any(t is not me for t in self._entry_threads)
+            if self._in_dispatch or others_in_entry:
+                self._deferred_release.append(seq_id)
+            else:
+                self.alloc.release(seq_id)
+
+    def _flush_deferred(self):
+        with self._lock:
+            if self._in_dispatch:
+                return      # the dispatch's own epilogue will flush
+            pending, self._deferred_release = self._deferred_release, []
+        for sid in pending:
+            # idempotent: racing a natural completion is a no-op
+            self.alloc.release(sid)
+
+    def _retire(self, req, status, error=None):
+        """Terminal transition: remove from the live set, free pages,
+        stamp status/error. Idempotent under the engine lock."""
+        with self._lock:
+            if req.done:
+                return False
+            req.done = True
+            req.status = status
+            req.error = error
+            if req.seq_id in self._live:
+                del self._live[req.seq_id]
+                self._release_pages(req.seq_id)
+            return True
+
+    def _expire(self, req, reason="deadline", now=None):
+        now = time.perf_counter() if now is None else now
+        elapsed = None if req._t_admit is None else now - req._t_admit
+        err = DeadlineExceeded(
+            f"request {req.seq_id} exceeded its {reason} after "
+            f"{0.0 if elapsed is None else elapsed:.3f}s "
+            f"({len(req.output_ids)}/{req.max_new_tokens} tokens "
+            f"emitted)", seq_id=req.seq_id, elapsed=elapsed,
+            tokens_emitted=len(req.output_ids), reason=reason)
+        if self._retire(req, "deadline_exceeded", err):
+            self._m["deadline_exceeded"].inc()
+
+    def _expire_deadlines(self):
+        """Expire every live request past its deadline — called at
+        wave/step/burst boundaries (the granularity that exists once a
+        dispatch is on device)."""
+        now = time.perf_counter()
+        with self._lock:
+            expired = [r for r in self._live.values()
+                       if not r.done and r._expires_at is not None
+                       and now >= r._expires_at]
+        for r in expired:
+            self._expire(r, now=now)
+
+    def cancel(self, req):
+        """Cancel a live request (by :class:`Request` or seq_id).
+
+        Thread-safe and idempotent — wire it directly to a client-abandon
+        callback. The request retires with status ``"cancelled"`` and
+        its partial output intact; its pages return to the allocator
+        (deferred past any in-flight dispatch, so compiled batch shapes
+        are never disturbed mid-flight). Reaches both live requests and
+        requests parked on the eviction requeue (an abandoned request
+        must not be pumped back in and decoded for nobody). Returns
+        True if this call did the cancellation, False if the request
+        was already terminal or unknown."""
+        with self._entry():
+            with self._lock:
+                if isinstance(req, Request):
+                    r = req
+                    if r.done:
+                        return False
+                    # sticky: even if the request is momentarily
+                    # unreachable (popped by the requeue pump, mid
+                    # re-admission), the admission path honors this
+                    r._cancel_requested = True
+                    if r in self._requeue:
+                        self._requeue.remove(r)
+                        r.done = True
+                        r.status = "cancelled"
+                        self._m["cancelled"].inc()
+                        return True
+                    if r.seq_id is None \
+                            or self._live.get(r.seq_id) is not r:
+                        if r.status == "pending":
+                            # never admitted: terminal right away, not
+                            # a dangling flag the caller must poll
+                            r.done = True
+                            r.status = "cancelled"
+                            self._m["cancelled"].inc()
+                        # else: popped by the requeue pump mid
+                        # re-admission — the flag is honored there
+                        return True
+                else:
+                    r = self._live.get(req)
+                    if r is None or r.done:
+                        return False
+                if self._retire(r, "cancelled"):
+                    self._m["cancelled"].inc()
+                    return True
+                return False
 
     # ------------------------------------------------------------------
     # prefill
@@ -289,9 +612,20 @@ class LlamaServingEngine:
 
     @_fatal_guard("serving.prefill_wave")
     def _prefill_wave(self, reqs):
-        """Prefill 1..max_batch admitted requests in ONE compiled call."""
-        if not reqs:
-            return
+        """Prefill 1..max_batch admitted requests in ONE compiled call.
+        Requests that expired or were cancelled since admission are
+        skipped (their pages are already back in the pool)."""
+        with self._entry(), self._dispatch_lock:
+            self._expire_deadlines()
+            with self._lock:
+                reqs = [r for r in reqs
+                        if not r.done and r.seq_id in self._live]
+                sids = [r.seq_id for r in reqs]
+            if not reqs:
+                return
+            self._do_prefill_wave(reqs, sids)
+
+    def _do_prefill_wave(self, reqs, sids):
         b = self.max_batch
         n_max = max(len(r.prompt_ids) for r in reqs)
         # bucket the padded length so ragged prompts share compiled
@@ -304,7 +638,7 @@ class LlamaServingEngine:
         for i, r in enumerate(reqs):
             n = len(r.prompt_ids)
             padded[i, :n] = r.prompt_ids
-            rp, ro = self.alloc.page_positions(r.seq_id, 0, n)
+            rp, ro = self.alloc.page_positions(sids[i], 0, n)
             page_ids[i, :n] = rp
             offs[i, :n] = ro
             last_pos[i] = n - 1
@@ -342,18 +676,31 @@ class LlamaServingEngine:
             for r in reqs:
                 if r._t_admit is not None:
                     r._t_admit += warm_dur
+                if r._expires_at is not None:
+                    # the deadline clock starts at admission; compile
+                    # warmup is engine overhead, not request time
+                    r._expires_at += warm_dur
             self._prefill_warm_buckets.add(bucket)
-        with no_grad(), _span("serving.prefill_wave", wave=len(reqs),
-                              bucket=bucket):
-            nxt, new_k, new_v = self._prefill_static(
-                Tensor(jnp.asarray(padded)),
-                Tensor(jnp.asarray(last_pos)),
-                Tensor(jnp.asarray(page_ids)), Tensor(jnp.asarray(offs)),
-                self.k_pools, self.v_pools)
+        with self._lock:
+            self._in_dispatch = True
+        try:
+            with no_grad(), _span("serving.prefill_wave", wave=len(reqs),
+                                  bucket=bucket):
+                nxt, new_k, new_v = self._prefill_static(
+                    Tensor(jnp.asarray(padded)),
+                    Tensor(jnp.asarray(last_pos)),
+                    Tensor(jnp.asarray(page_ids)), Tensor(jnp.asarray(offs)),
+                    self.k_pools, self.v_pools)
+        finally:
+            with self._lock:
+                self._in_dispatch = False
+        self._flush_deferred()
         self.k_pools, self.v_pools = list(new_k), list(new_v)
         first = np.asarray(nxt._data).reshape(-1)
         for i, r in enumerate(reqs):
-            self._emit(r, int(first[i]))
+            if not r.done and r.seq_id == sids[i]:
+                self._emit(r, int(first[i]))
+        self._expire_deadlines()
         self._set_pool_gauges()
 
     # ------------------------------------------------------------------
@@ -406,6 +753,42 @@ class LlamaServingEngine:
                       differentiable=False)
 
     # ------------------------------------------------------------------
+    # stuck-dispatch watchdog
+    # ------------------------------------------------------------------
+    def _arm_watchdog(self, cold):
+        """Arm the shared StepWatchdog for one dispatch: timeout =
+        max(stuck_min_timeout, stuck_factor x P99 of warm dispatches).
+        Cold dispatches (trace + compile, legitimately multi-second)
+        never arm; with < 8 samples there is no P99 worth trusting."""
+        if cold or not self.stuck_factor or self._closed:
+            return
+        times = self._dispatch_times
+        if len(times) < 8:
+            return
+        s = sorted(times)
+        p99 = s[min(len(s) - 1, int(math.ceil(0.99 * len(s))) - 1)]
+        if self._wd is None:
+            from ..distributed.watchdog import StepWatchdog
+            self._wd = StepWatchdog(timeout=float("inf"),
+                                    name="serving.decode").start()
+        self._wd.arm(max(self.stuck_min_timeout, self.stuck_factor * p99))
+
+    def _disarm_watchdog(self, duration=None, cold=False):
+        if duration is not None and not cold:
+            self._dispatch_times.append(duration)
+        if self._wd is not None:
+            self._wd.disarm()
+
+    def close(self):
+        """Release engine-owned background resources (the stuck-dispatch
+        watchdog thread). Idempotent; the engine stays usable but
+        unwatched — later dispatches will NOT respawn the watchdog."""
+        self._closed = True
+        if self._wd is not None:
+            self._wd.stop()
+            self._wd = None
+
+    # ------------------------------------------------------------------
     # scheduling
     # ------------------------------------------------------------------
     def _set_pool_gauges(self):
@@ -420,36 +803,275 @@ class LlamaServingEngine:
             _cw.sample_device_memory(min_interval=1.0)
             _fr.periodic_snapshot()
 
-    def _admit(self, req):
-        attempt = 0
-        while True:
-            reason = None
+    def _validate(self, req):
+        cap_pages = min(self.alloc.max_pages_per_seq, self.alloc.num_pages)
+        max_prompt = cap_pages * self.page_size
+        n = len(req.prompt_ids)
+        if n > max_prompt:
+            raise ValueError(
+                f"prompt of {n} tokens exceeds this engine's KV capacity "
+                f"of {max_prompt} tokens ({cap_pages} pages x "
+                f"{self.page_size} slots); split the prompt or size the "
+                f"pool up (num_pages/max_pages_per_seq)")
+
+    def _retry_after(self):
+        """Seconds until capacity plausibly frees: the live set's
+        shortest remaining token budget x recent median per-token
+        latency. Falls back to one backoff quantum without history."""
+        with self._lock:
+            live = [r for r in self._live.values() if not r.done]
+            times = sorted(self._token_times)
+        if not live or not times:
+            return max(self.admit_backoff, 0.005)
+        remaining = min(max(1, r.max_new_tokens - len(r.output_ids))
+                        for r in live)
+        return round(remaining * times[len(times) // 2], 4)
+
+    def _try_reserve(self, req):
+        """One admission attempt: capacity check, page reservation and
+        live-set insertion are ONE atomic transition under the engine
+        lock, so two admitting threads can never push the live set past
+        ``max_batch`` between a check and an insert. Returns a failure
+        reason or None."""
+        try:
+            # outside the lock: a hang/sleep fault must not wedge the
+            # engine lock, and an injected MemoryError rides the same
+            # pool-exhausted path the real allocator raises
+            _faults.fire("serve.admit", step=self._dispatch_count)
+        except MemoryError:
+            return "KV page pool exhausted"
+        with self._lock:
+            if self._draining:
+                return "draining"
             if len(self._live) >= self.max_batch:
-                reason = "engine full"
+                return "engine full"
+            try:
+                self.alloc.admit(req.seq_id, len(req.prompt_ids))
+            except MemoryError:
+                return "KV page pool exhausted"
+            self._live[req.seq_id] = req
+            req.status = "live"
+        return None
+
+    def _degrade_trim(self, req, tried):
+        """Ladder rung 1: truncate the lowest-priority victim's
+        ``max_new_tokens`` to what it already produced, retiring it NOW
+        with partial output (status ``completed``, ``trimmed=True``) —
+        frees its batch slot and pages without discarding work."""
+        with self._lock:
+            victims = [r for r in self._live.values()
+                       if not r.done and r.priority < req.priority
+                       and r.output_ids and r.seq_id not in tried]
+            if not victims:
+                return False
+            v = min(victims, key=lambda r: (r.priority, len(r.output_ids)))
+            tried.add(v.seq_id)
+            self._trim(v)
+        return True
+
+    def _trim(self, v):
+        """Shared trim bookkeeping: truncate the victim's budget to what
+        it already produced and retire it NOW (partial output kept,
+        ``trimmed=True``). Caller holds the engine lock."""
+        v.max_new_tokens = max(1, len(v.output_ids))
+        v.trimmed = True
+        if self._retire(v, "completed"):
+            self._m["completed"].inc()
+            self._m["degraded"].labels("trim").inc()
+
+    def _evict(self, v):
+        """Shared eviction bookkeeping: reclaim the victim's pages and
+        re-queue it against its ``retry_budget`` (a re-admission
+        restarts generation from scratch — its KV is gone) or fail it
+        typed when the budget is spent. A victim that turned terminal
+        (or was already requeued) since selection is left alone."""
+        with self._lock:
+            if v.done or v.seq_id is None:
+                return
+            if v.seq_id in self._live:
+                del self._live[v.seq_id]
+            self._release_pages(v.seq_id)
+            if v.retry_budget > 0:
+                v.retry_budget -= 1
+                v.output_ids = []
+                v.status = "requeued"
+                v._t_admit = None
+                v._expires_at = None
+                # a fresh seq_id on re-admission: the old id may still
+                # have a deferred page release in flight
+                v.seq_id = None
+                self._requeue.append(v)
             else:
-                if req.seq_id is None:
-                    req.seq_id = self._next_id
-                    self._next_id += 1
-                try:
-                    self.alloc.admit(req.seq_id, len(req.prompt_ids))
-                except MemoryError:
-                    reason = "KV page pool exhausted"
+                v.done = True
+                v.status = "evicted"
+                v.error = AdmissionError(
+                    "evicted under pressure; retry budget exhausted",
+                    live=len(self._live), max_batch=self.max_batch,
+                    free_pages=self.alloc.free_pages,
+                    num_pages=self.alloc.num_pages, retries=0)
+            self._m["degraded"].labels("evict").inc()
+
+    def _degrade_evict(self, req):
+        """Ladder rung 2: evict the lowest-priority victim — pages
+        reclaimed; the victim restarts from scratch via the requeue
+        (``retry_budget`` permitting) or fails typed."""
+        with self._lock:
+            victims = [r for r in self._live.values()
+                       if not r.done and r.priority < req.priority]
+            if not victims:
+                return False
+            v = min(victims, key=lambda r: (r.priority, len(r.output_ids)))
+            self._evict(v)
+        return True
+
+    def _relieve_pressure(self, live, n):
+        """Decode-boundary rung of the degradation ladder: when the
+        pool cannot hold the next ``n`` tokens for every live sequence,
+        evict the lowest-priority (then least-progressed) victim until
+        the rest fit — shed or degrade, never crash mid-step with a
+        torn allocator. Returns the surviving live list. Caller holds
+        the engine lock."""
+        page = self.page_size
+        live = list(live)
+        # a sequence about to cross its per-seq table cap can NEVER
+        # take this step, and a retry would deterministically hit the
+        # same wall — trim it (retire with the output it produced,
+        # ``trimmed=True``) rather than burn its retry budget on full
+        # regenerations or let alloc.extend raise mid-loop
+        for r in list(live):
+            need_pages = -(-(self.alloc._lens[r.seq_id] + n) // page)
+            if need_pages > self.alloc.max_pages_per_seq:
+                live.remove(r)
+                self._trim(r)
+        # while another thread is mid-entry, victim releases would be
+        # DEFERRED — evicting could not free a single page, so victims
+        # are merely POSTPONED from this dispatch (no state change;
+        # they rejoin at the next boundary, after the flush)
+        me = threading.current_thread()
+        deferrals_blocked = self._in_dispatch \
+            or any(t is not me for t in self._entry_threads)
+        while live:
+            need = sum(
+                max(0, -(-(self.alloc._lens[r.seq_id] + n) // page)
+                    - len(self.alloc._tables[r.seq_id]))
+                for r in live)
+            if need <= self.alloc.free_pages:
+                break
+            v = min(live, key=lambda r: (r.priority, len(r.output_ids)))
+            live.remove(v)
+            if not deferrals_blocked:
+                self._evict(v)
+        return live
+
+    def _pump_requeue(self):
+        """Continuous-batching re-admission at step/burst boundaries:
+        requests the ladder parked on the requeue rejoin the batch as
+        capacity allows, so plain ``add_request()`` + ``step()``
+        drivers (no :meth:`generate` loop) never strand an evicted
+        request in limbo. Everything admitted prefills as ONE wave."""
+        admitted = []
+        while True:
+            with self._lock:
+                if self._draining or not self._requeue \
+                        or len(self._live) >= self.max_batch:
+                    break
+                nxt = self._requeue.popleft()
+            if nxt.done:
+                continue
+            try:
+                # quiet probe: no backoff sleeps inside the dispatch
+                # lock (the pump retries at the next boundary anyway)
+                # and a re-park is not a shed for the metrics
+                self._admit_locked(nxt, quiet_retry=True)
+            except AdmissionError:
+                with self._lock:
+                    self._requeue.appendleft(nxt)
+                break
+            admitted.append(nxt)
+        if admitted:
+            self._prefill_wave(admitted)
+
+    def _admit(self, req):
+        """Admit one request, walking the degradation ladder under
+        pressure: trim -> evict -> (bounded backoff) -> shed with a
+        ``retry_after`` hint. Raises :class:`ValueError` for requests
+        that can NEVER fit (prompt longer than the pool) and
+        :class:`AdmissionError` for transient pressure."""
+        with self._entry():
+            return self._admit_locked(req)
+
+    def _admit_locked(self, req, quiet_retry=False):
+        with self._lock:
+            if req._cancel_requested and not req.done:
+                # a client abandon raced an eviction/re-admission:
+                # honor it here instead of decoding for nobody
+                req.done = True
+                req.status = "cancelled"
+                self._m["cancelled"].inc()
+                return req.seq_id
+        if req.done:
+            return req.seq_id
+        self._validate(req)
+        self._expire_deadlines()      # expired requests free capacity
+        with self._lock:
+            if req.seq_id is None:
+                req.seq_id = self._next_id
+                self._next_id += 1
+        attempt = 0
+        trim_tried: set[int] = set()
+        while True:
+            reason = self._try_reserve(req)
             if reason is None:
                 break
-            if attempt >= self.admit_retries:
-                self._m["evicted"].inc()
-                raise AdmissionError(
-                    reason, live=len(self._live),
-                    max_batch=self.max_batch,
-                    free_pages=self.alloc.free_pages,
-                    num_pages=self.alloc.num_pages, retries=attempt)
-            # bounded backoff: a concurrent step()/burst may retire a
-            # request and release its pages before the retry
-            attempt += 1
-            self._m["admit_retries"].inc()
-            time.sleep(self.admit_backoff * (2 ** (attempt - 1)))
-        self._live[req.seq_id] = req
-        req._t_admit = time.perf_counter()
+            # while a dispatch is in flight — or any other thread is
+            # mid-entry — victim page releases are DEFERRED, so
+            # trimming/evicting cannot free pages yet and destroying
+            # lower-priority work would gain nothing; fall through to
+            # backoff (which can observe the post-entry flush) or shed
+            me = threading.current_thread()
+            with self._lock:
+                pages_blocked = (
+                    reason == "KV page pool exhausted"
+                    and (self._in_dispatch
+                         or any(t is not me
+                                for t in self._entry_threads)))
+            if reason != "draining" and not pages_blocked:
+                if self._degrade_trim(req, trim_tried):
+                    continue
+                if self._degrade_evict(req):
+                    continue
+            if reason != "draining":
+                if not quiet_retry and attempt < self.admit_retries:
+                    # bounded backoff: a concurrent step()/burst may
+                    # retire a request and release its pages before the
+                    # retry
+                    attempt += 1
+                    self._m["admit_retries"].inc()
+                    time.sleep(self.admit_backoff * (2 ** (attempt - 1)))
+                    continue
+                if not quiet_retry:
+                    # drain gating and the requeue pump's boundary
+                    # probes are not capacity pressure: only real
+                    # pressure rejections feed the evicted/shed metrics
+                    self._m["evicted"].inc()
+                    self._m["degraded"].labels("shed").inc()
+            raise AdmissionError(
+                reason, live=len(self._live),
+                max_batch=self.max_batch,
+                free_pages=self.alloc.free_pages,
+                num_pages=self.alloc.num_pages, retries=attempt,
+                retry_after=self._retry_after())
+        # _try_reserve already made the request live; stamp the clocks
+        now = time.perf_counter()
+        with self._lock:
+            req._t_admit = now
+            ttl = None
+            if req.deadline is not None:
+                ttl = req.deadline
+            if req.token_budget is not None:
+                budget = req.token_budget * req.max_new_tokens
+                ttl = budget if ttl is None else min(ttl, budget)
+            req._expires_at = None if ttl is None else now + ttl
         self._m["admitted"].inc()
         self._m["prefill_tokens"].inc(len(req.prompt_ids))
         self._set_pool_gauges()
@@ -469,24 +1091,24 @@ class LlamaServingEngine:
         self._m["generated"].inc()
         if (req.eos_token_id is not None and token == req.eos_token_id) \
                 or len(req.output_ids) >= req.max_new_tokens:
-            req.done = True
-            self.alloc.release(req.seq_id)
-            del self._live[req.seq_id]
-            self._m["completed"].inc()
+            if self._retire(req, "completed"):
+                self._m["completed"].inc()
         # pool gauges are refreshed once per wave/step/burst by the
         # caller, not per emitted token — only the post-loop value is
         # observable anyway
 
-    def _views_np(self, live):
-        """Padded (tokens?, tables, lens) numpy views for the full
-        [max_batch] slot layout — pure host work, ONE H2D per array."""
+    def _views_np(self, sids):
+        """Padded (tables, lens) numpy views for the full [max_batch]
+        slot layout — pure host work, ONE H2D per array. Takes the
+        dispatch's seq-id snapshot, not live Request objects, so
+        concurrent lifecycle transitions can't tear the view."""
         b = self.max_batch
         tables = np.full((b, self.width), self.trash_page, np.int32)
         lens = np.ones((b,), np.int32)
-        for i, r in enumerate(live):
-            t = self.alloc._tables[r.seq_id]
+        for i, sid in enumerate(sids):
+            t = self.alloc._tables[sid]
             tables[i, :len(t)] = t
-            lens[i] = self.alloc._lens[r.seq_id]
+            lens[i] = self.alloc._lens[sid]
         return tables, lens
 
     def _ensure_decode_compiled(self):
@@ -501,36 +1123,76 @@ class LlamaServingEngine:
     def step(self):
         """Decode one token for every live request. Returns the number of
         live requests served."""
-        live = [r for r in self._live.values() if not r.done]
-        if not live:
-            return 0
-        # a cold call traces + compiles inside the timed window; that
-        # one-time multi-second sample would skew the tpot histogram
-        # (top bucket 10s) forever, so it is not observed
-        cold = self._decode_static is None
-        t0 = time.perf_counter()
-        # account the new token BEFORE building views: the write offset
-        # and the kernel's context length both include it
-        for r in live:
-            self.alloc.extend(r.seq_id, 1)
-        tokens = np.zeros((self.max_batch, 1), np.int64)
-        for i, r in enumerate(live):
-            tokens[i, 0] = r.output_ids[-1] if r.output_ids \
-                else r.prompt_ids[-1]
-        tables, lens = self._views_np(live)
-        step = self._ensure_decode_compiled()
-        with _span("serving.decode_step", live=len(live)):
-            nxt, new_k, new_v = step(
-                Tensor(jnp.asarray(tokens)), Tensor(jnp.asarray(tables)),
-                Tensor(jnp.asarray(lens)), self.k_pools, self.v_pools)
-        self.k_pools, self.v_pools = list(new_k), list(new_v)
-        out = np.asarray(nxt._data).reshape(-1)
-        if not cold:
-            self._m["tpot"].observe(time.perf_counter() - t0)
-        for i, r in enumerate(live):
-            self._emit(r, int(out[i]))
-        self._set_pool_gauges()
-        return len(live)
+        with self._entry(), self._dispatch_lock:
+            self._expire_deadlines()
+            self._pump_requeue()
+            with self._lock:
+                if not any(not r.done for r in self._live.values()):
+                    return 0
+            # before any allocator mutation: an injected raise aborts
+            # the dispatch cleanly instead of leaving lens advanced
+            # with no K/V written
+            _faults.fire("serve.decode", step=self._dispatch_count)
+            self._dispatch_count += 1
+            with self._lock:
+                live = [r for r in self._live.values() if not r.done]
+                live = self._relieve_pressure(live, 1)
+                # seq ids and last tokens are snapshotted under the
+                # lock: a concurrent cancel/evict may null seq_id or
+                # swap output_ids mid-setup, but this dispatch keeps
+                # reading its own consistent view (the pages stay
+                # reserved — cross-thread releases defer past _entry)
+                sids = [r.seq_id for r in live]
+                last_tok = [r.output_ids[-1] if r.output_ids
+                            else int(r.prompt_ids[-1]) for r in live]
+                # account the new token while still holding the lock:
+                # _relieve_pressure proved the pages exist, and the lock
+                # keeps a concurrent admission from consuming them
+                # between the proof and the extend
+                for sid in sids:
+                    self.alloc.extend(sid, 1)
+            if not live:
+                return 0
+            # a cold call traces + compiles inside the timed window; that
+            # one-time multi-second sample would skew the tpot histogram
+            # (top bucket 10s) forever, so it is not observed
+            cold = self._decode_static is None
+            t0 = time.perf_counter()
+            tokens = np.zeros((self.max_batch, 1), np.int64)
+            for i, t in enumerate(last_tok):
+                tokens[i, 0] = t
+            tables, lens = self._views_np(sids)
+            step = self._ensure_decode_compiled()
+            self._arm_watchdog(cold)
+            with self._lock:
+                self._in_dispatch = True
+            try:
+                with _span("serving.decode_step", live=len(live)):
+                    nxt, new_k, new_v = step(
+                        Tensor(jnp.asarray(tokens)),
+                        Tensor(jnp.asarray(tables)),
+                        Tensor(jnp.asarray(lens)),
+                        self.k_pools, self.v_pools)
+            finally:
+                with self._lock:
+                    self._in_dispatch = False
+                dur = time.perf_counter() - t0
+                self._disarm_watchdog(dur, cold=cold)
+            self._flush_deferred()
+            self.k_pools, self.v_pools = list(new_k), list(new_v)
+            out = np.asarray(nxt._data).reshape(-1)
+            if not cold:
+                self._m["tpot"].observe(dur)
+                self._token_times.append(dur)
+            for i, r in enumerate(live):
+                # the seq_id check drops rows whose request was evicted
+                # and requeued mid-dispatch — its (cleared) output must
+                # not receive this stale token
+                if not r.done and r.seq_id == sids[i]:
+                    self._emit(r, int(out[i]))
+            self._expire_deadlines()
+            self._set_pool_gauges()
+            return len(live)
 
     # ------------------------------------------------------------------
     # burst decode: n steps = ONE compiled program (lax.scan)
@@ -584,58 +1246,100 @@ class LlamaServingEngine:
     def _burst(self, n):
         """Decode ``n`` tokens for every live request in one dispatch.
         Pages for all n tokens are reserved up front; requests that
-        retire mid-burst (EOS / max_new_tokens) have their tail tokens
-        discarded at emit time — bounded waste, no correctness impact."""
-        live = [r for r in self._live.values() if not r.done]
-        if not live or n <= 0:
-            return 0
-        # as in step(): each new burst length compiles on its first
-        # call — don't let that land n inflated samples in tpot
-        cold = n not in self._burst_static
-        t0 = time.perf_counter()
-        start_lens = {r.seq_id: self.alloc._lens[r.seq_id] for r in live}
-        for r in live:
-            self.alloc.extend(r.seq_id, n)
-        b = self.max_batch
-        tables = np.full((b, self.width), self.trash_page, np.int32)
-        lens = np.ones((b,), np.int32)
-        tokens = np.zeros((b, 1), np.int64)
-        for i, r in enumerate(live):
-            t = self.alloc._tables[r.seq_id]
-            tables[i, :len(t)] = t
-            lens[i] = start_lens[r.seq_id] + 1   # first new token included
-            tokens[i, 0] = r.output_ids[-1] if r.output_ids \
-                else r.prompt_ids[-1]
-        sf = self._ensure_burst_compiled(n)
-        with no_grad(), _span("serving.decode_burst", live=len(live),
-                              burst=n):
-            out = sf(
-                Tensor(jnp.asarray(tokens)), Tensor(jnp.asarray(tables)),
-                Tensor(jnp.asarray(lens)), self.k_pools, self.v_pools)
-        n_layers = len(self.k_pools)
-        toks = out[0]
-        self.k_pools = list(out[1:1 + n_layers])
-        self.v_pools = list(out[1 + n_layers:])
-        all_tokens = np.asarray(toks._data)          # one D2H
-        # one scan tick serves every live row: per-token latency is the
-        # dispatch wall time amortized over the n ticks
-        if not cold:
-            tick = (time.perf_counter() - t0) / n
-            for _ in range(n):
-                self._m["tpot"].observe(tick)
-        served = 0
-        for i, r in enumerate(live):
-            for t in range(n):
-                if r.done:
-                    break
-                self._emit(r, int(all_tokens[i, t]))
-                served += 1
-        self._set_pool_gauges()
-        return served
+        retire mid-burst (EOS / max_new_tokens / expired deadline) have
+        their tail tokens discarded at emit time — bounded waste, no
+        correctness impact."""
+        with self._entry(), self._dispatch_lock:
+            self._expire_deadlines()
+            self._pump_requeue()
+            with self._lock:
+                if n <= 0 or not any(not r.done
+                                     for r in self._live.values()):
+                    return 0
+            # as in step(): fire before any allocator mutation
+            _faults.fire("serve.decode", step=self._dispatch_count)
+            self._dispatch_count += 1
+            with self._lock:
+                live = [r for r in self._live.values() if not r.done]
+                live = self._relieve_pressure(live, n)
+                sids = [r.seq_id for r in live]
+                last_tok = [r.output_ids[-1] if r.output_ids
+                            else int(r.prompt_ids[-1]) for r in live]
+                # reserve the whole burst under the lock (see step())
+                start_lens = {sid: self.alloc._lens[sid] for sid in sids}
+                for sid in sids:
+                    self.alloc.extend(sid, n)
+            if not live:
+                return 0
+            # as in step(): each new burst length compiles on its first
+            # call — don't let that land n inflated samples in tpot
+            cold = n not in self._burst_static
+            t0 = time.perf_counter()
+            b = self.max_batch
+            tables = np.full((b, self.width), self.trash_page, np.int32)
+            lens = np.ones((b,), np.int32)
+            tokens = np.zeros((b, 1), np.int64)
+            for i, sid in enumerate(sids):
+                t = self.alloc._tables[sid]
+                tables[i, :len(t)] = t
+                lens[i] = start_lens[sid] + 1       # first new token incl.
+                tokens[i, 0] = last_tok[i]
+            sf = self._ensure_burst_compiled(n)
+            self._arm_watchdog(cold)
+            with self._lock:
+                self._in_dispatch = True
+            try:
+                with no_grad(), _span("serving.decode_burst",
+                                      live=len(live), burst=n):
+                    out = sf(
+                        Tensor(jnp.asarray(tokens)),
+                        Tensor(jnp.asarray(tables)),
+                        Tensor(jnp.asarray(lens)),
+                        self.k_pools, self.v_pools)
+            finally:
+                with self._lock:
+                    self._in_dispatch = False
+                dur = time.perf_counter() - t0
+                self._disarm_watchdog(dur, cold=cold)
+            self._flush_deferred()
+            n_layers = len(self.k_pools)
+            toks = out[0]
+            self.k_pools = list(out[1:1 + n_layers])
+            self.v_pools = list(out[1 + n_layers:])
+            all_tokens = np.asarray(toks._data)          # one D2H
+            # one scan tick serves every live row: per-token latency is
+            # the dispatch wall time amortized over the n ticks
+            if not cold:
+                tick = dur / n
+                self._token_times.append(tick)
+                for _ in range(n):
+                    self._m["tpot"].observe(tick)
+            served = 0
+            for i, r in enumerate(live):
+                for t in range(n):
+                    # done: retired mid-burst (EOS / budget); seq_id
+                    # mismatch: evicted + requeued mid-dispatch — the
+                    # stale tail must not land in its cleared output
+                    if r.done or r.seq_id != sids[i]:
+                        break
+                    self._emit(r, int(all_tokens[i, t]))
+                    served += 1
+            self._expire_deadlines()
+            self._set_pool_gauges()
+            return served
 
     def _burst_fits(self, live, n):
-        """Largest burst <= n whose page reservations fit the pool."""
+        """Largest burst <= n whose page reservations fit the pool and
+        no sequence's per-seq table cap."""
         page = self.page_size
+        for r in live:
+            headroom = self.alloc.max_pages_per_seq * page \
+                - self.alloc._lens[r.seq_id]
+            if headroom < n:
+                # shrink to the tightest per-seq headroom; a fully
+                # capped sequence (headroom <= 0) is trimmed at the
+                # next step boundary by _relieve_pressure
+                n = max(1, headroom)
         while n > 1:
             need = sum(
                 max(0, -(-(self.alloc._lens[r.seq_id] + n) // page)
@@ -658,15 +1362,19 @@ class LlamaServingEngine:
         served = 0
         small = max(self.burst // 4, 2)
         while n > 0:
-            live = [r for r in self._live.values() if not r.done]
-            if not live:
-                break
-            if n >= self.burst:
-                chunk = self._burst_fits(live, self.burst)
-            elif n >= small or not exact:
-                chunk = self._burst_fits(live, small)
-            else:
-                chunk = 1
+            with self._lock:
+                # _burst_fits reads the allocator's per-seq state: hold
+                # the lock so a concurrent evict can't null a seq_id
+                # between the snapshot and the fit computation
+                live = [r for r in self._live.values() if not r.done]
+                if not live:
+                    break
+                if n >= self.burst:
+                    chunk = self._burst_fits(live, self.burst)
+                elif n >= small or not exact:
+                    chunk = self._burst_fits(live, small)
+                else:
+                    chunk = 1
             if chunk > 1:
                 served += self._burst(chunk)
                 n -= chunk
@@ -680,14 +1388,37 @@ class LlamaServingEngine:
         """Convenience batch API: admit all prompts (continuous batching
         handles ragged finish times), run to completion, return output id
         lists in order. Admissions happen in waves — every pending
-        request that fits prefills in ONE compiled call."""
+        request that fits prefills in ONE compiled call. Requests the
+        ladder re-queued are re-admitted ahead of new ones."""
         reqs = [Request(p, max_new_tokens, eos_token_id) for p in prompts]
         pending = list(reqs)
         while pending or any(not r.done for r in reqs):
             wave = []
-            while pending and len(self._live) < self.max_batch:
-                self._admit(pending[0])
-                wave.append(pending.pop(0))
+            while True:
+                with self._lock:
+                    if len(self._live) >= self.max_batch:
+                        break
+                    # requeue pops race _pump_requeue in a second driver
+                    # thread: decide AND pop under the lock
+                    from_requeue = bool(self._requeue)
+                    nxt = self._requeue.popleft() if from_requeue \
+                        else (pending.pop(0) if pending else None)
+                if nxt is None:
+                    break
+                if nxt.done:
+                    continue
+                try:
+                    self._admit(nxt)
+                except AdmissionError:
+                    if from_requeue:
+                        # still under pressure: park it again (keeps the
+                        # typed-terminal contract — never strand a
+                        # popped request in non-terminal 'requeued')
+                        with self._lock:
+                            self._requeue.appendleft(nxt)
+                        break
+                    raise
+                wave.append(nxt)
             self._prefill_wave(wave)
             live = [r for r in self._live.values() if not r.done]
             if live:
@@ -706,3 +1437,127 @@ class LlamaServingEngine:
             if not pending and all(r.done for r in reqs):
                 break
         return [r.output_ids for r in reqs]
+
+    # ------------------------------------------------------------------
+    # graceful drain
+    # ------------------------------------------------------------------
+    @_fatal_guard("serving.drain")
+    def drain(self, timeout=30.0):
+        """Stop admission and retire the in-flight set: decode until
+        every live request completes (EOS / max_new_tokens) or the
+        grace ``timeout`` elapses, then expire the stragglers with a
+        :class:`DeadlineExceeded` and release their pages. Admission
+        stays closed afterwards (:class:`AdmissionError` reason
+        ``"draining"``); call :meth:`resume_admission` to reopen.
+
+        Returns ``{"seconds", "completed", "expired"}`` — requests that
+        finished during the drain vs. those cut off at the window.
+        """
+        with self._lock:
+            self._draining = True
+            already = self._drain_active
+            if not already:
+                self._drain_active = True
+        if already:
+            # another thread's drain is mid-flight: wait it out within
+            # our own budget rather than returning a misleading no-op
+            # (a preemption exit riding on this return must not cut the
+            # active drain's grace window short)
+            t0 = time.perf_counter()
+            while self._drain_active \
+                    and time.perf_counter() - t0 < timeout:
+                time.sleep(0.01)
+            return {"seconds": time.perf_counter() - t0,
+                    "completed": 0, "expired": 0}
+        t0 = time.perf_counter()
+        try:
+            _faults.fire("serve.drain")
+            with self._lock:
+                start = [r for r in self._live.values() if not r.done]
+            while True:
+                self._expire_deadlines()
+                with self._lock:
+                    live = [r for r in self._live.values() if not r.done]
+                if not live:
+                    break
+                if time.perf_counter() - t0 >= timeout:
+                    for r in live:
+                        self._expire(r, reason="drain grace window")
+                    break
+                self.step()
+            # admission is closed, so requests parked on the requeue
+            # (evicted under decode-boundary pressure) can never run
+            # again — expire them typed rather than stranding them
+            with self._lock:
+                requeued = list(self._requeue)
+                self._requeue.clear()
+            for r in requeued:
+                if not r.done:
+                    self._expire(r, reason="drain grace window")
+            # everything that was live at entry is terminal now
+            dur = time.perf_counter() - t0
+            self._m["drain_seconds"].set(dur)
+            self._set_pool_gauges()
+            completed = sum(1 for r in start if r.status == "completed")
+            expired = sum(1 for r in start
+                          if r.status == "deadline_exceeded")
+            return {"seconds": dur, "completed": completed,
+                    "expired": expired}
+        finally:
+            with self._lock:
+                self._drain_active = False
+                pending = self._pending_drain
+                self._pending_drain = None
+            if pending is not None:
+                # a preemption signal arrived while this drain ran: the
+                # work is done, exit now
+                self._run_drain_and_exit(*pending)
+
+    def resume_admission(self):
+        """Reopen admission after a :meth:`drain` (test/maintenance
+        hook; a preemption-driven drain exits the process instead)."""
+        with self._lock:
+            self._draining = False
+
+    def _run_drain_and_exit(self, grace, exit_code, on_drained):
+        stats = self.drain(grace)
+        if on_drained is not None:
+            try:
+                on_drained(stats)
+            except Exception:
+                pass        # exiting anyway; the drain itself succeeded
+        os._exit(exit_code)
+
+    def install_drain_handler(self, grace=30.0,
+                              signals=(_signal.SIGTERM,), exit_code=0,
+                              on_drained=None):
+        """Hook preemption signals (default SIGTERM) for a graceful
+        drain: admission stops immediately; in-flight requests finish
+        or expire within ``grace`` seconds; then the process exits with
+        ``exit_code`` (default 0 — a drained exit is a clean exit). A
+        signal landing while a dispatch is in flight defers the drain
+        to the next wave/step/burst boundary, so engine state is never
+        torn mid-update — mirroring the checkpoint callback's deferred
+        emergency save. ``on_drained(stats)`` runs just before exit
+        (e.g. to flush metrics).
+
+        Must be called from the main thread (CPython signal rule).
+        Returns ``{signum: previous_handler}`` so callers can restore.
+        """
+        prev = {}
+
+        def _handler(signum, frame):
+            with self._lock:
+                self._draining = True
+                if self._drain_active or self._entry_depth > 0 \
+                        or self._flushing:
+                    # a manual drain is running or an entry is in
+                    # flight: record the exit request — drain's
+                    # epilogue / the entry boundary executes it
+                    self._pending_drain = (grace, exit_code, on_drained)
+                    return
+            self._run_drain_and_exit(grace, exit_code, on_drained)
+
+        for s in signals:
+            prev[s] = _signal.signal(s, _handler)
+        return prev

@@ -18,11 +18,23 @@ exactly once, every run.
 
 Rule fields (all optional except ``point`` and ``action``):
 
-- ``point``: instrumented point name (exact match).
+- ``point``: instrumented point name (exact match). Instrumented so
+  far: the checkpoint commit path (``ckpt.write``,
+  ``ckpt.before_marker``, ``rename``), the training loop
+  (``train.step``), and the serving request lifecycle
+  (``serve.admit`` — fired per admission attempt, so a ``raise`` rule
+  with ``exc: "MemoryError"`` simulates KV-pool pressure and drives
+  the degradation ladder; ``serve.decode`` — fired before each
+  step/burst dispatch, ``step`` = dispatch ordinal; ``serve.drain`` —
+  fired as a graceful drain begins).
 - ``action``: one of ``crash`` (``os._exit``), ``sigkill``, ``sigterm``
   (signal self), ``hang`` (sleep ~forever), ``sleep`` (slow-down, then
-  continue), ``raise`` (``OSError``), ``bitflip`` (corrupt the file at
-  the point's ``path``).
+  continue), ``raise`` (``OSError`` by default; see ``exc``),
+  ``bitflip`` (corrupt the file at the point's ``path``).
+- ``exc``: for ``raise`` — the exception type to inject, one of
+  ``OSError`` (default), ``MemoryError``, ``TimeoutError``,
+  ``RuntimeError``. Lets a plan exercise typed failure paths (e.g.
+  admission pressure is a ``MemoryError`` contract).
 - ``step``: only fire when the call site passes this step number.
 - ``path``: fnmatch glob matched against the call site's path (full
   path or basename).
@@ -52,6 +64,11 @@ PLAN_ENV = "PADDLE_TPU_FAULTS"
 _ACTIONS = ("crash", "sigkill", "sigterm", "hang", "sleep", "raise",
             "bitflip")
 
+#: injectable exception types for ``raise`` rules — a closed set, so a
+#: plan can't name arbitrary symbols
+_EXC_TYPES = {"OSError": OSError, "MemoryError": MemoryError,
+              "TimeoutError": TimeoutError, "RuntimeError": RuntimeError}
+
 
 class FaultRule:
     """One parsed plan entry. Matching is pure; firing performs the
@@ -70,6 +87,11 @@ class FaultRule:
         self.count = spec.get("count")
         self.seconds = spec.get("seconds")
         self.exit_code = int(spec.get("exit_code", 23))
+        self.exc = spec.get("exc", "OSError")
+        if self.exc not in _EXC_TYPES:
+            raise ValueError(
+                f"unknown exc type {self.exc!r}; expected one of "
+                f"{tuple(_EXC_TYPES)}")
         self.fired = 0
 
     def matches(self, point, step, path):
@@ -104,7 +126,7 @@ class FaultRule:
         elif self.action == "sleep":
             time.sleep(self.seconds if self.seconds is not None else 0.1)
         elif self.action == "raise":
-            raise OSError(
+            raise _EXC_TYPES[self.exc](
                 f"fault injected at {point!r}"
                 + (f" step={step}" if step is not None else "")
                 + (f" path={path}" if path is not None else ""))

@@ -241,3 +241,66 @@ def test_shutdown_of_idle_agent_creates_no_seq_key():
     finally:
         ag.store.close()
         rpc_mod._agent = None
+
+
+class TestRpcTimeout:
+    """rpc_sync waits are bounded and typed: a dead peer raises
+    RpcTimeoutError (a TimeoutError naming peer/seq/budget) instead of
+    blocking forever."""
+
+    def test_future_wait_times_out_typed(self):
+        import time
+
+        from paddle_tpu.distributed.rpc import (RpcTimeoutError,
+                                                _FutureReply)
+
+        fut = _FutureReply(to="w1", seq=7, timeout=0.05)
+        t0 = time.perf_counter()
+        with pytest.raises(RpcTimeoutError) as ei:
+            fut.wait()                      # falls back to call timeout
+        assert time.perf_counter() - t0 < 5.0
+        e = ei.value
+        assert isinstance(e, TimeoutError)
+        assert e.to == "w1" and e.seq == 7 and e.timeout == 0.05
+        assert "w1" in str(e) and "0.05" in str(e)
+
+    def test_explicit_wait_timeout_overrides(self):
+        from paddle_tpu.distributed.rpc import (RpcTimeoutError,
+                                                _FutureReply)
+
+        fut = _FutureReply(to="w2", seq=0, timeout=None)
+        with pytest.raises(RpcTimeoutError) as ei:
+            fut.wait(timeout=0.02)
+        assert ei.value.timeout == 0.02
+
+    def test_resolved_future_ignores_timeout(self):
+        from paddle_tpu.distributed.rpc import _FutureReply
+
+        fut = _FutureReply(to="w3", seq=1, timeout=0.01)
+        fut._set(42, None)
+        assert fut.wait() == 42
+
+    @pytest.mark.skipif(not native.available(),
+                        reason="needs native store")
+    def test_rpc_sync_to_dead_peer_times_out(self):
+        """A call addressed to a registered-but-unserved name (no
+        dispatcher consumes it) must surface RpcTimeoutError through
+        rpc_sync rather than hanging."""
+        from paddle_tpu.distributed import rpc as rpc_mod
+        from paddle_tpu.distributed.rpc import RpcTimeoutError
+
+        assert rpc_mod._agent is None
+        rpc_mod.init_rpc("alive", rank=0, world_size=1,
+                         master_endpoint="127.0.0.1:0")
+        ag = rpc_mod._agent
+        try:
+            # fabricate a dead peer: register the name without an agent
+            ag.store.set("rpc/worker/99", b"ghost")
+            ag.workers["ghost"] = rpc_mod.WorkerInfo("ghost", 99)
+            with pytest.raises(RpcTimeoutError) as ei:
+                rpc_mod.rpc_sync("ghost", abs, args=(2,), timeout=0.5)
+            assert ei.value.to == "ghost"
+        finally:
+            ag.stop()
+            ag.store.close()
+            rpc_mod._agent = None

@@ -1,0 +1,515 @@
+"""Host-side request-lifecycle tests for the serving engine: input
+validation, deadlines, cancellation, the degradation ladder, drain
+bookkeeping, the stuck-dispatch watchdog, and allocator double-free
+hygiene.
+
+Everything here avoids compiled dispatches (no prefill/decode program
+is ever launched) so the module stays in the fast tier; end-to-end
+lifecycle behavior rides tests/test_serving.py (slow tier).
+"""
+
+import json
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.paged_cache import PageAllocator
+from paddle_tpu.inference.serving import (
+    AdmissionError, DeadlineExceeded, LlamaServingEngine, Request)
+from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(tiny_llama_config())
+    m.eval()
+    return m
+
+
+@pytest.fixture()
+def engine(model):
+    e = LlamaServingEngine(model, max_batch=2, page_size=8, num_pages=16)
+    yield e
+    e.close()
+
+
+def _labeled(counter, *labels):
+    return 0.0 if counter is om.NULL else counter.labels(*labels).value
+
+
+# ---------------------------------------------------------------------
+# Request validation (satellite)
+# ---------------------------------------------------------------------
+class TestRequestValidation:
+    def test_empty_prompt(self):
+        with pytest.raises(ValueError, match="prompt_ids is empty"):
+            Request([])
+
+    def test_nonpositive_max_new_tokens(self):
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            Request([1], max_new_tokens=0)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            Request([1], max_new_tokens=-3)
+
+    def test_bad_budgets(self):
+        with pytest.raises(ValueError, match="deadline"):
+            Request([1], deadline=0)
+        with pytest.raises(ValueError, match="token_budget"):
+            Request([1], token_budget=-1.0)
+        with pytest.raises(ValueError, match="retry_budget"):
+            Request([1], retry_budget=-1)
+
+    def test_prompt_beyond_pool_capacity_names_limit(self, engine):
+        # 15 usable pages x 8 slots = 120 tokens of capacity
+        cap = engine.alloc.num_pages * engine.page_size
+        req = Request(list(range(cap + 1)), max_new_tokens=4)
+        with pytest.raises(ValueError) as ei:
+            engine._admit(req)
+        assert str(cap) in str(ei.value)
+        assert "KV capacity" in str(ei.value)
+
+    def test_validation_beats_opaque_shape_error(self, engine):
+        # the old failure mode was a shape error deep in _prefill_wave;
+        # now add_request rejects before any program is built
+        cap = engine.alloc.num_pages * engine.page_size
+        with pytest.raises(ValueError, match="KV capacity"):
+            engine.add_request(Request(list(range(cap + 50))))
+
+
+# ---------------------------------------------------------------------
+# PageAllocator idempotent release (satellite)
+# ---------------------------------------------------------------------
+class TestIdempotentRelease:
+    def test_double_release_is_noop_with_counter(self):
+        alloc = PageAllocator(8, 4)
+        alloc.admit(0, 6)           # 2 pages
+        free_after_admit = alloc.free_pages
+        alloc.release(0)
+        assert alloc.free_pages == free_after_admit + 2
+        with pytest.warns(RuntimeWarning, match="already-released"):
+            alloc.release(0)        # double free: no-op
+        assert alloc.free_pages == free_after_admit + 2
+        assert alloc.double_free_count == 1
+        # free list holds no duplicates
+        assert len(alloc._free) == len(set(alloc._free)) == 8
+
+    def test_release_unknown_sequence(self):
+        alloc = PageAllocator(4, 4)
+        with pytest.warns(RuntimeWarning):
+            alloc.release(99)
+        assert alloc.double_free_count == 1
+        assert alloc.free_pages == 4
+
+    def test_readmit_after_release_stays_consistent(self):
+        alloc = PageAllocator(4, 4)
+        alloc.admit(0, 4)
+        alloc.release(0)
+        with pytest.warns(RuntimeWarning):
+            alloc.release(0)
+        alloc.admit(1, 16)          # all 4 pages
+        assert alloc.free_pages == 0
+        alloc.release(1)
+        assert alloc.free_pages == 4
+
+
+# ---------------------------------------------------------------------
+# cancellation
+# ---------------------------------------------------------------------
+class TestCancel:
+    def test_cancel_releases_pages_and_is_idempotent(self, engine):
+        free0 = engine.alloc.free_pages
+        r = Request([1, 2, 3], max_new_tokens=8)
+        engine._admit(r)
+        assert engine.alloc.free_pages < free0
+        c0 = engine._m["cancelled"].value
+        assert engine.cancel(r) is True
+        assert r.done and r.status == "cancelled"
+        assert engine.alloc.free_pages == free0
+        assert r.seq_id not in engine._live
+        # idempotent: second cancel (and cancel by id) is a no-op
+        assert engine.cancel(r) is False
+        assert engine.cancel(r.seq_id) is False
+        if engine._m["cancelled"] is not om.NULL:
+            assert engine._m["cancelled"].value == c0 + 1
+
+    def test_cancel_unknown_request(self, engine):
+        assert engine.cancel(12345) is False
+
+    def test_cancel_reaches_requeued_request(self, model):
+        """A client abandon racing an eviction must still land: the
+        parked request is removed from the requeue, never pumped back
+        in."""
+        e = LlamaServingEngine(model, max_batch=2, page_size=8,
+                               num_pages=16)
+        r = Request([1, 2], max_new_tokens=8, priority=0, retry_budget=1)
+        e._admit(r)
+        with e._lock:
+            e._evict(r)                     # -> requeue, seq_id None
+        assert r in e._requeue and r.status == "requeued"
+        assert e.cancel(r) is True
+        assert r.done and r.status == "cancelled"
+        assert r not in e._requeue
+        assert e.cancel(r) is False         # idempotent
+        e.close()
+
+    def test_concurrent_admission_never_overshoots_max_batch(self, model):
+        import threading
+
+        from paddle_tpu.inference.serving import AdmissionError
+
+        e = LlamaServingEngine(model, max_batch=4, page_size=8,
+                               num_pages=64)
+        admitted, shed = [], []
+
+        def admitter(i):
+            try:
+                e._admit(Request([i + 1], max_new_tokens=8))
+                admitted.append(i)
+            except AdmissionError:
+                shed.append(i)
+
+        ts = [threading.Thread(target=admitter, args=(i,))
+              for i in range(12)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(e._live) == 4
+        assert len(admitted) == 4 and len(shed) == 8
+        e.close()
+
+    def test_cancel_keeps_partial_output(self, engine):
+        r = Request([1, 2], max_new_tokens=8)
+        engine._admit(r)
+        r.output_ids = [7, 8]
+        engine.cancel(r)
+        assert r.output_ids == [7, 8]
+        assert r.error is None
+
+    def test_cancel_during_dispatch_defers_page_release(self, engine):
+        """Pages of a request cancelled while a dispatch is in flight
+        go back to the pool only after the dispatch retires — the
+        program may still be writing K/V into them."""
+        free0 = engine.alloc.free_pages
+        r = Request([1, 2, 3], max_new_tokens=8)
+        engine._admit(r)
+        with engine._lock:
+            engine._in_dispatch = True
+        try:
+            engine.cancel(r)
+            assert r.done and r.status == "cancelled"
+            assert engine.alloc.free_pages < free0   # still reserved
+        finally:
+            with engine._lock:
+                engine._in_dispatch = False
+        engine._flush_deferred()
+        assert engine.alloc.free_pages == free0
+
+
+# ---------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------
+class TestDeadlines:
+    def test_expiry_releases_pages_and_types_result(self, engine):
+        free0 = engine.alloc.free_pages
+        r = Request([1, 2, 3], max_new_tokens=8, deadline=60.0)
+        engine._admit(r)
+        assert r._expires_at is not None
+        d0 = engine._m["deadline_exceeded"].value
+        r.output_ids = [4]
+        r._expires_at = time.perf_counter() - 0.01   # force expiry
+        engine._expire_deadlines()
+        assert r.done and r.status == "deadline_exceeded"
+        assert isinstance(r.error, DeadlineExceeded)
+        assert r.error.tokens_emitted == 1
+        assert r.output_ids == [4]                   # partial preserved
+        assert engine.alloc.free_pages == free0
+        if engine._m["deadline_exceeded"] is not om.NULL:
+            assert engine._m["deadline_exceeded"].value == d0 + 1
+
+    def test_token_budget_sets_tighter_deadline(self, engine):
+        r = Request([1], max_new_tokens=10, deadline=100.0,
+                    token_budget=0.5)
+        engine._admit(r)
+        # 10 tokens x 0.5 s/token = 5s < 100s TTL
+        assert r._expires_at - r._t_admit == pytest.approx(5.0, abs=0.1)
+
+    def test_next_admission_reuses_expired_pages(self, engine):
+        # fill the pool with one big request, expire it, and admit a
+        # fresh request into the reclaimed pages — no dispatch needed
+        big = Request(list(range(100)), max_new_tokens=4, deadline=50.0)
+        engine._admit(big)
+        assert engine.alloc.free_pages < 3
+        big._expires_at = time.perf_counter() - 0.01
+        nxt = Request(list(range(40)), max_new_tokens=4)
+        engine._admit(nxt)     # _admit expires stale deadlines first
+        assert big.status == "deadline_exceeded"
+        assert nxt.seq_id in engine._live
+
+
+# ---------------------------------------------------------------------
+# degradation ladder: trim -> evict -> shed
+# ---------------------------------------------------------------------
+class TestDegradationLadder:
+    def test_trim_retires_lowest_priority_with_partial_output(self, model):
+        e = LlamaServingEngine(model, max_batch=2, page_size=8,
+                               num_pages=16)
+        lo1 = Request([1, 2], max_new_tokens=16, priority=0)
+        lo2 = Request([3, 4], max_new_tokens=16, priority=0)
+        e._admit(lo1)
+        e._admit(lo2)
+        lo1.output_ids = [9, 9, 9]      # has produced work
+        lo2.output_ids = [9]
+        trim0 = _labeled(e._m["degraded"], "trim")
+        hi = Request([5, 6], max_new_tokens=4, priority=1)
+        e._admit(hi)                    # engine full -> trim rung
+        # lowest-priority victim with least output loses its tail
+        assert lo2.done and lo2.status == "completed" and lo2.trimmed
+        assert lo2.max_new_tokens == 1 and lo2.output_ids == [9]
+        assert not lo1.done
+        assert hi.seq_id in e._live
+        assert _labeled(e._m["degraded"], "trim") == trim0 + 1 \
+            or e._m["degraded"] is om.NULL
+        e.close()
+
+    def test_evict_requeues_with_retry_budget(self, model):
+        e = LlamaServingEngine(model, max_batch=2, page_size=8,
+                               num_pages=16)
+        lo1 = Request([1, 2], max_new_tokens=16, priority=0,
+                      retry_budget=1)
+        lo2 = Request([3, 4], max_new_tokens=16, priority=0,
+                      retry_budget=1)
+        e._admit(lo1)
+        e._admit(lo2)
+        # no victim has output -> trim can't free capacity -> evict
+        evict0 = _labeled(e._m["degraded"], "evict")
+        hi = Request([5, 6], max_new_tokens=4, priority=1)
+        e._admit(hi)
+        requeued = [r for r in (lo1, lo2) if r.status == "requeued"]
+        assert len(requeued) == 1
+        v = requeued[0]
+        assert not v.done and v.retry_budget == 0
+        assert v.output_ids == [] and v.seq_id is None
+        assert v in e._requeue
+        assert hi.seq_id in e._live
+        assert _labeled(e._m["degraded"], "evict") == evict0 + 1 \
+            or e._m["degraded"] is om.NULL
+        e.close()
+
+    def test_evict_without_budget_fails_typed(self, model):
+        e = LlamaServingEngine(model, max_batch=1, page_size=8,
+                               num_pages=16)
+        lo = Request([1, 2], max_new_tokens=16, priority=0,
+                     retry_budget=0)
+        e._admit(lo)
+        hi = Request([3], max_new_tokens=4, priority=1)
+        e._admit(hi)
+        assert lo.done and lo.status == "evicted"
+        assert isinstance(lo.error, AdmissionError)
+        assert lo not in e._requeue
+        e.close()
+
+    def test_shed_carries_retry_after(self, model):
+        e = LlamaServingEngine(model, max_batch=1, page_size=8,
+                               num_pages=16)
+        e._admit(Request([1, 2], max_new_tokens=16, priority=5))
+        shed0 = _labeled(e._m["degraded"], "shed")
+        # equal/lower priority: no trim or evict victim -> shed
+        with pytest.raises(AdmissionError) as ei:
+            e._admit(Request([3], max_new_tokens=4, priority=5))
+        assert ei.value.reason == "engine full"
+        assert ei.value.retry_after is not None
+        assert ei.value.retry_after > 0
+        assert _labeled(e._m["degraded"], "shed") == shed0 + 1 \
+            or e._m["degraded"] is om.NULL
+        e.close()
+
+    def test_decode_boundary_pressure_evicts_instead_of_crashing(
+            self, model):
+        """A pool too full to hold every live sequence's next token
+        evicts the least-progressed lowest-priority request (requeue)
+        instead of raising MemoryError mid-step with a torn allocator."""
+        e = LlamaServingEngine(model, max_batch=2, page_size=8,
+                               num_pages=3)      # 2 usable pages
+        r1 = Request(list(range(8)), max_new_tokens=50)   # 1 full page
+        r2 = Request(list(range(8)), max_new_tokens=50)   # 1 full page
+        e._admit(r1)
+        e._admit(r2)
+        r1.output_ids = [1, 2]      # r2 is least progressed -> victim
+        assert e.alloc.free_pages == 0
+        survivors = e._relieve_pressure([r1, r2], 1)
+        assert survivors == [r1]
+        assert r2.status == "requeued" and r2 in e._requeue
+        assert e.alloc.free_pages == 1   # r2's page back; r1 can extend
+        e.close()
+
+    def test_ladder_order_under_fault_driven_pressure(self, model,
+                                                      monkeypatch):
+        """PADDLE_TPU_FAULTS injects MemoryError at serve.admit — the
+        KV-pool-exhausted signal — and the ladder walks trim -> evict
+        -> shed in order, metrics asserted at each rung."""
+        plan = [{"point": "serve.admit", "action": "raise",
+                 "exc": "MemoryError", "count": 6}]
+        monkeypatch.setenv(faults.PLAN_ENV, json.dumps(plan))
+        faults.reset()
+        try:
+            e = LlamaServingEngine(model, max_batch=8, page_size=8,
+                                   num_pages=64)
+            lo1 = Request([1, 2], max_new_tokens=16, priority=0)
+            lo2 = Request([3, 4], max_new_tokens=16, priority=0)
+            # plan not yet active for these (count burns on attempts):
+            # admit them BEFORE arming by resetting afterwards
+            monkeypatch.delenv(faults.PLAN_ENV)
+            faults.reset()
+            e._admit(lo1)
+            e._admit(lo2)
+            lo1.output_ids = [9, 9]
+            monkeypatch.setenv(faults.PLAN_ENV, json.dumps(plan))
+            faults.reset()
+            trim0 = _labeled(e._m["degraded"], "trim")
+            evict0 = _labeled(e._m["degraded"], "evict")
+            shed0 = _labeled(e._m["degraded"], "shed")
+            hi = Request([5, 6], max_new_tokens=4, priority=1)
+            # attempt 1: MemoryError -> trim lo1 (has output);
+            # attempt 2: MemoryError -> evict lo2;
+            # attempt 3: MemoryError -> no victims left -> shed
+            with pytest.raises(AdmissionError) as ei:
+                e._admit(hi)
+            assert ei.value.reason == "KV page pool exhausted"
+            assert lo1.done and lo1.status == "completed" and lo1.trimmed
+            assert lo2.status == "requeued"
+            if e._m["degraded"] is not om.NULL:
+                assert _labeled(e._m["degraded"], "trim") == trim0 + 1
+                assert _labeled(e._m["degraded"], "evict") == evict0 + 1
+                assert _labeled(e._m["degraded"], "shed") == shed0 + 1
+            e.close()
+        finally:
+            faults.reset()
+
+
+# ---------------------------------------------------------------------
+# drain + admission gate
+# ---------------------------------------------------------------------
+class TestDrain:
+    def test_drain_empty_engine(self, engine):
+        stats = engine.drain(timeout=1.0)
+        assert stats["completed"] == 0 and stats["expired"] == 0
+        shed0 = _labeled(engine._m["degraded"], "shed")
+        ev0 = engine._m["evicted"].value
+        with pytest.raises(AdmissionError) as ei:
+            engine._admit(Request([1], max_new_tokens=2))
+        assert ei.value.reason == "draining"
+        # drain gating is not capacity pressure: no shed/evicted counts
+        assert _labeled(engine._m["degraded"], "shed") == shed0
+        assert engine._m["evicted"].value == ev0
+        engine.resume_admission()
+        engine._admit(Request([1], max_new_tokens=2))
+
+    def test_drain_expires_stragglers_at_grace(self, engine):
+        free0 = engine.alloc.free_pages
+        r = Request([1, 2, 3], max_new_tokens=8)
+        engine._admit(r)
+        r.output_ids = [5]
+        stats = engine.drain(timeout=0.0)    # grace already over
+        assert r.done and r.status == "deadline_exceeded"
+        assert isinstance(r.error, DeadlineExceeded)
+        assert r.error.reason == "drain grace window"
+        assert engine.alloc.free_pages == free0
+        assert stats["expired"] == 1 and stats["completed"] == 0
+        if engine._m["drain_seconds"] is not om.NULL:
+            assert engine._m["drain_seconds"].value >= 0.0
+
+    def test_drain_counts_expired_deadline_as_drained(self, engine):
+        r = Request([1, 2], max_new_tokens=8, deadline=30.0)
+        engine._admit(r)
+        r._expires_at = time.perf_counter() - 0.01
+        stats = engine.drain(timeout=5.0)    # expiry path, no dispatch
+        assert r.status == "deadline_exceeded"
+        assert stats["expired"] == 1
+
+
+# ---------------------------------------------------------------------
+# stuck-dispatch watchdog
+# ---------------------------------------------------------------------
+class TestStuckWatchdog:
+    def test_arm_skips_cold_and_thin_history(self, engine):
+        engine._arm_watchdog(cold=True)
+        assert engine._wd is None
+        engine._dispatch_times.extend([0.01] * 4)   # < 8 samples
+        engine._arm_watchdog(cold=False)
+        assert engine._wd is None
+
+    def test_arm_uses_p99_with_floor(self, engine):
+        engine.stuck_min_timeout = 0.5
+        engine._dispatch_times.extend([0.01] * 16)
+        engine._arm_watchdog(cold=False)
+        assert engine._wd is not None
+        # 8 x 0.01 = 0.08 < floor 0.5
+        assert engine._wd.timeout == pytest.approx(0.5)
+        engine._dispatch_times.extend([1.0] * 16)
+        engine._arm_watchdog(cold=False)
+        assert engine._wd.timeout == pytest.approx(8.0)
+        engine._disarm_watchdog()
+        assert engine._wd.timeout == float("inf")
+
+    def test_stall_fires_watchdog(self, engine):
+        engine.stuck_min_timeout = 0.05
+        engine._dispatch_times.extend([0.005] * 16)
+        engine._arm_watchdog(cold=False)
+        wd = engine._wd
+        assert wd is not None
+        deadline = time.monotonic() + 5.0
+        while wd.timeouts == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)       # poll thread ticks at <= 1s
+        assert wd.timeouts >= 1
+        engine._disarm_watchdog()
+
+    def test_close_is_idempotent(self, engine):
+        engine._dispatch_times.extend([0.01] * 16)
+        engine._arm_watchdog(cold=False)
+        engine.close()
+        assert engine._wd is None
+        engine.close()
+
+
+# ---------------------------------------------------------------------
+# fault-plan `exc` extension
+# ---------------------------------------------------------------------
+class TestFaultExc:
+    def test_raise_custom_exception_type(self, monkeypatch):
+        plan = [{"point": "serve.admit", "action": "raise",
+                 "exc": "MemoryError"}]
+        monkeypatch.setenv(faults.PLAN_ENV, json.dumps(plan))
+        faults.reset()
+        try:
+            with pytest.raises(MemoryError, match="serve.admit"):
+                faults.fire("serve.admit")
+        finally:
+            faults.reset()
+
+    def test_unknown_exc_rejected_at_parse(self):
+        with pytest.raises(ValueError, match="unknown exc"):
+            faults.FaultRule({"point": "x", "action": "raise",
+                              "exc": "SystemExit"})
+
+    def test_default_exc_is_oserror(self):
+        rule = faults.FaultRule({"point": "x", "action": "raise"})
+        with pytest.raises(OSError):
+            rule.perform("x", None, None)
+
+
+# ---------------------------------------------------------------------
+# AdmissionError surface
+# ---------------------------------------------------------------------
+def test_admission_error_retry_after_in_message():
+    e = AdmissionError("engine full", live=1, max_batch=1, free_pages=3,
+                       num_pages=8, retries=0, retry_after=0.25)
+    assert "retry after 0.250s" in str(e)
+    assert e.retry_after == 0.25
+    # backward compatible: retry_after optional
+    e2 = AdmissionError("engine full", 1, 1, 3, 8, 0)
+    assert e2.retry_after is None
