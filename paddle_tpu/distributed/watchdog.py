@@ -174,12 +174,48 @@ class FileStore:
     host whose stamp ages past it stops appearing in :meth:`hosts` — a
     crashed host that never deregistered is treated as dead, and an
     :class:`ElasticManager` watching the store reports ``scale_down``.
-    Re-registering (:meth:`heartbeat`) refreshes the stamp."""
+    Re-registering (:meth:`heartbeat`) refreshes the stamp.
+
+    Staleness is judged by the stamp file's **mtime** against the fs
+    server's own "now" (probed via :meth:`_fs_now`) — one clock every
+    writer AND reader agrees on, so neither a skewed writer nor a
+    skewed reader (NTP step, drifting VM) can mass-expire perfectly
+    healthy hosts. The embedded ``time.time()`` value is kept only as
+    a fallback for stores where mtime is unavailable."""
+
+    #: seconds between fs-clock probes (hosts() scans between probes
+    #: reuse the cached offset)
+    CLOCK_PROBE_INTERVAL = 5.0
 
     def __init__(self, path, ttl=None):
         self.path = path
         self.ttl = None if ttl is None else float(ttl)
         os.makedirs(path, exist_ok=True)
+        self._clock_probe_at = None     # monotonic stamp of last probe
+        self._clock_offset = 0.0        # fs-server now - reader now
+
+    def _fs_now(self):
+        """The filesystem server's idea of "now". Stamp mtimes come
+        from the fs server's clock, so aging must compare them against
+        the SAME clock — a reader whose local clock runs ahead would
+        otherwise mass-expire every healthy host. Measured by touching
+        a hidden probe file and reading its mtime back; the offset is
+        cached for CLOCK_PROBE_INTERVAL. Falls back to the local clock
+        when the store is not writable."""
+        mono = time.monotonic()
+        if self._clock_probe_at is None \
+                or mono - self._clock_probe_at >= \
+                self.CLOCK_PROBE_INTERVAL:
+            probe = os.path.join(self.path, f".clock.{os.getpid()}")
+            try:
+                with open(probe, "w") as f:
+                    f.write("x")
+                self._clock_offset = os.path.getmtime(probe) \
+                    - time.time()
+            except OSError:
+                self._clock_offset = 0.0
+            self._clock_probe_at = mono
+        return time.time() + self._clock_offset
 
     def register(self, host_id):
         # stamp atomically (write-aside + replace): open(.., "w") would
@@ -202,22 +238,27 @@ class FileStore:
             pass
 
     def hosts(self):
-        now = time.time()
+        now = self._fs_now() if self.ttl is not None else time.time()
         out = []
         for name in sorted(os.listdir(self.path)):
             if name.startswith("."):
                 continue            # in-flight stamp writes
             if self.ttl is not None:
                 p = os.path.join(self.path, name)
+                # age by the stamp file's MTIME first — on a shared
+                # filesystem that is the fs server's clock, the one
+                # reference all hosts see. The embedded time.time()
+                # stamp is the WRITER's clock: cross-host skew or an
+                # NTP step there would mass-expire (or immortalize)
+                # perfectly healthy replicas, so it is only a fallback
+                # for stores where mtime is unavailable/untrustworthy.
                 try:
-                    with open(p) as f:
-                        stamp = float(f.read().strip() or "0")
-                except (OSError, ValueError):
-                    # unreadable/half-written registration: age by mtime
-                    # so it still expires instead of living forever
+                    stamp = os.path.getmtime(p)
+                except OSError:
                     try:
-                        stamp = os.path.getmtime(p)
-                    except OSError:
+                        with open(p) as f:
+                            stamp = float(f.read().strip() or "0")
+                    except (OSError, ValueError):
                         continue        # vanished mid-scan
                 if now - stamp > self.ttl:
                     continue

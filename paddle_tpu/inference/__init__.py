@@ -107,3 +107,23 @@ class Predictor:
 
 def create_predictor(config):
     return Predictor(config)
+
+
+_LAZY = {
+    # the serving/cluster stack imports the model zoo — load on demand
+    "LlamaServingEngine": "serving", "Request": "serving",
+    "AdmissionError": "serving", "DeadlineExceeded": "serving",
+    "ServingCluster": "cluster", "EngineReplica": "cluster",
+    "ClusterRequest": "cluster", "PrefixCache": "prefix_cache",
+    "PageAllocator": "paged_cache",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

@@ -1,0 +1,835 @@
+"""Multi-replica serving tier: load-aware routing, membership, rolling
+restart.
+
+One :class:`~paddle_tpu.inference.serving.LlamaServingEngine` is a
+single continuous batch on a single chip; this module is the layer that
+makes N of them look like one service (ROADMAP item 2 — the
+millions-of-users story, cf. the Gemma-on-TPU serving comparison in
+PAPERS.md):
+
+- :class:`EngineReplica` — one engine driven by its own worker thread,
+  registered in the shared :class:`~paddle_tpu.distributed.watchdog
+  .FileStore` membership store with TTL heartbeats (the elastic
+  launcher's liveness mechanism, reused for serving). A replica that
+  dies — fault-injected via the ``replica.dead`` point, or a simulated
+  SIGKILL via :meth:`EngineReplica.kill` — simply stops heartbeating
+  and ages out of membership.
+- :class:`ClusterRequest` — the router-level request handle. It
+  survives its replica: if the replica dies before the request
+  finishes, the router re-submits it elsewhere (bounded by
+  ``failover_budget``), and a cluster-level ``deadline`` keeps ticking
+  across attempts — a request always ends terminal (completed or a
+  typed error), never lost.
+- :class:`ServingCluster` — the routing frontend. ``submit()`` picks
+  the least-loaded ready replica from the engines' own queue-depth /
+  KV-page-utilization gauges; when every replica sheds, the typed
+  :class:`~paddle_tpu.inference.serving.AdmissionError` propagates with
+  the smallest ``retry_after`` hint (backpressure, not a drop). A
+  monitor thread watches membership through an
+  :class:`~paddle_tpu.distributed.watchdog.ElasticManager`, fails over
+  the requests of dead replicas and (``auto_replace=True``) rebuilds
+  them. :meth:`ServingCluster.rolling_restart` cycles replicas through
+  ``drain()`` one at a time — the router stops routing to a draining
+  replica, its backlog is re-routed, in-flight requests finish or
+  expire typed inside the grace window, and a fresh engine takes over.
+
+Each replica's engine keeps its own shared-prefix KV cache, so a hot
+system prompt is prefilled once per replica. In tests replicas are
+in-process engines; a subprocess deployment drives the same surface
+(the worker loop maps 1:1 onto a process main loop with the store on a
+shared filesystem).
+
+Fault points: ``router.route`` fires per routing decision and
+``replica.dead`` fires per worker-loop tick, so a ``PADDLE_TPU_FAULTS``
+plan can inject routing errors or kill replica N at tick K
+deterministically in CI.
+"""
+
+from __future__ import annotations
+
+import collections
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from ..distributed.watchdog import ElasticManager, FileStore
+from ..observability import metrics as _om
+from ..observability.trace import span as _span
+from ..testing import faults as _faults
+from .serving import (AdmissionError, DeadlineExceeded,
+                      LlamaServingEngine, Request)
+
+__all__ = ["ClusterRequest", "EngineReplica", "ServingCluster",
+           "ReplicaLostError"]
+
+
+class ReplicaLostError(RuntimeError):
+    """Terminal cluster-level failure: the request's replica died and
+    its failover budget is spent. Carries enough to alert on."""
+
+    def __init__(self, msg, replica_id=None, failovers=0):
+        super().__init__(msg)
+        self.replica_id = replica_id
+        self.failovers = failovers
+
+
+def _router_metrics():
+    return {
+        "routed": _om.counter(
+            "router_requests_routed_total",
+            "requests routed to a replica", labelnames=("replica",)),
+        "backpressure": _om.counter(
+            "router_backpressure_total",
+            "submissions rejected because every replica shed"),
+        "failover": _om.counter(
+            "router_failovers_total",
+            "requests re-submitted after their replica died"),
+        "lost": _om.counter(
+            "router_requests_lost_total",
+            "requests that exhausted their failover budget"),
+        "replaced": _om.counter(
+            "router_replicas_replaced_total",
+            "dead replicas rebuilt by the monitor"),
+        "restarts": _om.counter(
+            "router_rolling_restarts_total",
+            "replicas cycled through a rolling restart"),
+        "ready": _om.gauge(
+            "router_replicas_ready",
+            "replicas currently routable (alive, registered, not "
+            "draining)"),
+    }
+
+
+class ClusterRequest:
+    """One generation request at the routing tier.
+
+    Holds the *intent* (prompt, budgets, priority); each submission to
+    a replica materializes a fresh engine-level
+    :class:`~paddle_tpu.inference.serving.Request` so a failover
+    restarts cleanly. ``deadline`` is a cluster-level wall-clock TTL
+    measured from the first ``submit()`` — it keeps ticking across
+    failovers, so a request bouncing between dying replicas still ends
+    in a typed :class:`DeadlineExceeded` rather than living forever.
+    """
+
+    def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
+                 deadline=None, token_budget=None, priority=0,
+                 retry_budget=1, failover_budget=3):
+        self.prompt_ids = np.asarray(prompt_ids, np.int64).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.deadline = None if deadline is None else float(deadline)
+        self.token_budget = token_budget
+        self.priority = int(priority)
+        self.retry_budget = int(retry_budget)
+        self.failover_budget = int(failover_budget)
+        self.failovers = 0
+        self.request: Request | None = None   # current engine attempt
+        self.replica_id = None
+        self.status = "pending"
+        self.error = None
+        self.output_ids: list[int] = []
+        self._t_submit = None
+        self._finished = threading.Event()
+        self._lock = threading.Lock()
+        # constructing the engine request up front validates the args
+        # at submit() time, not on a replica's worker thread
+        Request(self.prompt_ids, self.max_new_tokens, eos_token_id,
+                deadline, token_budget, priority, retry_budget)
+
+    # ------------------------------------------------------------------
+    @property
+    def done(self):
+        return self._finished.is_set()
+
+    def wait(self, timeout=None):
+        """Block until terminal; True if it finished in time."""
+        return self._finished.wait(timeout)
+
+    def result(self, timeout=None):
+        """Output ids, or raises the typed terminal error (or
+        :class:`TimeoutError` if still running past ``timeout``)."""
+        if not self._finished.wait(timeout):
+            raise TimeoutError(
+                f"request not finished within {timeout}s "
+                f"(status={self.status})")
+        if self.error is not None:
+            raise self.error
+        return self.output_ids
+
+    # -- replica-side hooks --------------------------------------------
+    def _remaining_ttl(self, now=None):
+        if self.deadline is None:
+            return None
+        now = time.perf_counter() if now is None else now
+        return self.deadline - (now - self._t_submit)
+
+    def _new_attempt(self, replica_id):
+        """Engine-level request for one submission attempt, or None if
+        the cluster deadline already lapsed (the request is finished
+        typed here — never silently dropped)."""
+        with self._lock:
+            if self._finished.is_set():
+                return None
+            ttl = self._remaining_ttl()
+            if ttl is not None and ttl <= 0:
+                self._finish_locked(
+                    "deadline_exceeded",
+                    DeadlineExceeded(
+                        f"cluster deadline of {self.deadline}s lapsed "
+                        f"before the request reached a live replica",
+                        tokens_emitted=len(self.output_ids),
+                        reason="cluster deadline"))
+                return None
+            r = Request(self.prompt_ids, self.max_new_tokens,
+                        self.eos_token_id, ttl, self.token_budget,
+                        self.priority, self.retry_budget)
+            self.request = r
+            self.replica_id = replica_id
+            self.status = "live"
+            return r
+
+    def _finish_locked(self, status, error):
+        self.status = status
+        self.error = error
+        self._finished.set()
+
+    def _finish_from(self, req):
+        """Adopt an engine request's terminal state."""
+        with self._lock:
+            if self._finished.is_set():
+                return
+            self.output_ids = list(req.output_ids)
+            self._finish_locked(req.status, req.error)
+
+    def _fail(self, status, error):
+        with self._lock:
+            if not self._finished.is_set():
+                self._finish_locked(status, error)
+
+    def cancel(self):
+        """Best-effort cancel: marks the handle terminal and cancels
+        the current engine attempt if one is live."""
+        with self._lock:
+            req = self.request
+            if not self._finished.is_set():
+                self._finish_locked("cancelled", None)
+        return req
+
+
+class EngineReplica:
+    """One serving replica: an engine plus the worker thread that
+    drives it (admission from a backlog queue, decode steps, completion
+    reaping, membership heartbeats). The worker thread is the ONLY
+    thread that touches the engine's dispatch path; the router merely
+    appends to the backlog, so replica-internal state never races.
+
+    ``kill()`` simulates a SIGKILL: the worker stops mid-loop without
+    draining or deregistering — exactly what a preempted host looks
+    like to the membership store (its stamp ages out after ``ttl``).
+    """
+
+    def __init__(self, replica_id, engine_factory, store=None,
+                 ttl=None, heartbeat_interval=None, max_backlog=None,
+                 idle_sleep=0.002, burst=None):
+        self.replica_id = str(replica_id)
+        self._factory = engine_factory
+        self.engine: LlamaServingEngine | None = None
+        self.store = store
+        self.ttl = ttl
+        self._hb_interval = heartbeat_interval or (
+            ttl / 3.0 if ttl else 0.5)
+        self.max_backlog = max_backlog
+        self.idle_sleep = float(idle_sleep)
+        self.burst = burst                  # decode chunk per loop tick
+        self._backlog: collections.deque[ClusterRequest] = \
+            collections.deque()
+        self._tracked: dict[Request, ClusterRequest] = {}
+        # requests popped from the backlog but not yet admitted: the
+        # worker can die (fault injection) mid-admission, and a
+        # request in that window must still be found by failover
+        self._pending_admit: list[ClusterRequest] = []
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._hb_thread = None
+        self._draining = False
+        self._dead = False
+        self._death_reason = None
+        self._last_beat = 0.0
+        self._ticks = 0
+        self._m_dead = _om.counter(
+            "replica_deaths_total",
+            "replica worker loops that died uncleanly")
+
+    # ------------------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+            self._draining = False
+            self._dead = False
+            self._death_reason = None
+        if self.engine is None:
+            self.engine = self._factory()
+        if self.max_backlog is None:
+            self.max_backlog = self.engine.max_batch * 4
+        self._register()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"replica-{self.replica_id}")
+        self._thread.start()
+        if self.store is not None:
+            # heartbeats ride a sidecar thread: a worker mid-compile
+            # (multi-second XLA trace) must not age out of membership;
+            # a DEAD worker stops the sidecar, so death still surfaces
+            # as TTL expiry
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, daemon=True,
+                name=f"replica-{self.replica_id}-hb")
+            self._hb_thread.start()
+        return self
+
+    def _register(self):
+        if self.store is not None:
+            self.store.register(self.replica_id)
+            self._last_beat = time.monotonic()
+
+    def _hb_loop(self):
+        while not self._stop.wait(self._hb_interval):
+            if self._dead or not self.alive():
+                return      # a crashed host never says goodbye
+            try:
+                self.store.heartbeat(self.replica_id)
+            except OSError:
+                pass
+
+    # -- router-facing surface -----------------------------------------
+    def alive(self):
+        t = self._thread
+        return (not self._dead) and t is not None and t.is_alive()
+
+    def ready(self):
+        return (self.alive() and not self._draining
+                and self.engine is not None and self.engine.is_ready())
+
+    def load(self):
+        """Load score from the engine's own admission gauges: live
+        batch occupancy + backlog depth (normalized to max_batch) +
+        KV-page utilization. Lower is better."""
+        e = self.engine
+        with self._lock:
+            backlog = len(self._backlog)
+        if e is None:
+            return {"score": float("inf"), "live": 0, "backlog": backlog,
+                    "kv_util": 1.0}
+        live = len(e._live)
+        kv_util = 1.0 - e.alloc.free_pages / e.alloc.num_pages
+        score = (live + backlog) / max(1, e.max_batch) + kv_util
+        return {"score": score, "live": live, "backlog": backlog,
+                "kv_util": kv_util}
+
+    def submit(self, creq):
+        """Queue a request for this replica's worker. Raises a typed
+        :class:`AdmissionError` (with the engine's ``retry_after``
+        estimate) when the replica is not accepting or its backlog is
+        full — the router's cue to pick another replica."""
+        e = self.engine
+        with self._lock:
+            if self._dead or self._draining or e is None:
+                raise AdmissionError(
+                    f"replica {self.replica_id} not accepting "
+                    f"({'dead' if self._dead else 'draining'})",
+                    live=0 if e is None else len(e._live),
+                    max_batch=0 if e is None else e.max_batch,
+                    free_pages=0 if e is None else e.alloc.free_pages,
+                    num_pages=0 if e is None else e.alloc.num_pages,
+                    retries=0)
+            if len(self._backlog) >= self.max_backlog:
+                raise AdmissionError(
+                    f"replica {self.replica_id} backlog full",
+                    live=len(e._live), max_batch=e.max_batch,
+                    free_pages=e.alloc.free_pages,
+                    num_pages=e.alloc.num_pages, retries=0,
+                    retry_after=e._retry_after())
+            self._backlog.append(creq)
+
+    # -- worker loop ----------------------------------------------------
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                # deterministic kill switch for CI plans: a rule at
+                # replica.dead (action raise/hang) takes this worker
+                # down as a crash, not a drain
+                _faults.fire("replica.dead", step=self._ticks,
+                             path=self.replica_id)
+                self._ticks += 1
+                self._admit_from_backlog()
+                served = 0
+                e = self.engine
+                if e is not None \
+                        and any(not r.done for r in e._live.values()):
+                    served = e.decode_many(self.burst) if self.burst \
+                        else e.step()
+                self._reap_completed()
+                with self._lock:
+                    idle = not served and not self._backlog
+                if idle:
+                    time.sleep(self.idle_sleep)
+        except BaseException as exc:     # noqa: BLE001 — death IS the event
+            with self._lock:
+                self._dead = True
+                self._death_reason = exc
+            self._m_dead.inc()
+            # no deregister: a crashed host never says goodbye — the
+            # membership TTL is what detects it
+
+    def _admit_from_backlog(self):
+        e = self.engine
+        admitted = []
+        while True:
+            with self._lock:
+                if (self._draining or not self._backlog
+                        or len(e._live) >= e.max_batch):
+                    break
+                creq = self._backlog.popleft()
+                self._pending_admit.append(creq)
+            # removal from _pending_admit happens ONLY on the normal
+            # paths below: a crash anywhere in between leaves the
+            # request discoverable by take_unfinished()
+            if creq.done:
+                self._unpend(creq)
+                continue
+            req = creq._new_attempt(self.replica_id)
+            if req is None:
+                self._unpend(creq)
+                continue        # finished typed (cluster deadline)
+            try:
+                e._admit(req)
+            except AdmissionError:
+                with self._lock:
+                    self._backlog.appendleft(creq)
+                    self._pending_admit.remove(creq)
+                break
+            except ValueError as exc:
+                # never-fitting prompt: typed terminal, not a retry
+                creq._fail("evicted", exc)
+                self._unpend(creq)
+                continue
+            with self._lock:
+                self._tracked[req] = creq
+                self._pending_admit.remove(creq)
+            admitted.append(req)
+        if admitted:
+            e._prefill_wave(admitted)
+
+    def _unpend(self, creq):
+        with self._lock:
+            if creq in self._pending_admit:
+                self._pending_admit.remove(creq)
+
+    def _reap_completed(self):
+        with self._lock:
+            finished = [(r, c) for r, c in self._tracked.items()
+                        if r.done]
+            for r, _ in finished:
+                del self._tracked[r]
+        for r, c in finished:
+            c._finish_from(r)
+
+    # -- lifecycle ------------------------------------------------------
+    def begin_drain(self):
+        """Stop accepting routes; the worker finishes what's admitted."""
+        with self._lock:
+            self._draining = True
+
+    def take_backlog(self):
+        """Pull every queued-but-unadmitted request (the router
+        re-routes them before a drain or after a death)."""
+        with self._lock:
+            out = list(self._backlog)
+            self._backlog.clear()
+        return out
+
+    def take_unfinished(self):
+        """Backlog + mid-admission + tracked in-flight requests that
+        are not terminal — the failover set after this replica died."""
+        with self._lock:
+            out = [c for c in self._backlog if not c.done]
+            self._backlog.clear()
+            out += [c for c in self._pending_admit if not c.done]
+            self._pending_admit.clear()
+            out += [c for r, c in self._tracked.items() if not c.done]
+            self._tracked.clear()
+        return out
+
+    def stop_worker(self, timeout=10.0):
+        """Ask the worker loop to exit and join it (the engine itself
+        stays usable — rolling restart drains it next)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout)
+
+    def drain(self, grace=30.0):
+        """Drain the engine (worker must be stopped first so only one
+        thread drives dispatches), then reap terminal requests."""
+        stats = self.engine.drain(grace) if self.engine is not None \
+            else {"seconds": 0.0, "completed": 0, "expired": 0}
+        self._reap_completed()
+        return stats
+
+    def restart(self):
+        """Replace the engine via the factory and rejoin the cluster —
+        the second half of a rolling restart (or a kill-and-replace).
+        Unfinished requests are NOT carried over; the caller fails
+        them over first."""
+        old = self.engine
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                pass
+        self.engine = self._factory()
+        with self._lock:
+            self._tracked.clear()
+            self._backlog.clear()
+            self._pending_admit.clear()
+        return self.start()
+
+    def kill(self):
+        """Simulate a SIGKILL: stop the worker abruptly, no drain, no
+        deregistration — detected only by membership TTL expiry (or
+        the monitor noticing the dead thread)."""
+        with self._lock:
+            self._dead = True
+            self._death_reason = RuntimeError("killed")
+        self._m_dead.inc()
+        self._stop.set()
+
+    def stop(self, timeout=10.0):
+        """Clean shutdown: stop the worker and leave membership."""
+        self.stop_worker(timeout)
+        if self.store is not None:
+            try:
+                self.store.deregister(self.replica_id)
+            except OSError:
+                pass
+        if self.engine is not None:
+            self.engine.close()
+
+
+class ServingCluster:
+    """Routing frontend over N :class:`EngineReplica` instances.
+
+    Args:
+        engine_factory: zero-arg callable building a fresh
+            :class:`LlamaServingEngine` (called per replica and per
+            restart/replacement).
+        num_replicas: replica count at start().
+        store_path: membership directory (a shared filesystem in a
+            real deployment); default: a private temp dir.
+        ttl: membership TTL in seconds — a replica whose heartbeat is
+            older ages out and is treated as dead.
+        monitor_interval: seconds between membership sweeps.
+        auto_replace: rebuild dead replicas automatically
+            (kill-and-replace).
+        failover_budget: default per-request failover budget.
+    """
+
+    def __init__(self, engine_factory, num_replicas=2, store_path=None,
+                 ttl=2.0, monitor_interval=0.05, auto_replace=True,
+                 failover_budget=3, max_backlog=None, burst=None):
+        self._factory = engine_factory
+        self.num_replicas = int(num_replicas)
+        self.ttl = ttl
+        self.store = FileStore(
+            store_path or tempfile.mkdtemp(prefix="paddle_tpu_cluster_"),
+            ttl=ttl)
+        self.monitor_interval = float(monitor_interval)
+        self.auto_replace = auto_replace
+        self.failover_budget = int(failover_budget)
+        self.max_backlog = max_backlog
+        self.burst = burst
+        self._replicas: dict[str, EngineReplica] = {}
+        self._maintenance: set[str] = set()   # ids mid-rolling-restart
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._monitor_thread = None
+        self._elastic = None
+        self._m = _router_metrics()
+        self._route_count = 0
+        self._started = False
+
+    # ------------------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+        for i in range(self.num_replicas):
+            rid = f"replica-{i}"
+            rep = EngineReplica(rid, self._factory, store=self.store,
+                                ttl=self.ttl,
+                                max_backlog=self.max_backlog,
+                                burst=self.burst)
+            rep.start()
+            self._replicas[rid] = rep
+        self._elastic = ElasticManager(self.store, "router",
+                                       self.num_replicas)
+        self._monitor_thread = threading.Thread(
+            target=self._monitor, daemon=True, name="cluster-monitor")
+        self._monitor_thread.start()
+        return self
+
+    def replicas(self):
+        with self._lock:
+            return dict(self._replicas)
+
+    def ready(self):
+        """Cluster readiness: at least one routable replica (wire to
+        ``start_http_server(ready=cluster.ready)`` for ``/readyz``)."""
+        return any(r.ready() for r in self.replicas().values())
+
+    def start_http_server(self, port=0, addr="127.0.0.1"):
+        """Metrics + /healthz + /readyz endpoint for the whole tier."""
+        from ..observability.export import start_http_server
+        return start_http_server(port=port, addr=addr, ready=self.ready)
+
+    # -- routing --------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
+               deadline=None, token_budget=None, priority=0,
+               retry_budget=1, failover_budget=None):
+        """Route one request to the least-loaded ready replica.
+        Returns a :class:`ClusterRequest`; raises a typed
+        :class:`AdmissionError` carrying the smallest ``retry_after``
+        across replicas when the whole tier is at capacity."""
+        creq = ClusterRequest(
+            prompt_ids, max_new_tokens, eos_token_id, deadline,
+            token_budget, priority, retry_budget,
+            self.failover_budget if failover_budget is None
+            else failover_budget)
+        creq._t_submit = time.perf_counter()
+        self._route(creq)
+        return creq
+
+    def _routable(self, exclude=()):
+        live_hosts = set(self.store.hosts())
+        with self._lock:
+            reps = [r for rid, r in self._replicas.items()
+                    if rid not in exclude
+                    and rid not in self._maintenance
+                    and r.ready() and rid in live_hosts]
+        return reps
+
+    def _route(self, creq, exclude=()):
+        with self._lock:
+            step = self._route_count
+            self._route_count += 1
+        # deterministic routing-error injection for CI plans
+        _faults.fire("router.route", step=step)
+        candidates = sorted(self._routable(exclude),
+                            key=lambda r: r.load()["score"])
+        retry_after = None
+        stats = {"live": 0, "max_batch": 0, "free_pages": 0,
+                 "num_pages": 0}
+        for rep in candidates:
+            try:
+                with _span("cluster.route", replica=rep.replica_id):
+                    rep.submit(creq)
+            except AdmissionError as e:
+                if e.retry_after is not None:
+                    retry_after = e.retry_after if retry_after is None \
+                        else min(retry_after, e.retry_after)
+                for k in stats:
+                    stats[k] += getattr(e, k, 0)
+                continue
+            creq.replica_id = rep.replica_id
+            self._m["routed"].labels(rep.replica_id).inc()
+            return rep
+
+        self._m["backpressure"].inc()
+        raise AdmissionError(
+            f"no replica accepted the request "
+            f"({len(candidates)} routable of {len(self._replicas)})",
+            retries=0, retry_after=retry_after, **stats)
+
+    def cancel(self, creq):
+        """Cancel a cluster request: the handle turns terminal and the
+        current engine attempt (if any) is cancelled on its replica."""
+        req = creq.cancel()
+        rep = self._replicas.get(creq.replica_id)
+        if req is not None and rep is not None \
+                and rep.engine is not None:
+            rep.engine.cancel(req)
+
+    # -- membership monitor --------------------------------------------
+    def _monitor(self):
+        while not self._stop.wait(self.monitor_interval):
+            try:
+                self._sweep()
+            except Exception:
+                # the monitor must survive transient store errors; the
+                # next sweep retries
+                pass
+
+    def _claim(self, rid, rep=None):
+        """Atomically claim a replica for exclusive maintenance (the
+        monitor's death handling vs rolling_restart — whoever claims
+        first proceeds; the other skips or waits). Returns False when
+        already claimed, or when ``rep`` no longer IS the registered
+        replica (a stale snapshot)."""
+        with self._lock:
+            if rid in self._maintenance:
+                return False
+            if rep is not None and self._replicas.get(rid) is not rep:
+                return False
+            self._maintenance.add(rid)
+            return True
+
+    def _release_claim(self, rid):
+        with self._lock:
+            self._maintenance.discard(rid)
+
+    def _sweep(self):
+        if self._elastic is not None:
+            self._elastic.watch_once()      # live-host gauge + events
+        live_hosts = set(self.store.hosts())
+        with self._lock:
+            reps = [(rid, r) for rid, r in self._replicas.items()
+                    if rid not in self._maintenance]
+        ready = 0
+        for rid, rep in reps:
+            dead = (not rep.alive()) or (rid not in live_hosts
+                                         and not rep._draining)
+            if dead:
+                # claim BEFORE touching the replica: rolling_restart
+                # may have started on it since the snapshot (its
+                # stop_worker looks like a death), and two rebuilders
+                # racing one replica would tear its engine
+                if not self._claim(rid, rep):
+                    continue
+                try:
+                    self._handle_death(rid, rep)
+                finally:
+                    self._release_claim(rid)
+            elif rep.ready():
+                ready += 1
+        self._m["ready"].set(ready)
+
+    def _handle_death(self, rid, rep):
+        """Fail over a dead replica's requests; optionally rebuild it.
+        Caller holds the maintenance claim for ``rid``."""
+        orphans = rep.take_unfinished()
+        rep.stop_worker(timeout=1.0)
+        for creq in orphans:
+            self._failover(creq, dead_rid=rid)
+        if self.auto_replace:
+            rep.restart()
+            self._m["replaced"].inc()
+
+    def _failover(self, creq, dead_rid):
+        if creq.done:
+            return
+        creq.failovers += 1
+        if creq.failovers > creq.failover_budget:
+            self._m["lost"].inc()
+            creq._fail("evicted", ReplicaLostError(
+                f"replica {dead_rid} died and the failover budget "
+                f"({creq.failover_budget}) is exhausted",
+                replica_id=dead_rid, failovers=creq.failovers))
+            return
+        self._m["failover"].inc()
+        try:
+            self._route(creq, exclude=(dead_rid,))
+        except AdmissionError as e:
+            # the tier is saturated right now — typed terminal rather
+            # than a silent drop; callers see the backpressure reason
+            self._m["lost"].inc()
+            creq._fail("evicted", e)
+
+    # -- rolling restart ------------------------------------------------
+    def rolling_restart(self, grace=30.0):
+        """Cycle every replica through drain -> replace, one at a time,
+        with the router live the whole way: a draining replica takes no
+        new routes, its backlog re-routes to its peers, its in-flight
+        requests finish (or expire typed) inside ``grace``, then a
+        fresh engine rejoins membership before the next replica starts.
+        Returns per-replica drain stats."""
+        results = {}
+        for rid in list(self.replicas()):
+            rep = self._replicas.get(rid)
+            if rep is None:
+                continue
+            # wait out a monitor-side rebuild of this replica (it ends
+            # with a fresh engine anyway — but the restart must still
+            # cycle it deliberately, so claim rather than skip)
+            claimed = self._claim(rid)
+            t0 = time.monotonic()
+            while not claimed and time.monotonic() - t0 < grace:
+                time.sleep(0.02)
+                claimed = self._claim(rid)
+            if not claimed:
+                continue            # could not get exclusive access
+            rep = self._replicas.get(rid, rep)
+            try:
+                with _span("cluster.rolling_restart", replica=rid):
+                    rep.begin_drain()
+                    for creq in rep.take_backlog():
+                        if creq.done:
+                            continue
+                        try:
+                            self._route(creq, exclude=(rid,))
+                        except AdmissionError as e:
+                            creq._fail("evicted", e)
+                    rep.stop_worker()
+                    stats = rep.drain(grace)
+                    rep.restart()
+                    results[rid] = stats
+                    self._m["restarts"].inc()
+            finally:
+                with self._lock:
+                    self._maintenance.discard(rid)
+        return results
+
+    # -- shutdown -------------------------------------------------------
+    def drain(self, grace=30.0):
+        """Drain the whole tier (no restarts): stop routing, drain each
+        replica, leave admission closed."""
+        self._stop.set()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout=5.0)
+        stats = {}
+        for rid, rep in self.replicas().items():
+            rep.begin_drain()
+            for creq in rep.take_backlog():
+                if not creq.done:
+                    creq._fail("evicted", AdmissionError(
+                        "cluster draining", live=0, max_batch=0,
+                        free_pages=0, num_pages=0, retries=0))
+            rep.stop_worker()
+            stats[rid] = rep.drain(grace)
+        return stats
+
+    def stop(self):
+        """Stop monitor + replicas (graceful; engines closed)."""
+        self._stop.set()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout=5.0)
+        for rep in self.replicas().values():
+            rep.stop()
+
+    def stats(self):
+        out = {}
+        for rid, rep in self.replicas().items():
+            d = rep.load()
+            d["alive"] = rep.alive()
+            d["ready"] = rep.ready()
+            e = rep.engine
+            if e is not None and e.prefix is not None:
+                d["prefix"] = e.prefix.stats()
+            out[rid] = d
+        return out

@@ -31,7 +31,18 @@ __all__ = ["PageAllocator", "PagedKVCache"]
 
 
 class PageAllocator:
-    """Free-list page allocator + per-sequence block tables."""
+    """Free-list page allocator + per-sequence block tables.
+
+    Pages are **refcounted** so a page can be shared by several owners:
+    a live sequence whose prompt prefix was already prefilled can
+    reference the cached prefix pages (see
+    :mod:`paddle_tpu.inference.prefix_cache`) instead of re-prefilling
+    them, and a prefix cache can keep pages alive after the sequence
+    that wrote them retired. A page returns to the free list only when
+    its last reference drops. Writing into a shared page goes through
+    :meth:`ensure_writable` — copy-on-write: the writer gets a private
+    copy and the shared original stays immutable for its other owners.
+    """
 
     def __init__(self, num_pages, page_size, max_pages_per_seq=None):
         self.num_pages = num_pages
@@ -39,8 +50,17 @@ class PageAllocator:
         self.max_pages_per_seq = max_pages_per_seq or num_pages
         self._free = list(range(num_pages - 1, -1, -1))
         self._free_set = set(self._free)
+        self._refs: dict[int, int] = {}     # page -> refcount (allocated)
         self._tables: dict[int, list[int]] = {}
         self._lens: dict[int, int] = {}
+        # copy-on-write accounting: ensure_writable() copies are counted
+        # so the page-aligned prefix-cache design (which should never
+        # trigger one in the natural flow) stays observable
+        self.cow_count = 0
+        self._m_cow = _om.counter(
+            "kv_page_cow_total",
+            "copy-on-write page copies triggered by a write into a "
+            "shared page")
         # double-free accounting: release() is idempotent (cancellation
         # racing a natural completion must not corrupt the free list),
         # but every ignored release is counted — a growing count means
@@ -63,8 +83,16 @@ class PageAllocator:
     def live_sequences(self):
         return sorted(self._tables)
 
-    def admit(self, seq_id, n_tokens):
-        """Reserve pages for a new sequence of ``n_tokens`` (prefill)."""
+    def admit(self, seq_id, n_tokens, shared_pages=None):
+        """Reserve pages for a new sequence of ``n_tokens`` (prefill).
+
+        ``shared_pages`` (optional) is a list of already-allocated pages
+        holding the sequence's prefix K/V — typically a prefix-cache
+        match. They become the leading entries of the block table with
+        their refcount bumped (shared, not owned), and only the
+        remaining ``need - len(shared_pages)`` pages are drawn from the
+        free list."""
+        shared = list(shared_pages or ())
         with self._lock:
             if seq_id in self._tables:
                 raise ValueError(f"sequence {seq_id} already admitted")
@@ -73,12 +101,23 @@ class PageAllocator:
                 raise ValueError(
                     f"{n_tokens} tokens needs {need} pages > "
                     f"max_pages_per_seq ({self.max_pages_per_seq})")
-            if need > len(self._free):
+            if len(shared) > need:
+                raise ValueError(
+                    f"{len(shared)} shared prefix pages exceed the "
+                    f"{need} pages {n_tokens} tokens need")
+            for p in shared:
+                if p in self._free_set or p not in self._refs:
+                    raise ValueError(
+                        f"shared page {p} is not allocated; a prefix "
+                        f"match must hold a live reference")
+            if need - len(shared) > len(self._free):
                 raise MemoryError(
-                    f"paged cache exhausted: need {need} pages, "
-                    f"{len(self._free)} free")
-            self._tables[seq_id] = [self._pop_free()
-                                    for _ in range(need)]
+                    f"paged cache exhausted: need {need - len(shared)} "
+                    f"pages, {len(self._free)} free")
+            for p in shared:
+                self._refs[p] += 1
+            self._tables[seq_id] = shared + [
+                self._pop_free() for _ in range(need - len(shared))]
             self._lens[seq_id] = n_tokens
             return list(self._tables[seq_id])
 
@@ -86,6 +125,7 @@ class PageAllocator:
         # caller holds self._lock
         p = self._free.pop()
         self._free_set.discard(p)
+        self._refs[p] = 1
         return p
 
     def extend(self, seq_id, n_tokens=1):
@@ -107,7 +147,9 @@ class PageAllocator:
             return ln
 
     def release(self, seq_id):
-        """Return a finished sequence's pages to the free list.
+        """Drop a finished sequence's references; pages whose LAST
+        reference this was return to the free list (shared prefix pages
+        a cache or another sequence still holds stay allocated).
 
         Idempotent: releasing an unknown / already-released sequence —
         or a table entry that somehow already sits in the free list —
@@ -127,7 +169,7 @@ class PageAllocator:
                 return
             self._lens.pop(seq_id, None)
             for p in table:
-                if p in self._free_set:
+                if p in self._free_set or p not in self._refs:
                     self.double_free_count += 1
                     self._m_double_free.inc()
                     warnings.warn(
@@ -135,8 +177,73 @@ class PageAllocator:
                         f"skipping double insert", RuntimeWarning,
                         stacklevel=2)
                     continue
-                self._free.append(p)
-                self._free_set.add(p)
+                self._decref_locked(p)
+
+    def _decref_locked(self, p):
+        # caller holds self._lock and proved p is allocated
+        self._refs[p] -= 1
+        if self._refs[p] <= 0:
+            del self._refs[p]
+            self._free.append(p)
+            self._free_set.add(p)
+            return True
+        return False
+
+    def incref(self, page):
+        """Take an extra reference on an allocated page (a prefix cache
+        pinning a freshly prefilled page)."""
+        with self._lock:
+            if page in self._free_set or page not in self._refs:
+                raise ValueError(f"cannot incref free page {page}")
+            self._refs[page] += 1
+
+    def decref(self, page):
+        """Drop one reference; frees the page at zero. Returns True if
+        the page went back to the free list. Decref of an already-free
+        page is the same counted no-op as a double release."""
+        with self._lock:
+            if page in self._free_set or page not in self._refs:
+                self.double_free_count += 1
+                self._m_double_free.inc()
+                warnings.warn(
+                    f"decref of free page {page} ignored",
+                    RuntimeWarning, stacklevel=2)
+                return False
+            return self._decref_locked(p=page)
+
+    def page_ref(self, page):
+        """Current refcount of a page (0 = free)."""
+        with self._lock:
+            return self._refs.get(page, 0)
+
+    def ensure_writable(self, seq_id, pos):
+        """Copy-on-write guard for a K/V write at token position
+        ``pos``: if the page holding ``pos`` is shared (refcount > 1),
+        allocate a private replacement, swap it into this sequence's
+        block table and drop one reference on the original. Returns
+        ``(old_page, new_page)`` when a copy is needed — the caller
+        must copy the page's device content old -> new before writing —
+        or ``None`` when the page is already exclusively owned.
+
+        With page-aligned prefix caching this never fires in the
+        natural flow (a sequence's own writes always land past its
+        shared prefix, in pages it owns), but the contract keeps a
+        shared page immutable no matter what the caller does."""
+        with self._lock:
+            table = self._tables[seq_id]
+            idx = pos // self.page_size
+            p = table[idx]
+            if self._refs.get(p, 0) <= 1:
+                return None
+            if not self._free:
+                raise MemoryError(
+                    "paged cache exhausted on copy-on-write")
+            new = self._pop_free()
+            table[idx] = new
+            self._refs[p] -= 1
+            self.cow_count += 1
+            self._m_cow.inc()
+            return (p, new)
 
     def context_len(self, seq_id):
         return self._lens[seq_id]

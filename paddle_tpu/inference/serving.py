@@ -31,6 +31,20 @@ Design (TPU-first):
   away. Pages for the whole burst are reserved up front; sequence
   lengths advance on device as the scan carry.
 
+Shared-prefix KV cache (scale-out layer):
+- Page-aligned prompt prefixes are content-addressed
+  (:mod:`paddle_tpu.inference.prefix_cache`): a cold prompt's full
+  pages are pinned after its prefill wave, and a later prompt sharing
+  that prefix admits directly against the cached pages (refcounted in
+  :class:`PageAllocator`, copy-on-write on any write into a shared
+  page). Only the un-cached suffix runs through the model — via the
+  compiled decode program, teacher-forced — so a 1k-token system
+  prompt is prefilled once per replica, not once per request.
+  ``serving_prefix_cache_hit_total`` /
+  ``serving_prefix_saved_prefill_tokens_total`` make the win visible;
+  under pool pressure cached pages are evicted (LRU, chain tails
+  first) before the degradation ladder touches live requests.
+
 Request lifecycle (robustness layer):
 - Every request moves through ``status``: ``pending`` → ``live`` →
   one of ``completed`` / ``deadline_exceeded`` / ``cancelled`` /
@@ -145,6 +159,17 @@ class DeadlineExceeded(TimeoutError):
 _LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+#: Cross-ENGINE dispatch serializer. Framework mode state (grad mode,
+#: AMP state, trace stacks, the compile watcher) is per-process, so two
+#: engine INSTANCES tracing/dispatching from different threads (an
+#: in-process multi-replica cluster) would interleave no_grad sections
+#: and leak tracers. Each dispatch body takes this lock INSIDE its own
+#: per-instance ``_dispatch_lock`` (consistent order: own lock first,
+#: global second — no cycle), and it is released between a drain's
+#: steps, so one replica draining never starves its peers. Re-entrant
+#: because a step's requeue pump may prefill.
+_CROSS_ENGINE_LOCK = threading.RLock()
+
 
 def _serving_metrics():
     """Standard serving metric set on the default registry (no-ops when
@@ -193,6 +218,19 @@ def _serving_metrics():
             "serving_prefill_tokens_total", "prompt tokens prefilled"),
         "generated": _om.counter(
             "serving_generated_tokens_total", "tokens emitted by decode"),
+        "prefix_lookups": _om.counter(
+            "serving_prefix_cache_lookup_total",
+            "admissions that consulted the shared-prefix cache"),
+        "prefix_hits": _om.counter(
+            "serving_prefix_cache_hit_total",
+            "admissions that reused cached prefix pages"),
+        "prefix_saved": _om.counter(
+            "serving_prefix_saved_prefill_tokens_total",
+            "prompt tokens NOT prefilled because their pages were "
+            "served from the shared-prefix cache"),
+        "prefix_pages": _om.gauge(
+            "serving_prefix_cache_pages",
+            "KV pages currently pinned by the shared-prefix cache"),
     }
 
 
@@ -306,6 +344,7 @@ class Request:
         self._t_admit = None          # set at admission; drives TTFT
         self._expires_at = None       # perf_counter stamp, or None
         self._cancel_requested = False  # honored at (re-)admission
+        self._cached_tokens = 0       # prefix tokens served from cache
 
 
 class LlamaServingEngine:
@@ -316,7 +355,8 @@ class LlamaServingEngine:
     def __init__(self, model, max_batch=16, page_size=16, num_pages=None,
                  max_pages_per_seq=None, burst=None, admit_retries=0,
                  admit_backoff=0.005, stuck_factor=8.0,
-                 stuck_min_timeout=30.0):
+                 stuck_min_timeout=30.0, prefix_cache=True,
+                 prefix_cache_pages=None):
         if num_pages is None:
             num_pages = max_batch * 24 + 8
         self.model = model
@@ -346,6 +386,13 @@ class LlamaServingEngine:
                                    max_pages_per_seq)
         self.width = self.alloc.max_pages_per_seq
         self.trash_page = num_pages - 1
+        # shared-prefix KV cache: page-aligned prompt prefixes are
+        # prefilled once and later admissions reference the cached
+        # pages (refcounted in the allocator; see prefix_cache.py)
+        from .prefix_cache import PrefixCache
+        self.prefix = PrefixCache(self.alloc, page_size,
+                                  max_pages=prefix_cache_pages) \
+            if prefix_cache else None
         dt = model.parameters()[0].dtype
         hk, d = cfg.num_key_value_heads, cfg.head_dim
         # head-major [P, Hk, page, D] — the Pallas kernel's tiling layout
@@ -612,18 +659,25 @@ class LlamaServingEngine:
 
     @_fatal_guard("serving.prefill_wave")
     def _prefill_wave(self, reqs):
-        """Prefill 1..max_batch admitted requests in ONE compiled call.
-        Requests that expired or were cancelled since admission are
-        skipped (their pages are already back in the pool)."""
-        with self._entry(), self._dispatch_lock:
+        """Prefill 1..max_batch admitted requests in ONE compiled call
+        (cold prompts), then advance cached-prefix admissions through
+        the decode program over their un-cached suffix (warm prompts —
+        see :meth:`_suffix_prefill`). Requests that expired or were
+        cancelled since admission are skipped (their pages are already
+        back in the pool)."""
+        with self._entry(), self._dispatch_lock, _CROSS_ENGINE_LOCK:
             self._expire_deadlines()
             with self._lock:
                 reqs = [r for r in reqs
                         if not r.done and r.seq_id in self._live]
-                sids = [r.seq_id for r in reqs]
-            if not reqs:
-                return
-            self._do_prefill_wave(reqs, sids)
+                cold = [r for r in reqs if not r._cached_tokens]
+                warm = [r for r in reqs if r._cached_tokens]
+                cold_sids = [r.seq_id for r in cold]
+                warm_sids = [r.seq_id for r in warm]
+            if cold:
+                self._do_prefill_wave(cold, cold_sids)
+            if warm:
+                self._suffix_prefill(warm, warm_sids)
 
     def _do_prefill_wave(self, reqs, sids):
         b = self.max_batch
@@ -696,10 +750,124 @@ class LlamaServingEngine:
                 self._in_dispatch = False
         self._flush_deferred()
         self.k_pools, self.v_pools = list(new_k), list(new_v)
+        # register full prompt pages for reuse BEFORE emitting: a
+        # max_new_tokens=1 request retires (and releases) at emit, and
+        # its prefix must still make it into the cache
+        if self.prefix is not None:
+            self._prefix_insert(reqs, sids)
         first = np.asarray(nxt._data).reshape(-1)
         for i, r in enumerate(reqs):
             if not r.done and r.seq_id == sids[i]:
                 self._emit(r, int(first[i]))
+        self._expire_deadlines()
+        self._set_pool_gauges()
+
+    def _prefix_insert(self, reqs, sids):
+        """Pin freshly written full prompt pages in the prefix cache
+        (one allocator reference each) so they outlive the requests."""
+        with self._lock:
+            for r, sid in zip(reqs, sids):
+                if r.done or r.seq_id != sid:
+                    continue
+                table = self.alloc._tables.get(sid)
+                if table:
+                    self.prefix.insert(r.prompt_ids, table)
+            self._m["prefix_pages"].set(self.prefix.pages)
+
+    def _copy_page(self, old, new):
+        """Device-copy one page's K/V across every layer — the payload
+        of a :meth:`PageAllocator.ensure_writable` copy-on-write."""
+        for li in range(len(self.k_pools)):
+            kd = self.k_pools[li]._data
+            vd = self.v_pools[li]._data
+            self.k_pools[li] = Tensor(kd.at[new].set(kd[old]))
+            self.v_pools[li] = Tensor(vd.at[new].set(vd[old]))
+
+    def _suffix_prefill(self, reqs, sids):
+        """Write warm requests' un-cached suffix K/V by teacher-forcing
+        the compiled decode program over the suffix tokens (emitted
+        logits are discarded until the final prompt token, whose argmax
+        IS the first generated token). A shared 1k-token system prompt
+        costs ``len(suffix)`` decode dispatches instead of a full
+        prefill — the prefix-cache TTFT win. All warm requests in the
+        wave advance in lockstep, one batched dispatch per position."""
+        b = self.max_batch
+        if self._decode_static is None \
+                and self._m["ttft"] is not _om.NULL:
+            # compile the decode program OUTSIDE the TTFT window (all
+            # writes land in the trash page, outputs discarded) and
+            # credit the compile time back to the wave's clocks —
+            # mirrors the cold prefill bucket warmup
+            t_w = time.perf_counter()
+            step = self._ensure_decode_compiled()
+            with no_grad():
+                step(Tensor(jnp.asarray(np.zeros((b, 1), np.int64))),
+                     Tensor(jnp.asarray(np.full(
+                         (b, self.width), self.trash_page, np.int32))),
+                     Tensor(jnp.asarray(np.ones((b,), np.int32))),
+                     self.k_pools, self.v_pools)
+            warm_dur = time.perf_counter() - t_w
+            for r in reqs:
+                if r._t_admit is not None:
+                    r._t_admit += warm_dur
+                if r._expires_at is not None:
+                    r._expires_at += warm_dur
+        step = self._ensure_decode_compiled()
+        cur = {r.seq_id: r._cached_tokens for r in reqs}
+        total = {r.seq_id: len(r.prompt_ids) for r in reqs}
+        while True:
+            with self._lock:
+                rows = [(i, r) for i, r in enumerate(reqs)
+                        if not r.done and r.seq_id == sids[i]
+                        and cur[sids[i]] < total[sids[i]]]
+                cow = []
+                for i, r in rows:
+                    # defensive copy-on-write: page-aligned matches
+                    # always write into privately owned pages, but a
+                    # shared page must stay immutable regardless
+                    cp = self.alloc.ensure_writable(sids[i],
+                                                    cur[sids[i]])
+                    if cp is not None:
+                        cow.append(cp)
+            if not rows:
+                break
+            for old, new in cow:
+                self._copy_page(old, new)
+            tokens = np.zeros((b, 1), np.int64)
+            tables = np.full((b, self.width), self.trash_page, np.int32)
+            lens = np.ones((b,), np.int32)
+            for i, r in rows:
+                sid = sids[i]
+                t = self.alloc._tables[sid]
+                tables[i, :len(t)] = t
+                lens[i] = cur[sid] + 1      # context incl. this token
+                tokens[i, 0] = int(r.prompt_ids[cur[sid]])
+            with self._lock:
+                self._in_dispatch = True
+            try:
+                with no_grad(), _span("serving.suffix_prefill",
+                                      rows=len(rows)):
+                    nxt, new_k, new_v = step(
+                        Tensor(jnp.asarray(tokens)),
+                        Tensor(jnp.asarray(tables)),
+                        Tensor(jnp.asarray(lens)),
+                        self.k_pools, self.v_pools)
+            finally:
+                with self._lock:
+                    self._in_dispatch = False
+            self._flush_deferred()
+            self.k_pools, self.v_pools = list(new_k), list(new_v)
+            out = np.asarray(nxt._data).reshape(-1)
+            for i, r in rows:
+                sid = sids[i]
+                cur[sid] += 1
+                if cur[sid] >= total[sid] and not r.done \
+                        and r.seq_id == sid:
+                    self._emit(r, int(out[i]))
+        # chain extension: a warm prompt longer than its cached prefix
+        # contributes its additional full pages
+        if self.prefix is not None:
+            self._prefix_insert(reqs, sids)
         self._expire_deadlines()
         self._set_pool_gauges()
 
@@ -845,12 +1013,54 @@ class LlamaServingEngine:
                 return "draining"
             if len(self._live) >= self.max_batch:
                 return "engine full"
-            try:
-                self.alloc.admit(req.seq_id, len(req.prompt_ids))
-            except MemoryError:
-                return "KV page pool exhausted"
+            n = len(req.prompt_ids)
+            cached = 0
+            val_retries = 0
+            evicted_cache = False
+            recorded = False
+            while True:
+                shared, cached = ([], 0)
+                if self.prefix is not None:
+                    # stats recorded once per admission, not per retry
+                    shared, cached = self.prefix.match(
+                        req.prompt_ids, record=not recorded)
+                    recorded = True
+                try:
+                    self.alloc.admit(req.seq_id, n, shared_pages=shared)
+                    break
+                except ValueError:
+                    # a concurrent prefix.clear()/eviction freed the
+                    # matched pages between match and admit: re-match
+                    # and retry (a ValueError with NO shared pages is
+                    # a genuine validation error and propagates)
+                    if shared and val_retries < 2:
+                        val_retries += 1
+                        continue
+                    raise
+                except MemoryError:
+                    # cached prefixes are an optimization, never a
+                    # reason to shed load: give cold cache pages back
+                    # to the pool and retry once (the retry re-matches
+                    # — eviction may have taken this prompt's chain)
+                    if evicted_cache or self.prefix is None:
+                        return "KV page pool exhausted"
+                    evicted_cache = True
+                    need = max(1, math.ceil(n / self.page_size))
+                    while self.alloc.free_pages < need \
+                            and self.prefix.pages:
+                        self.prefix.evict_pages(need
+                                                - self.alloc.free_pages)
+                    if self.alloc.free_pages < need:
+                        return "KV page pool exhausted"
+            req._cached_tokens = cached
             self._live[req.seq_id] = req
             req.status = "live"
+            if self.prefix is not None:
+                self._m["prefix_lookups"].inc()
+                if cached:
+                    self._m["prefix_hits"].inc()
+                    self._m["prefix_saved"].inc(cached)
+                self._m["prefix_pages"].set(self.prefix.pages)
         return None
 
     def _degrade_trim(self, req, tried):
@@ -897,6 +1107,7 @@ class LlamaServingEngine:
                 v.status = "requeued"
                 v._t_admit = None
                 v._expires_at = None
+                v._cached_tokens = 0    # re-matched at re-admission
                 # a fresh seq_id on re-admission: the old id may still
                 # have a deferred page release in flight
                 v.seq_id = None
@@ -957,6 +1168,12 @@ class LlamaServingEngine:
                 for r in live)
             if need <= self.alloc.free_pages:
                 break
+            # cold prefix-cache pages go back to the pool BEFORE any
+            # live request is destroyed — same contract as admission
+            if self.prefix is not None and self.prefix.pages \
+                    and self.prefix.evict_pages(
+                        need - self.alloc.free_pages):
+                continue
             v = min(live, key=lambda r: (r.priority, len(r.output_ids)))
             live.remove(v)
             if not deferrals_blocked:
@@ -1073,7 +1290,9 @@ class LlamaServingEngine:
                 ttl = budget if ttl is None else min(ttl, budget)
             req._expires_at = None if ttl is None else now + ttl
         self._m["admitted"].inc()
-        self._m["prefill_tokens"].inc(len(req.prompt_ids))
+        # cached-prefix tokens are NOT prefilled — only the suffix is
+        self._m["prefill_tokens"].inc(
+            len(req.prompt_ids) - req._cached_tokens)
         self._set_pool_gauges()
         return req.seq_id
 
@@ -1123,7 +1342,7 @@ class LlamaServingEngine:
     def step(self):
         """Decode one token for every live request. Returns the number of
         live requests served."""
-        with self._entry(), self._dispatch_lock:
+        with self._entry(), self._dispatch_lock, _CROSS_ENGINE_LOCK:
             self._expire_deadlines()
             self._pump_requeue()
             with self._lock:
@@ -1149,10 +1368,19 @@ class LlamaServingEngine:
                 # _relieve_pressure proved the pages exist, and the lock
                 # keeps a concurrent admission from consuming them
                 # between the proof and the extend
+                cow = []
                 for sid in sids:
                     self.alloc.extend(sid, 1)
+                    # copy-on-write backstop: the write position must
+                    # never land in a page shared with the prefix cache
+                    cp = self.alloc.ensure_writable(
+                        sid, self.alloc._lens[sid] - 1)
+                    if cp is not None:
+                        cow.append(cp)
             if not live:
                 return 0
+            for old, new in cow:
+                self._copy_page(old, new)
             # a cold call traces + compiles inside the timed window; that
             # one-time multi-second sample would skew the tpot histogram
             # (top bucket 10s) forever, so it is not observed
@@ -1249,7 +1477,7 @@ class LlamaServingEngine:
         retire mid-burst (EOS / max_new_tokens / expired deadline) have
         their tail tokens discarded at emit time — bounded waste, no
         correctness impact."""
-        with self._entry(), self._dispatch_lock:
+        with self._entry(), self._dispatch_lock, _CROSS_ENGINE_LOCK:
             self._expire_deadlines()
             self._pump_requeue()
             with self._lock:
@@ -1267,10 +1495,19 @@ class LlamaServingEngine:
                             else int(r.prompt_ids[-1]) for r in live]
                 # reserve the whole burst under the lock (see step())
                 start_lens = {sid: self.alloc._lens[sid] for sid in sids}
+                cow = []
                 for sid in sids:
                     self.alloc.extend(sid, n)
+                    # only the burst's FIRST write position can sit in
+                    # a pre-existing (possibly shared) page; the rest
+                    # land in pages this extend just allocated
+                    cp = self.alloc.ensure_writable(sid, start_lens[sid])
+                    if cp is not None:
+                        cow.append(cp)
             if not live:
                 return 0
+            for old, new in cow:
+                self._copy_page(old, new)
             # as in step(): each new burst length compiles on its first
             # call — don't let that land n inflated samples in tpot
             cold = n not in self._burst_static
@@ -1518,6 +1755,15 @@ class LlamaServingEngine:
         hook; a preemption-driven drain exits the process instead)."""
         with self._lock:
             self._draining = False
+
+    def is_ready(self):
+        """Readiness (distinct from liveness): False while draining or
+        closed, so a load balancer stops sending BEFORE :meth:`drain`
+        finishes. Wire it to the ``ready=`` probe of
+        :func:`paddle_tpu.observability.export.start_http_server` to
+        expose it as ``/readyz``."""
+        with self._lock:
+            return not (self._draining or self._closed)
 
     def _run_drain_and_exit(self, grace, exit_code, on_drained):
         stats = self.drain(grace)

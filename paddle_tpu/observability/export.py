@@ -7,8 +7,9 @@
   (serialize, ship, re-render identically on another host).
 - :func:`start_http_server` — an optional stdlib ``http.server`` scrape
   endpoint (``/metrics`` text + HEAD, ``/metrics.json`` snapshot,
-  ``/healthz`` liveness probe) for the serving engine; returns a handle
-  with ``.port`` / ``.url`` / ``.stop``.
+  ``/healthz`` liveness probe, ``/readyz`` readiness probe that turns
+  503 while the local engine drains) for the serving engine; returns a
+  handle with ``.port`` / ``.url`` / ``.stop``.
 """
 
 from __future__ import annotations
@@ -133,12 +134,22 @@ class ScrapeServer:
         self._thread.join(timeout=5)
 
 
-def start_http_server(port=0, addr="127.0.0.1", registry=None):
+def start_http_server(port=0, addr="127.0.0.1", registry=None,
+                      ready=None):
     """Serve ``/metrics`` (Prometheus text; HEAD supported for cheap
-    reachability checks), ``/metrics.json``, and ``/healthz`` (200 +
+    reachability checks), ``/metrics.json``, ``/healthz`` (200 +
     uptime/pid JSON — the liveness probe serving deployments point at
-    the same port) on a daemon thread; ``port=0`` picks a free port.
-    Returns :class:`ScrapeServer`."""
+    the same port), and ``/readyz`` (readiness, see below) on a daemon
+    thread; ``port=0`` picks a free port. Returns
+    :class:`ScrapeServer`.
+
+    ``ready`` is an optional zero-arg callable consulted per
+    ``/readyz`` probe: truthy -> 200, falsy (or raising) -> 503 — 503
+    means "alive but do not send traffic", the state a draining or
+    admission-paused serving replica is in, so load balancers stop
+    routing BEFORE ``drain()`` finishes. ``/healthz`` stays 200 the
+    whole time (the process is healthy; restarting it would be wrong).
+    With ``ready=None``, ``/readyz`` mirrors ``/healthz``."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry if registry is not None else default_registry()
@@ -146,18 +157,29 @@ def start_http_server(port=0, addr="127.0.0.1", registry=None):
 
     class Handler(BaseHTTPRequestHandler):
         def _payload(self):
-            """(body, content-type) for the path, or None -> 404."""
+            """(status, body, content-type) for the path, or None."""
             if self.path in ("/", "/metrics"):
-                return (prometheus_text(reg).encode(),
+                return (200, prometheus_text(reg).encode(),
                         "text/plain; version=0.0.4; charset=utf-8")
             if self.path == "/metrics.json":
-                return (json.dumps(json_snapshot(reg)).encode(),
+                return (200, json.dumps(json_snapshot(reg)).encode(),
                         "application/json")
             if self.path == "/healthz":
                 doc = {"status": "ok", "pid": os.getpid(),
                        "uptime_seconds": round(
                            time.monotonic() - t_start, 3)}
-                return json.dumps(doc).encode(), "application/json"
+                return 200, json.dumps(doc).encode(), "application/json"
+            if self.path == "/readyz":
+                ok = True
+                if ready is not None:
+                    try:
+                        ok = bool(ready())
+                    except Exception:
+                        ok = False
+                doc = {"status": "ready" if ok else "not_ready",
+                       "pid": os.getpid()}
+                return (200 if ok else 503,
+                        json.dumps(doc).encode(), "application/json")
             return None
 
         def _respond(self, head_only):
@@ -165,8 +187,8 @@ def start_http_server(port=0, addr="127.0.0.1", registry=None):
             if payload is None:
                 self.send_error(404)
                 return
-            body, ctype = payload
-            self.send_response(200)
+            status, body, ctype = payload
+            self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()

@@ -21,12 +21,18 @@ Rule fields (all optional except ``point`` and ``action``):
 - ``point``: instrumented point name (exact match). Instrumented so
   far: the checkpoint commit path (``ckpt.write``,
   ``ckpt.before_marker``, ``rename``), the training loop
-  (``train.step``), and the serving request lifecycle
+  (``train.step``), the serving request lifecycle
   (``serve.admit`` — fired per admission attempt, so a ``raise`` rule
   with ``exc: "MemoryError"`` simulates KV-pool pressure and drives
   the degradation ladder; ``serve.decode`` — fired before each
   step/burst dispatch, ``step`` = dispatch ordinal; ``serve.drain`` —
-  fired as a graceful drain begins).
+  fired as a graceful drain begins), and the multi-replica serving
+  tier (``replica.dead`` — fired per replica worker-loop tick with
+  ``step`` = tick ordinal and ``path`` = the replica id, so a
+  ``raise``/``hang`` rule kills replica N at tick K and the router's
+  membership TTL + failover path runs deterministically in CI;
+  ``router.route`` — fired per routing decision with ``step`` = the
+  route ordinal, so a ``raise`` rule injects routing errors).
 - ``action``: one of ``crash`` (``os._exit``), ``sigkill``, ``sigterm``
   (signal self), ``hang`` (sleep ~forever), ``sleep`` (slow-down, then
   continue), ``raise`` (``OSError`` by default; see ``exc``),

@@ -31,7 +31,8 @@ def _seed():
 #    never converges) must fail WITH a stack dump, not silently eat the
 #    suite's global timeout. faulthandler dumps every thread's stack
 #    after the per-test budget and exits, so CI sees where it hung. ----
-_WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle"}
+_WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
+                        "test_cluster", "test_prefix_cache"}
 
 
 @pytest.fixture(autouse=True)

@@ -193,9 +193,12 @@ class TestElastic:
         store.register("a")
         store.register("b")
         assert store.hosts() == ["a", "b"]
-        # backdate b's heartbeat past the ttl (a crash never refreshes)
-        with open(os.path.join(str(tmp_path), "b"), "w") as f:
+        # backdate b's heartbeat past the ttl (a crash never refreshes);
+        # staleness is judged by the stamp file's mtime, so backdate that
+        p = os.path.join(str(tmp_path), "b")
+        with open(p, "w") as f:
             f.write(str(time.time() - 120.0))
+        os.utime(p, (time.time() - 120.0, time.time() - 120.0))
         assert store.hosts() == ["a"]
         m = ElasticManager(store, "a", 2)
         assert m.watch_once() == "scale_down"
@@ -220,6 +223,45 @@ class TestElastic:
         store.register("a")
         with open(os.path.join(str(tmp_path), "a"), "w") as f:
             f.write(str(time.time() - 1e6))
+        assert store.hosts() == ["a"]
+
+    def test_filestore_writer_clock_skew_does_not_expire_healthy_host(
+            self, tmp_path):
+        """Regression: a healthy replica whose CLOCK is skewed (or hit
+        an NTP step) embeds a bogus time.time() in its stamp. Aging
+        must follow the stamp file's mtime — the filesystem server's
+        clock — so the host stays live; only a genuinely stale mtime
+        (no heartbeat actually landing) expires it."""
+        import os
+
+        store = FileStore(str(tmp_path), ttl=30.0)
+        # writer's clock is 1e6 s behind: embedded stamp looks ancient,
+        # but the write itself (mtime) just happened
+        p = os.path.join(str(tmp_path), "skewed")
+        with open(p, "w") as f:
+            f.write(str(time.time() - 1e6))
+        assert store.hosts() == ["skewed"]
+        # the reverse: an embedded stamp claiming the future cannot
+        # keep a host alive when no write has landed within the ttl
+        q = os.path.join(str(tmp_path), "stale")
+        with open(q, "w") as f:
+            f.write(str(time.time() + 1e6))
+        os.utime(q, (time.time() - 120.0, time.time() - 120.0))
+        assert store.hosts() == ["skewed"]
+        # heartbeat (a real write) revives it
+        store.heartbeat("stale")
+        assert store.hosts() == ["skewed", "stale"]
+
+    def test_filestore_reader_clock_skew_does_not_expire_hosts(
+            self, tmp_path, monkeypatch):
+        """The READER side of the same bug: a router whose clock runs
+        an hour ahead must not see every heartbeating host as stale —
+        hosts() compares mtimes against the fs server's own 'now'
+        (probed), not the reader's time.time()."""
+        store = FileStore(str(tmp_path), ttl=30.0)
+        store.register("a")
+        real = time.time
+        monkeypatch.setattr(time, "time", lambda: real() + 3600.0)
         assert store.hosts() == ["a"]
 
 

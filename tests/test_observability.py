@@ -339,7 +339,11 @@ class TestServingIntegration:
         # must observe per-token latency
         engine.generate(_prompts(), max_new_tokens=5)
         assert reg.get("serving_token_latency_seconds").count > 0
-        # pool drained: utilization gauge returns to 0 at quiescence
+        # pool drained at quiescence: only shared-prefix cache pins
+        # remain (the 9-token prompt leaves one full page cached);
+        # invalidating the cache returns utilization to 0
+        engine.prefix.clear()
+        engine._set_pool_gauges()
         assert reg.get("serving_kv_page_utilization").value == 0.0
         assert reg.get("serving_queue_depth").value == 0.0
         names = {e["name"] for e in otrace.get_events()}

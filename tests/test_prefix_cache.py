@@ -1,0 +1,392 @@
+"""PageAllocator refcount / copy-on-write semantics and the
+shared-prefix KV cache, from allocator unit tests up to token-exact
+engine-level prefix reuse.
+
+The gold standard for the engine tests is the model's own greedy
+decode: a request admitted against CACHED prefix pages must emit
+exactly the tokens a cold run of the same prompt emits.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.paged_cache import PageAllocator
+from paddle_tpu.inference.prefix_cache import PrefixCache
+from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+
+
+# ---------------------------------------------------------------------
+# allocator refcounts + copy-on-write (satellite)
+# ---------------------------------------------------------------------
+class TestRefcounts:
+    def test_shared_admit_increfs(self):
+        alloc = PageAllocator(8, 4)
+        t1 = alloc.admit(1, 8)
+        assert all(alloc.page_ref(p) == 1 for p in t1)
+        alloc.incref(t1[0])                 # a cache pin
+        assert alloc.page_ref(t1[0]) == 2
+        t2 = alloc.admit(2, 8, shared_pages=[t1[0]])
+        assert t2[0] == t1[0] and alloc.page_ref(t1[0]) == 3
+        assert t2[1] != t1[1]               # private tail page
+
+    def test_shared_admit_rejects_free_page(self):
+        alloc = PageAllocator(4, 4)
+        t = alloc.admit(1, 4)
+        alloc.release(1)
+        with pytest.raises(ValueError):
+            alloc.admit(2, 4, shared_pages=[t[0]])
+
+    def test_release_ordering_shared_page_survives(self):
+        """A page shared by two sequences and a cache pin frees only
+        after the LAST reference drops, whatever the release order."""
+        alloc = PageAllocator(8, 4)
+        t1 = alloc.admit(1, 4)
+        alloc.incref(t1[0])
+        t2 = alloc.admit(2, 4, shared_pages=[t1[0]])
+        assert t2 == [t1[0]]
+        alloc.release(1)
+        assert alloc.page_ref(t1[0]) == 2   # seq 2 + cache
+        assert t1[0] not in alloc._free_set
+        alloc.release(2)
+        assert alloc.page_ref(t1[0]) == 1   # cache only
+        assert alloc.free_pages == 7
+        assert alloc.decref(t1[0]) is True  # last ref frees
+        assert alloc.free_pages == 8
+        assert alloc.double_free_count == 0
+
+    def test_double_admit_against_shared_page(self):
+        alloc = PageAllocator(8, 4)
+        t = alloc.admit(1, 4)
+        alloc.incref(t[0])
+        alloc.admit(2, 4, shared_pages=[t[0]])
+        alloc.admit(3, 4, shared_pages=[t[0]])
+        assert alloc.page_ref(t[0]) == 4
+        for s in (3, 1, 2):
+            alloc.release(s)
+        assert alloc.page_ref(t[0]) == 1
+        assert alloc.free_pages == 7
+        assert alloc.double_free_count == 0
+
+    def test_cow_on_write_into_shared_page(self):
+        """extend() into a shared page must go through ensure_writable:
+        the writer gets a private copy, other owners keep the
+        original."""
+        alloc = PageAllocator(8, 4)
+        t1 = alloc.admit(1, 4)
+        alloc.incref(t1[0])                 # ref 2: shared
+        alloc.admit(2, 2, shared_pages=[t1[0]])
+        alloc.extend(2, 1)                  # pos 2, inside the shared page
+        cp = alloc.ensure_writable(2, 2)
+        assert cp is not None
+        old, new = cp
+        assert old == t1[0] and new != old
+        assert alloc._tables[2][0] == new
+        assert alloc.page_ref(old) == 2     # seq 1 + cache
+        assert alloc.page_ref(new) == 1
+        assert alloc.cow_count == 1
+        # now private: a second write is a no-op
+        assert alloc.ensure_writable(2, 2) is None
+        assert alloc.cow_count == 1
+
+    def test_cow_exhausted_pool_raises(self):
+        alloc = PageAllocator(2, 4)
+        t1 = alloc.admit(1, 4)
+        alloc.incref(t1[0])
+        alloc.admit(2, 2, shared_pages=[t1[0]])
+        alloc.admit(3, 4)                   # drains the free list
+        with pytest.raises(MemoryError):
+            alloc.ensure_writable(2, 1)
+
+    def test_idempotent_release_contract_with_refcounts(self):
+        """PR-4 contract preserved: double release / double decref are
+        counted no-ops that never corrupt the free list, and never
+        touch the surviving references of a shared page."""
+        alloc = PageAllocator(8, 4)
+        t = alloc.admit(1, 4)
+        alloc.incref(t[0])
+        alloc.admit(2, 4, shared_pages=[t[0]])
+        alloc.release(2)
+        with pytest.warns(RuntimeWarning):
+            alloc.release(2)                # unknown now: counted no-op
+        assert alloc.double_free_count == 1
+        assert alloc.page_ref(t[0]) == 2    # untouched by the no-op
+        alloc.release(1)
+        assert alloc.decref(t[0]) is True
+        with pytest.warns(RuntimeWarning):
+            assert alloc.decref(t[0]) is False
+        assert alloc.double_free_count == 2
+        assert alloc.free_pages == 8
+
+
+# ---------------------------------------------------------------------
+# PrefixCache bookkeeping (no model)
+# ---------------------------------------------------------------------
+class TestPrefixCache:
+    def test_match_insert_full_pages_only(self):
+        alloc = PageAllocator(32, 4)
+        cache = PrefixCache(alloc, 4)
+        prompt = list(range(10))            # 2 full pages + 2 tokens
+        table = alloc.admit(1, 10)
+        assert cache.insert(prompt, table) == 2
+        pages, n = cache.match(prompt)
+        assert n == 8 and pages == table[:2]
+        # a diverging second page matches only page 0 (chain hashing)
+        pages, n = cache.match(prompt[:4] + [99, 98, 97, 96, 1, 2])
+        assert n == 4 and pages == [table[0]]
+        # unrelated prompt: no match
+        assert cache.match([7] * 10) == ([], 0)
+
+    def test_exact_multiple_prompt_never_fully_covered(self):
+        """The final prompt token must run through the model (it
+        produces the first-output logits), so a prompt that is an
+        exact page multiple caches/matches one page less."""
+        alloc = PageAllocator(32, 4)
+        cache = PrefixCache(alloc, 4)
+        prompt = list(range(8))             # exactly 2 pages
+        table = alloc.admit(1, 8)
+        assert cache.insert(prompt, table) == 1   # page 0 only
+        pages, n = cache.match(prompt)
+        assert n == 4 and pages == [table[0]]
+
+    def test_insert_pins_pages_past_release(self):
+        alloc = PageAllocator(32, 4)
+        cache = PrefixCache(alloc, 4)
+        prompt = list(range(13))            # 3 full pages cacheable
+        table = alloc.admit(1, 13)
+        cache.insert(prompt, table)
+        alloc.release(1)
+        assert alloc.free_pages == 32 - 3   # pinned by the cache
+        pages, n = cache.match(prompt)
+        assert n == 12 and pages == table[:3]
+
+    def test_eviction_removes_chain_tails_first(self):
+        alloc = PageAllocator(32, 4)
+        cache = PrefixCache(alloc, 4)
+        prompt = list(range(13))
+        table = alloc.admit(1, 13)
+        cache.insert(prompt, table)
+        alloc.release(1)
+        assert cache.evict_pages(1) == 1    # the tail page frees
+        pages, n = cache.match(prompt)
+        assert n == 8 and pages == table[:2]    # prefix chain intact
+        assert cache.clear() == 2
+        assert alloc.free_pages == 32
+        assert cache.match(prompt) == ([], 0)
+
+    def test_eviction_of_page_shared_with_live_seq_does_not_free(self):
+        alloc = PageAllocator(32, 4)
+        cache = PrefixCache(alloc, 4)
+        prompt = list(range(9))             # 2 full pages cacheable
+        table = alloc.admit(1, 9)
+        cache.insert(prompt, table)
+        alloc.admit(2, 9, shared_pages=table[:2])
+        alloc.release(1)
+        free0 = alloc.free_pages
+        assert cache.clear() == 0           # unpinned, but seq 2 holds
+        assert alloc.free_pages == free0
+        alloc.release(2)
+        assert alloc.free_pages == 32
+
+    def test_max_pages_cap_evicts_lru(self):
+        alloc = PageAllocator(32, 4)
+        cache = PrefixCache(alloc, 4, max_pages=2)
+        t1 = alloc.admit(1, 9)
+        cache.insert(list(range(9)), t1)            # 2 pages
+        t2 = alloc.admit(2, 9)
+        cache.insert([50 + i for i in range(9)], t2)
+        assert cache.pages == 2             # capped, LRU chain evicted
+
+    def test_stats(self):
+        alloc = PageAllocator(32, 4)
+        cache = PrefixCache(alloc, 4)
+        table = alloc.admit(1, 9)
+        cache.insert(list(range(9)), table)
+        cache.match(list(range(9)))
+        cache.match([99] * 9)
+        s = cache.stats()
+        assert s["hits"] == 1 and s["lookups"] == 2
+        assert s["hit_rate"] == 0.5 and s["saved_tokens"] == 8
+
+
+# ---------------------------------------------------------------------
+# engine-level shared-prefix reuse (token-exact)
+# ---------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(tiny_llama_config())
+    m.eval()
+    return m
+
+
+def _reference_continuation(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], np.int64))
+    out = model.generate(ids, max_new_tokens=n)
+    return np.asarray(out._data)[0, len(prompt):].tolist()
+
+
+class TestEngineSharedPrefix:
+    def test_cached_prefix_is_token_exact(self, model):
+        """Two prompts sharing a page-aligned 16-token prefix: the
+        second admits against the first's cached pages (hit counted,
+        prefill skipped) and still reproduces its standalone greedy
+        continuation token for token."""
+        from paddle_tpu.inference.serving import (LlamaServingEngine,
+                                                  Request)
+        from paddle_tpu.observability import metrics as om
+
+        rng = np.random.RandomState(11)
+        v = model.config.vocab_size
+        prefix = rng.randint(0, v, (16,)).tolist()  # 2 full pages @ 8
+        p1 = prefix + rng.randint(0, v, (3,)).tolist()
+        p2 = prefix + rng.randint(0, v, (4,)).tolist()
+        engine = LlamaServingEngine(model, max_batch=4, page_size=8,
+                                    num_pages=32)
+        r1 = Request(p1, max_new_tokens=5)
+        engine.add_request(r1)
+        while not r1.done:
+            engine.step()
+        assert r1._cached_tokens == 0
+        assert r1.output_ids == _reference_continuation(model, p1, 5)
+        assert engine.prefix.pages == 2
+
+        r2 = Request(p2, max_new_tokens=5)
+        engine.add_request(r2)
+        assert r2._cached_tokens == 16      # both prefix pages reused
+        while not r2.done:
+            engine.step()
+        assert r2.output_ids == _reference_continuation(model, p2, 5)
+        s = engine.prefix.stats()
+        assert s["hits"] >= 1 and s["saved_tokens"] >= 16
+        if om.enabled():
+            assert om.counter(
+                "serving_prefix_cache_hit_total").value >= 1
+            assert om.counter(
+                "serving_prefix_saved_prefill_tokens_total").value >= 16
+        # invalidation returns every cached page; nothing leaks
+        engine.prefix.clear()
+        assert engine.alloc.free_pages == engine.alloc.num_pages
+        assert engine.alloc.cow_count == 0  # page-aligned: no COW fired
+        engine.close()
+
+    def test_three_way_share_and_release_ordering(self, model):
+        """Several live requests on the same cached prefix, retiring in
+        arbitrary order: pages free only when the cache lets go."""
+        from paddle_tpu.inference.serving import (LlamaServingEngine,
+                                                  Request)
+
+        rng = np.random.RandomState(12)
+        v = model.config.vocab_size
+        prefix = rng.randint(0, v, (16,)).tolist()
+        engine = LlamaServingEngine(model, max_batch=4, page_size=8,
+                                    num_pages=48)
+        reqs = [Request(prefix + rng.randint(0, v, (2 + i,)).tolist(),
+                        max_new_tokens=3 + i) for i in range(3)]
+        for r in reqs:
+            engine.add_request(r)
+        assert [r._cached_tokens for r in reqs] == [0, 16, 16]
+        shared_pages = engine.alloc._tables[reqs[1].seq_id][:2]
+        assert engine.alloc._tables[reqs[2].seq_id][:2] == shared_pages
+        assert all(engine.alloc.page_ref(p) == 4 for p in shared_pages)
+        while not all(r.done for r in reqs):
+            engine.step()
+        for r in reqs:
+            want = _reference_continuation(
+                model, list(r.prompt_ids), r.max_new_tokens)
+            assert r.output_ids == want
+        # all retired: only the cache pins remain
+        assert all(engine.alloc.page_ref(p) == 1 for p in shared_pages)
+        engine.prefix.clear()
+        assert engine.alloc.free_pages == engine.alloc.num_pages
+        engine.close()
+
+    def test_pool_pressure_evicts_cache_before_shedding(self, model):
+        """Cached prefixes are an optimization, never a reason to shed:
+        an admission that would exhaust the pool reclaims cold cache
+        pages and succeeds instead of raising AdmissionError."""
+        from paddle_tpu.inference.serving import (LlamaServingEngine,
+                                                  Request)
+
+        rng = np.random.RandomState(13)
+        v = model.config.vocab_size
+        engine = LlamaServingEngine(model, max_batch=2, page_size=8,
+                                    num_pages=16)   # 15 usable pages
+        r1 = Request(rng.randint(0, v, (17,)).tolist(), max_new_tokens=2)
+        engine.add_request(r1)
+        while not r1.done:
+            engine.step()
+        assert engine.prefix.pages == 2     # pinned past retirement
+        free0 = engine.alloc.free_pages
+        assert free0 == 13
+        # 105 tokens need 14 pages; only 13 are free -> the admission
+        # must reclaim a pinned cache page instead of shedding
+        big = Request(rng.randint(0, v, (105,)).tolist(),
+                      max_new_tokens=2)
+        engine.add_request(big)             # must NOT raise
+        assert big.status in ("live", "completed")
+        while not big.done:
+            engine.step()
+        assert big.status == "completed"
+        assert engine.alloc.free_pages + engine.prefix.pages \
+            == engine.alloc.num_pages
+        engine.close()
+
+    def test_decode_pressure_reclaims_cache_before_evicting_live(
+            self, model):
+        """The decode-boundary rung honors the same contract as
+        admission: when a live sequence needs a page and the pool is
+        empty, cold prefix-cache pages are reclaimed BEFORE any live
+        request is evicted or trimmed."""
+        from paddle_tpu.inference.serving import (LlamaServingEngine,
+                                                  Request)
+        from paddle_tpu.observability import metrics as om
+
+        rng = np.random.RandomState(14)
+        v = model.config.vocab_size
+        engine = LlamaServingEngine(model, max_batch=2, page_size=8,
+                                    num_pages=16)   # 15 usable pages
+        r1 = Request(rng.randint(0, v, (17,)).tolist(), max_new_tokens=2)
+        engine.add_request(r1)
+        while not r1.done:
+            engine.step()
+        assert engine.prefix.pages == 2 and engine.alloc.free_pages == 13
+        ev0 = om.counter("serving_degraded_total",
+                         labelnames=("rung",)).labels("evict").value \
+            if om.enabled() else 0
+        # 104 tokens = exactly 13 pages: admission fits with zero slack,
+        # and the first decode extend needs a 14th page from a dry pool
+        big = Request(rng.randint(0, v, (104,)).tolist(),
+                      max_new_tokens=3)
+        engine.add_request(big)
+        while not big.done:
+            engine.step()
+        assert big.status == "completed" and not big.trimmed
+        assert len(big.output_ids) == 3     # never evicted/restarted
+        # the cache paid (r1's cold chain was reclaimed), not the
+        # request; big's own prefix re-populated the cache afterwards
+        assert engine.prefix.evictions >= 1
+        if om.enabled():
+            assert om.counter("serving_degraded_total",
+                              labelnames=("rung",)).labels(
+                                  "evict").value == ev0
+        engine.close()
+
+    def test_requeued_request_rematches_prefix(self, model):
+        """An evicted+requeued request re-matches at re-admission (its
+        _cached_tokens reset with its cleared output)."""
+        from paddle_tpu.inference.serving import Request
+
+        r = Request([1] * 20, max_new_tokens=4)
+        r._cached_tokens = 16
+        r.seq_id = 7
+        # exercise the reset path the ladder uses
+        from paddle_tpu.inference.serving import LlamaServingEngine
+        engine = LlamaServingEngine(model, max_batch=2, page_size=8,
+                                    num_pages=32)
+        engine.alloc.admit(7, 20)
+        engine._live[7] = r
+        r.status = "live"
+        engine._evict(r)
+        assert r.status == "requeued" and r._cached_tokens == 0
+        engine.close()
