@@ -11,6 +11,7 @@ MFU). Reference bar for the harness itself: `tools/ci_op_benchmark.sh`,
 """
 
 import json
+import os
 import sys
 import time
 
@@ -406,6 +407,68 @@ def bench_prefix_cluster(model, on_tpu=True):
     return out
 
 
+def bench_restart_ttft(on_tpu=True):
+    """Cold vs warm-cache restart-to-first-token for a SUBPROCESS
+    serving replica (ROADMAP item 5 / PR 7): a worker process is
+    started against an empty persistent compile cache (cold — it pays
+    the full XLA compile bill before its self-probe's first token),
+    SIGKILLed, and replaced by the supervisor; the replacement
+    pre-warms the registry-recorded shape buckets against the now-warm
+    cache. The delta is what makes kill-and-replace a non-event."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.inference.cluster import ServingCluster
+
+    root = tempfile.mkdtemp(prefix="paddle_tpu_restart_bench_")
+    cfg = (dict(vocab_size=8192, hidden_size=512, intermediate_size=1408,
+                num_hidden_layers=8, num_attention_heads=8,
+                num_key_value_heads=4) if on_tpu else
+           dict(vocab_size=512, hidden_size=256, intermediate_size=512,
+                num_hidden_layers=4, num_attention_heads=4,
+                num_key_value_heads=2))
+    spec = {"model": {"kind": "tiny_llama", "seed": 0, "config": cfg},
+            "engine": dict(max_batch=4 if on_tpu else 2,
+                           page_size=16 if on_tpu else 8,
+                           num_pages=128 if on_tpu else 48)}
+    env = {"PADDLE_TPU_COMPILE_CACHE_DIR": os.path.join(root, "cache"),
+           "PADDLE_TPU_SHAPE_REGISTRY": os.path.join(root, "shapes.json")}
+    cluster = ServingCluster(
+        engine_spec=spec, num_replicas=1,
+        store_path=os.path.join(root, "members"), ttl=30.0,
+        monitor_interval=0.05, restart_backoff=0.05,
+        spawn_grace=900.0, subprocess_env=env).start()
+    try:
+        deadline = time.time() + 900
+        rep = cluster.replicas()["replica-0"]
+        while not rep.ready() and time.time() < deadline:
+            time.sleep(0.2)
+        cold = rep.restart_ttft
+        # a little real load so decode lands in the shape registry via
+        # actual dispatches, then SIGKILL: the supervised replacement
+        # path IS the measured path
+        cluster.submit([1, 2, 3], max_new_tokens=4).result(timeout=600)
+        pid = rep._proc.pid
+        rep.kill()
+        deadline = time.time() + 900
+        while time.time() < deadline:
+            rep = cluster.replicas()["replica-0"]
+            if rep.alive() and rep.ready() and rep._proc.pid != pid:
+                break
+            time.sleep(0.2)
+        warm = rep.restart_ttft
+        hits = (rep.cache_stats or {}).get("hits", 0)
+    finally:
+        cluster.stop()
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "serving_restart_cold_ttft_ms": round(cold * 1e3, 1),
+        "serving_restart_ttft_ms": round(warm * 1e3, 1),
+        "serving_restart_ttft_speedup": round(cold / max(warm, 1e-9), 3),
+        "serving_restart_cache_hits": hits,
+    }
+
+
 # second MFU entry (~0.7-0.9B): best-first with HBM fallbacks
 LARGE_CANDIDATES = [
     (dict(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
@@ -537,6 +600,12 @@ def main():
     except Exception as e:
         log(f"prefix/cluster bench failed: {e!r:.300}")
         result["cluster_error"] = repr(e)[:200]
+
+    try:
+        result.update(bench_restart_ttft(on_tpu=on_tpu))
+    except Exception as e:
+        log(f"restart-ttft bench failed: {e!r:.300}")
+        result["restart_error"] = repr(e)[:200]
 
     try:
         if on_tpu:

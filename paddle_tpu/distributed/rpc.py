@@ -18,7 +18,8 @@ import threading
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_current_worker_info", "get_worker_info",
-           "get_all_worker_infos", "WorkerInfo", "RpcTimeoutError"]
+           "get_all_worker_infos", "WorkerInfo", "RpcTimeoutError",
+           "RpcEndpoint"]
 
 
 class RpcTimeoutError(TimeoutError):
@@ -79,15 +80,28 @@ class _FutureReply:
 
 
 class _RpcAgent:
-    def __init__(self, name, rank, world_size, store):
+    def __init__(self, name, rank, world_size, store, dynamic=False):
         self.name = name
         self.rank = rank
         self.world_size = world_size
         self.store = store
         self._stop = threading.Event()
         self._req_seq = 0
-        self._served = 0              # dispatcher's next-unserved seq
-        store.set(f"rpc/worker/{rank}", name.encode())
+        self._serve_from = 0
+        if dynamic:
+            # a REPLACEMENT incarnation of this name must resume the
+            # mailbox where the store's seq counter stands — starting at
+            # 0 would wait forever on seqs the dead incarnation already
+            # consumed (calls addressed to the corpse are lost; their
+            # callers time out typed and retry, which is the contract)
+            try:
+                raw = store.get(f"rpc/seq/{name}", timeout=0.25)
+                self._serve_from = int.from_bytes(raw, "little")
+            except TimeoutError:
+                pass                  # never called: fresh mailbox
+        self._served = self._serve_from   # dispatcher's next-unserved seq
+        if not dynamic:
+            store.set(f"rpc/worker/{rank}", name.encode())
         # DEDICATED connection for the dispatcher: a TCPStore client
         # serializes requests on its single socket, so a blocking
         # reply-wait elsewhere must never share the dispatcher's
@@ -96,12 +110,13 @@ class _RpcAgent:
         self._dispatch_store = self._connect()
         self._dispatcher = threading.Thread(target=self._serve, daemon=True)
         self._dispatcher.start()
-        # barrier: everyone registered before calls start flying
-        store.barrier(world_size, tag="rpc_init")
         self.workers = {}
-        for r in range(world_size):
-            wname = store.get(f"rpc/worker/{r}", timeout=30).decode()
-            self.workers[wname] = WorkerInfo(wname, r)
+        if not dynamic:
+            # barrier: everyone registered before calls start flying
+            store.barrier(world_size, tag="rpc_init")
+            for r in range(world_size):
+                wname = store.get(f"rpc/worker/{r}", timeout=30).decode()
+                self.workers[wname] = WorkerInfo(wname, r)
 
     def _connect(self):
         from ..native import TCPStore
@@ -110,7 +125,7 @@ class _RpcAgent:
                         timeout=self.store.timeout)
 
     def _serve(self):
-        seq = 0
+        seq = self._serve_from
         st = self._dispatch_store
         while not self._stop.is_set():
             key = f"rpc/to/{self.name}/{seq}"
@@ -220,6 +235,63 @@ class _RpcAgent:
             if conn is not None:
                 conn.close()
         self._dispatch_store.close()
+
+
+class RpcEndpoint:
+    """A named RPC mailbox with DYNAMIC membership — the serving tier's
+    sibling of :func:`init_rpc`'s fixed-world agent.
+
+    ``init_rpc`` assumes a training job: every rank known up front, a
+    barrier before the first call, one global agent per process. A
+    serving cluster is the opposite — replica processes join when they
+    finish compiling, die without notice, and are replaced under a new
+    incarnation of the same name — so an endpoint skips the barrier and
+    the rank enumeration entirely: the name IS the address (the store
+    key-space is already name-keyed: ``rpc/to/{name}/{seq}``), late
+    joiners serve as soon as their dispatcher is up, and any number of
+    endpoints may live in one process (no global singleton).
+
+    The router hosts the master store (``is_master=True, port=0`` picks
+    a free port — read it back from :attr:`port`); workers connect as
+    clients. Everything else — the dedicated dispatcher connection, the
+    tombstone protocol for timed-out calls, the typed
+    :class:`RpcTimeoutError` — is the proven ``_RpcAgent`` machinery,
+    reused as-is.
+    """
+
+    def __init__(self, name, host="127.0.0.1", port=0, is_master=False,
+                 timeout=60.0):
+        from ..native import TCPStore
+
+        self.name = name
+        store = TCPStore(host=host, port=int(port), is_master=is_master,
+                         timeout=timeout)
+        self.host = host
+        self.port = store.port
+        self._agent = _RpcAgent(name, rank=None, world_size=None,
+                                store=store, dynamic=True)
+        self._closed = False
+
+    def call(self, to, fn, args=None, kwargs=None, timeout=30.0):
+        """Async call of ``fn(*args, **kwargs)`` on endpoint ``to``;
+        returns a future whose ``wait()`` raises the peer's pickled
+        exception or a typed :class:`RpcTimeoutError`."""
+        return self._agent.call(to, fn, args, kwargs, timeout)
+
+    def call_sync(self, to, fn, args=None, kwargs=None, timeout=30.0):
+        return self.call(to, fn, args, kwargs, timeout).wait(timeout)
+
+    def stop(self):
+        """Stop serving and sweep this endpoint's own tombstones.
+        Idempotent; the underlying store connection is closed."""
+        if self._closed:
+            return
+        self._closed = True
+        self._agent.stop()
+        try:
+            self._agent.store.close()
+        except Exception:
+            pass
 
 
 _agent: _RpcAgent | None = None

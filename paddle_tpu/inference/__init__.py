@@ -114,8 +114,9 @@ _LAZY = {
     "LlamaServingEngine": "serving", "Request": "serving",
     "AdmissionError": "serving", "DeadlineExceeded": "serving",
     "ServingCluster": "cluster", "EngineReplica": "cluster",
+    "SubprocessReplica": "cluster", "ReplicaLostError": "cluster",
     "ClusterRequest": "cluster", "PrefixCache": "prefix_cache",
-    "PageAllocator": "paged_cache",
+    "PageAllocator": "paged_cache", "replica_main": "replica_worker",
 }
 
 

@@ -48,6 +48,9 @@ deterministically in CI.
 from __future__ import annotations
 
 import collections
+import json
+import os
+import random
 import tempfile
 import threading
 import time
@@ -61,8 +64,8 @@ from ..testing import faults as _faults
 from .serving import (AdmissionError, DeadlineExceeded,
                       LlamaServingEngine, Request)
 
-__all__ = ["ClusterRequest", "EngineReplica", "ServingCluster",
-           "ReplicaLostError"]
+__all__ = ["ClusterRequest", "EngineReplica", "SubprocessReplica",
+           "ServingCluster", "ReplicaLostError"]
 
 
 class ReplicaLostError(RuntimeError):
@@ -73,6 +76,13 @@ class ReplicaLostError(RuntimeError):
         super().__init__(msg)
         self.replica_id = replica_id
         self.failovers = failovers
+
+    def __reduce__(self):
+        # survives the rpc error-reply round trip with its typed fields
+        # (default exception pickling keeps __dict__, but rebuilding
+        # from fields is the explicit contract the tests pin down)
+        return (type(self), (self.args[0] if self.args else "",
+                             self.replica_id, self.failovers))
 
 
 def _router_metrics():
@@ -99,6 +109,12 @@ def _router_metrics():
             "router_replicas_ready",
             "replicas currently routable (alive, registered, not "
             "draining)"),
+        "quarantined": _om.counter(
+            "cluster_replica_quarantined_total",
+            "replicas quarantined by the crash-loop circuit breaker"),
+        "quarantined_now": _om.gauge(
+            "cluster_replicas_quarantined",
+            "replicas currently held out by the circuit breaker"),
     }
 
 
@@ -196,6 +212,21 @@ class ClusterRequest:
         self.error = error
         self._finished.set()
 
+    def _attempt_spec(self, replica_id):
+        """JSON-able engine-request spec for one submission attempt to a
+        SUBPROCESS replica (deadline already reduced to the remaining
+        cluster TTL), or None when the request finished typed first."""
+        req = self._new_attempt(replica_id)
+        if req is None:
+            return None
+        return {"prompt_ids": [int(t) for t in self.prompt_ids],
+                "max_new_tokens": self.max_new_tokens,
+                "eos_token_id": self.eos_token_id,
+                "deadline": req.deadline,
+                "token_budget": self.token_budget,
+                "priority": self.priority,
+                "retry_budget": self.retry_budget}
+
     def _finish_from(self, req):
         """Adopt an engine request's terminal state."""
         with self._lock:
@@ -203,6 +234,15 @@ class ClusterRequest:
                 return
             self.output_ids = list(req.output_ids)
             self._finish_locked(req.status, req.error)
+
+    def _finish_remote(self, status, output_ids, error):
+        """Adopt a terminal state reported by a subprocess replica over
+        rpc (the error arrives pickled — typed, fields intact)."""
+        with self._lock:
+            if self._finished.is_set():
+                return
+            self.output_ids = list(output_ids or [])
+            self._finish_locked(status, error)
 
     def _fail(self, status, error):
         with self._lock:
@@ -233,8 +273,13 @@ class EngineReplica:
 
     def __init__(self, replica_id, engine_factory, store=None,
                  ttl=None, heartbeat_interval=None, max_backlog=None,
-                 idle_sleep=0.002, burst=None):
+                 idle_sleep=0.002, burst=None, spawn_fault=True):
         self.replica_id = str(replica_id)
+        # replica_main() passes False: for a subprocess worker the
+        # SUPERVISOR's Popen is the spawn — the inherited fault plan
+        # must not fire the same serve.spawn rule a second time inside
+        # the worker it already allowed to spawn
+        self._spawn_fault = bool(spawn_fault)
         self._factory = engine_factory
         self.engine: LlamaServingEngine | None = None
         self.store = store
@@ -260,6 +305,8 @@ class EngineReplica:
         self._death_reason = None
         self._last_beat = 0.0
         self._ticks = 0
+        self._beats = 0
+        self._spawns = 0
         self._m_dead = _om.counter(
             "replica_deaths_total",
             "replica worker loops that died uncleanly")
@@ -269,6 +316,27 @@ class EngineReplica:
         with self._lock:
             if self._thread is not None and self._thread.is_alive():
                 return self
+        # retire the previous incarnation's threads BEFORE clearing the
+        # stop event: clearing first can resurrect a heartbeat sidecar
+        # still parked in its wait() — two sidecars then stamp one id,
+        # and a DEAD incarnation's survivor would keep a ghost fresh in
+        # membership past its real death
+        self._stop.set()
+        for t in (self._thread, self._hb_thread):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=5.0)
+        # deterministic spawn failure for chaos plans: a raise rule at
+        # serve.spawn (path = replica id, step = spawn ordinal) fails
+        # this start/restart the way a full host or a bad image fails a
+        # process spawn — the supervisor's backoff + breaker take over.
+        # The ordinal advances even when the fault raises, so a
+        # step-keyed rule fails only the attempt it names.
+        spawn = self._spawns
+        self._spawns += 1
+        if self._spawn_fault:
+            _faults.fire("serve.spawn", step=spawn,
+                         path=self.replica_id)
+        with self._lock:
             self._stop.clear()
             self._draining = False
             self._dead = False
@@ -302,6 +370,13 @@ class EngineReplica:
         while not self._stop.wait(self._hb_interval):
             if self._dead or not self.alive():
                 return      # a crashed host never says goodbye
+            # chaos hook: a hang/sleep rule at replica.heartbeat (path =
+            # replica id, step = beat ordinal) freezes this sidecar so
+            # the replica silently ages out of membership — the TTL
+            # detection + circuit-breaker path, driven deterministically
+            _faults.fire("replica.heartbeat", step=self._beats,
+                         path=self.replica_id)
+            self._beats += 1
             try:
                 self.store.heartbeat(self.replica_id)
             except OSError:
@@ -311,6 +386,19 @@ class EngineReplica:
     def alive(self):
         t = self._thread
         return (not self._dead) and t is not None and t.is_alive()
+
+    def is_dead(self, registered):
+        """Supervisor's death verdict given this sweep's membership
+        observation: a dead worker thread, or a live thread whose stamp
+        aged out (frozen heartbeats — as good as dead for routing)."""
+        return (not self.alive()) or (not registered
+                                      and not self._draining)
+
+    def cancel_attempt(self, creq):
+        """Cancel the engine-level attempt of a cluster request."""
+        req = creq.request
+        if req is not None and self.engine is not None:
+            self.engine.cancel(req)
 
     def ready(self):
         return (self.alive() and not self._draining
@@ -467,12 +555,14 @@ class EngineReplica:
         return out
 
     def stop_worker(self, timeout=10.0):
-        """Ask the worker loop to exit and join it (the engine itself
-        stays usable — rolling restart drains it next)."""
+        """Ask the worker loop to exit and join it — the heartbeat
+        sidecar too, so a stopped incarnation can never keep stamping
+        membership (the ghost a later restart would resurrect). The
+        engine itself stays usable — rolling restart drains it next."""
         self._stop.set()
-        t = self._thread
-        if t is not None and t is not threading.current_thread():
-            t.join(timeout)
+        for t in (self._thread, self._hb_thread):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout)
 
     def drain(self, grace=30.0):
         """Drain the engine (worker must be stopped first so only one
@@ -522,13 +612,486 @@ class EngineReplica:
             self.engine.close()
 
 
+class SubprocessReplica:
+    """One serving replica in its OWN process — the crash-containment
+    unit. A segfault, OOM, or wedged XLA dispatch inside the worker
+    kills that process and nothing else; the supervisor sees the exit
+    code (or the heartbeat stamp aging out) and replaces it, warm via
+    the persistent compile cache.
+
+    The process runs :func:`paddle_tpu.inference.replica_worker
+    .replica_main`: it builds its engine from a JSON ``spec``,
+    registers in the shared :class:`FileStore` with TTL heartbeats once
+    the engine is ready (pre-warm included — registration IS the
+    readiness signal), and serves requests over the
+    :class:`~paddle_tpu.distributed.rpc.RpcEndpoint` transport. On this
+    side, a poller thread mirrors request state back into the router's
+    :class:`ClusterRequest` handles and keeps the last-seen load/ready
+    snapshot for routing — no rpc on the routing hot path.
+
+    Fault points: ``serve.spawn`` fires before each process spawn
+    (path = replica id, step = spawn ordinal) so a chaos plan can fail
+    spawns deterministically and drive the supervisor's circuit
+    breaker.
+    """
+
+    def __init__(self, replica_id, spec, endpoint, store, store_path,
+                 ttl=None, max_backlog=None, burst=None,
+                 spawn_grace=180.0, poll_interval=0.05,
+                 submit_timeout=15.0, env=None, on_orphan=None,
+                 prewarm=True, log_dir=None):
+        self.replica_id = str(replica_id)
+        self.spec = spec
+        self.endpoint = endpoint
+        self.store = store
+        self.store_path = store_path
+        self.ttl = ttl
+        self.max_backlog = max_backlog
+        self.burst = burst
+        self.spawn_grace = float(spawn_grace)
+        self.poll_interval = float(poll_interval)
+        self.submit_timeout = float(submit_timeout)
+        self.on_orphan = on_orphan
+        self.log_dir = log_dir
+        self._prewarm = prewarm
+        self._extra_env = dict(env or {})
+        self.engine = None            # interface parity: never local
+        self._proc = None
+        self._log_file = None
+        self._tracked: dict[str, ClusterRequest] = {}
+        self._ids: dict[ClusterRequest, str] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._poller = None
+        self._load = None             # last load dict seen by the poller
+        self._remote_ready = False
+        self._registered_seen = False
+        self._spawn_t = None
+        self._draining = False
+        self._dead = False
+        self.exit_code = None
+        self.restart_ttft = None      # worker-reported restart -> token
+        self.cache_stats = None       # worker-reported compile cache
+        self._spawns = 0
+        self._m_dead = _om.counter(
+            "replica_deaths_total",
+            "replica worker loops that died uncleanly")
+
+    # ------------------------------------------------------------------
+    def start(self):
+        import subprocess
+        import sys
+
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                return self
+        self._retire_poller()
+        # the chaos hook a crash-loop plan drives: raising here IS the
+        # failed spawn (bad image, full host); the supervisor backs
+        # off. The ordinal advances even when the fault raises, so a
+        # step-keyed rule fails exactly the attempt it names and the
+        # supervisor's NEXT retry can succeed (the recovery path).
+        spawn = self._spawns
+        self._spawns += 1
+        _faults.fire("serve.spawn", step=spawn, path=self.replica_id)
+        env = dict(os.environ)
+        env.update(self._extra_env)
+        # the worker must import THIS paddle_tpu, wherever the router
+        # imported it from (repo checkout, wheel, editable install) —
+        # python -m resolves via PYTHONPATH, not the router's sys.path
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        env["PADDLE_TPU_REPLICA_ID"] = self.replica_id
+        env["PADDLE_TPU_REPLICA_STORE"] = str(self.store_path)
+        env["PADDLE_TPU_REPLICA_RPC"] = \
+            f"{self.endpoint.host}:{self.endpoint.port}"
+        env["PADDLE_TPU_REPLICA_SPEC"] = json.dumps(self.spec)
+        env["PADDLE_TPU_REPLICA_T0"] = repr(time.time())
+        if self.ttl is not None:
+            env["PADDLE_TPU_REPLICA_TTL"] = repr(float(self.ttl))
+        if self.max_backlog is not None:
+            env["PADDLE_TPU_REPLICA_BACKLOG"] = str(self.max_backlog)
+        if self.burst is not None:
+            env["PADDLE_TPU_REPLICA_BURST"] = str(self.burst)
+        # prewarm on by default in workers: a replacement's first
+        # request must hit compiled programs, not the compile bill
+        env.setdefault("PADDLE_TPU_SERVING_PREWARM",
+                       "1" if self._prewarm else "0")
+        out = subprocess.DEVNULL
+        if self.log_dir is not None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._log_file = open(os.path.join(
+                self.log_dir,
+                f"{self.replica_id}.{self._spawns - 1}.log"), "w")
+            out = self._log_file
+        with self._lock:
+            self._dead = False
+            self._draining = False
+            self.exit_code = None
+            self._remote_ready = False
+            self._registered_seen = False
+            self._stop = threading.Event()   # fresh: old poller owns its own
+            self._spawn_t = time.monotonic()
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "paddle_tpu.inference.replica_worker"],
+                env=env, stdout=out, stderr=subprocess.STDOUT)
+        self._poller = threading.Thread(
+            target=self._poll_loop,
+            args=(self._stop, self._proc), daemon=True,
+            name=f"replica-{self.replica_id}-poll")
+        self._poller.start()
+        return self
+
+    def _retire_poller(self):
+        self._stop.set()
+        t = self._poller
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        if self._log_file is not None:
+            try:
+                self._log_file.close()
+            except OSError:
+                pass
+            self._log_file = None
+
+    # -- the result pump ------------------------------------------------
+    def _poll_loop(self, stop, proc):
+        from . import replica_worker as _rw
+
+        misses: dict[str, int] = {}
+        interval = self.poll_interval
+        while not stop.wait(interval):
+            if proc.poll() is not None:
+                with self._lock:
+                    if not self._dead:
+                        self._dead = True
+                        self._m_dead.inc()
+                    self.exit_code = proc.returncode
+                return
+            with self._lock:
+                ids = list(self._tracked)
+            # idle polls only refresh load/readiness — ease off so the
+            # router is not churning a connection per 50 ms per replica
+            # (each call opens a fresh store connection + waiter
+            # thread); with requests in flight, poll at full rate
+            interval = self.poll_interval if ids \
+                else max(self.poll_interval, 0.25)
+            try:
+                rsp = self.endpoint.call_sync(
+                    self.replica_id, _rw._worker_poll, (ids,),
+                    timeout=2.0)
+            except Exception:
+                continue    # starting or wedged: proc + TTL judge that
+            self._remote_ready = bool(rsp.get("ready"))
+            # NOTE: rpc reachability is NOT membership — the worker's
+            # dispatcher is up before it registers, and latching
+            # _registered_seen here would turn "still starting" into
+            # "silently aged out" at the next sweep (a spurious death
+            # per warm restart, phantom breaker counts). Only the
+            # supervisor's own membership observation (is_dead) sets it.
+            self._load = rsp.get("load")
+            if rsp.get("restart_ttft") is not None:
+                self.restart_ttft = rsp["restart_ttft"]
+            if rsp.get("cache") is not None:
+                self.cache_stats = rsp["cache"]
+            for req_id, state in (rsp.get("requests") or {}).items():
+                with self._lock:
+                    creq = self._tracked.get(req_id)
+                if creq is None:
+                    continue
+                if state is None:
+                    # the worker does not know this request (reply to
+                    # its submit was lost, or a restart raced us):
+                    # after a few confirmations, orphan it back to the
+                    # router for failover — never strand the handle
+                    misses[req_id] = misses.get(req_id, 0) + 1
+                    if misses[req_id] >= 3:
+                        misses.pop(req_id, None)
+                        self._untrack(creq)
+                        if self.on_orphan is not None:
+                            self.on_orphan(creq, self.replica_id)
+                    continue
+                misses.pop(req_id, None)
+                if state.get("done"):
+                    self._untrack(creq)
+                    creq._finish_remote(state.get("status"),
+                                        state.get("output_ids"),
+                                        state.get("error"))
+
+    def _untrack(self, creq):
+        with self._lock:
+            req_id = self._ids.pop(creq, None)
+            if req_id is not None:
+                self._tracked.pop(req_id, None)
+
+    # -- router-facing surface -----------------------------------------
+    def alive(self):
+        p = self._proc
+        return (not self._dead) and p is not None and p.poll() is None
+
+    def is_dead(self, registered):
+        """Death verdict: exited process (any exit code), a registered
+        replica whose stamp aged out (frozen heartbeats / SIGKILL), or
+        a spawn that never reached membership within ``spawn_grace``
+        (wedged startup)."""
+        p = self._proc
+        if p is None or self._dead or p.poll() is not None:
+            return True
+        if registered:
+            self._registered_seen = True
+            return False
+        if self._draining:
+            return False
+        if self._registered_seen:
+            return True         # was in membership, silently aged out
+        return (time.monotonic() - self._spawn_t) > self.spawn_grace
+
+    def ready(self):
+        return self.alive() and not self._draining and self._remote_ready
+
+    def load(self):
+        l = self._load
+        if not self.alive() or l is None:
+            return {"score": float("inf"), "live": 0, "backlog": 0,
+                    "kv_util": 1.0}
+        return l
+
+    def submit(self, creq):
+        from . import replica_worker as _rw
+
+        with self._lock:
+            if self._dead or self._draining or not self._remote_ready:
+                state = "dead" if self._dead else \
+                    "draining" if self._draining else "starting"
+                raise AdmissionError(
+                    f"replica {self.replica_id} not accepting ({state})",
+                    live=0, max_batch=0, free_pages=0, num_pages=0,
+                    retries=0)
+        spec = creq._attempt_spec(self.replica_id)
+        if spec is None:
+            return          # finished typed (cluster deadline) already
+        try:
+            req_id = self.endpoint.call_sync(
+                self.replica_id, _rw._worker_submit, (spec,),
+                timeout=self.submit_timeout)
+        except AdmissionError:
+            raise           # typed backpressure, fields intact (pickled)
+        except Exception as e:
+            # transport failure == not accepting: the router's cue to
+            # try a peer; liveness is the supervisor's job, not submit's
+            raise AdmissionError(
+                f"replica {self.replica_id} unreachable "
+                f"({type(e).__name__})", live=0, max_batch=0,
+                free_pages=0, num_pages=0, retries=0) from e
+        with self._lock:
+            self._tracked[req_id] = creq
+            self._ids[creq] = req_id
+
+    def cancel_attempt(self, creq):
+        from . import replica_worker as _rw
+
+        with self._lock:
+            req_id = self._ids.get(creq)
+        if req_id is None:
+            return
+        try:
+            self.endpoint.call_sync(self.replica_id, _rw._worker_cancel,
+                                    (req_id,), timeout=5.0)
+        except Exception:
+            pass            # dead replica: the monitor reaps it anyway
+
+    # -- lifecycle ------------------------------------------------------
+    def begin_drain(self):
+        from . import replica_worker as _rw
+
+        with self._lock:
+            self._draining = True
+        try:
+            self.endpoint.call_sync(self.replica_id,
+                                    _rw._worker_begin_drain, (),
+                                    timeout=5.0)
+        except Exception:
+            pass
+
+    def take_backlog(self):
+        """Pull queued-but-unadmitted requests back from the worker (the
+        router re-routes them before a drain)."""
+        from . import replica_worker as _rw
+
+        try:
+            ids = self.endpoint.call_sync(
+                self.replica_id, _rw._worker_take_backlog, (),
+                timeout=5.0)
+        except Exception:
+            return []
+        out = []
+        with self._lock:
+            for req_id in ids:
+                creq = self._tracked.pop(req_id, None)
+                if creq is not None:
+                    self._ids.pop(creq, None)
+                    if not creq.done:
+                        out.append(creq)
+        return out
+
+    def take_unfinished(self):
+        """Every tracked non-terminal request — the failover set after
+        this replica's process died."""
+        with self._lock:
+            out = [c for c in self._tracked.values() if not c.done]
+            self._tracked.clear()
+            self._ids.clear()
+        return out
+
+    def stop_worker(self, timeout=10.0):
+        """In-process replicas stop their worker thread here; for a
+        subprocess the worker loop is stopped by :meth:`drain` inside
+        the worker itself. A DEAD process is reaped and its poller
+        retired."""
+        if not self.alive():
+            self._retire_poller()
+
+    def drain(self, grace=30.0):
+        from . import replica_worker as _rw
+
+        try:
+            stats = self.endpoint.call_sync(
+                self.replica_id, _rw._worker_drain, (grace,),
+                timeout=grace + 30.0)
+        except Exception:
+            stats = {"seconds": 0.0, "completed": 0, "expired": 0}
+        # mirror the drained requests' terminal states NOW (the
+        # in-process drain ends with a synchronous _reap_completed):
+        # a restart right after this would kill the worker — and with
+        # it the results — before the 50ms poller's next pass
+        self._reap_tracked()
+        return stats
+
+    def _reap_tracked(self):
+        """One synchronous poll that adopts every tracked request's
+        terminal state — the subprocess analog of
+        :meth:`EngineReplica._reap_completed`."""
+        from . import replica_worker as _rw
+
+        with self._lock:
+            ids = list(self._tracked)
+        if not ids:
+            return
+        try:
+            rsp = self.endpoint.call_sync(
+                self.replica_id, _rw._worker_poll, (ids,), timeout=10.0)
+        except Exception:
+            return          # dead/unreachable: failover owns these
+        for req_id, state in (rsp.get("requests") or {}).items():
+            with self._lock:
+                creq = self._tracked.get(req_id)
+            if creq is None or state is None or not state.get("done"):
+                continue
+            self._untrack(creq)
+            creq._finish_remote(state.get("status"),
+                                state.get("output_ids"),
+                                state.get("error"))
+
+    def restart(self):
+        """Replace the process: clean-exit the old one if it is still
+        up, then spawn fresh. Requests whose terminal state was never
+        mirrored back (and are not yet done) are handed to
+        ``on_orphan`` for failover — a restart must never strand a
+        handle in limbo."""
+        self._request_exit(timeout=5.0)
+        self._retire_poller()
+        with self._lock:
+            leftovers = [c for c in self._tracked.values()
+                         if not c.done]
+            self._tracked.clear()
+            self._ids.clear()
+        for creq in leftovers:
+            if self.on_orphan is not None:
+                self.on_orphan(creq, self.replica_id)
+        return self.start()
+
+    def _request_exit(self, timeout=5.0):
+        from . import replica_worker as _rw
+
+        p = self._proc
+        if p is None:
+            return
+        if p.poll() is None:
+            for _ in range(2):      # a lost first ask is retried once
+                try:
+                    self.endpoint.call_sync(self.replica_id,
+                                            _rw._worker_exit, (),
+                                            timeout=timeout)
+                    break
+                except Exception:
+                    continue
+            try:
+                p.wait(timeout=timeout)
+            except Exception:
+                p.terminate()
+                try:
+                    p.wait(timeout=timeout)
+                except Exception:
+                    p.kill()
+                    p.wait()
+        self.exit_code = p.returncode
+
+    def kill(self):
+        """SIGKILL the worker process: no drain, no deregistration —
+        membership TTL (or the exit code) is what detects it."""
+        with self._lock:
+            self._dead = True
+        self._m_dead.inc()
+        p = self._proc
+        if p is not None and p.poll() is None:
+            p.kill()
+
+    def stop(self, timeout=10.0):
+        """Clean shutdown: the worker drains nothing but deregisters
+        from membership and exits 0."""
+        self._request_exit(timeout=timeout)
+        self._retire_poller()
+
+
+class _RestartState:
+    """Supervisor bookkeeping for ONE replica id: when it died, whether
+    its death has been processed, when the next (backed-off) restart is
+    due, and whether the crash-loop breaker holds it out."""
+
+    __slots__ = ("deaths", "down", "restart_at", "quarantined")
+
+    def __init__(self):
+        self.deaths = collections.deque(maxlen=64)  # monotonic stamps
+        self.down = False
+        self.restart_at = None
+        self.quarantined = False
+
+
 class ServingCluster:
-    """Routing frontend over N :class:`EngineReplica` instances.
+    """Routing frontend + supervisor over N replicas.
+
+    Replicas are in-process :class:`EngineReplica` threads (tests,
+    single-tenant embedding) or — with ``engine_spec`` — real
+    :class:`SubprocessReplica` processes: crash containment, exit-code
+    liveness, and warm restart via the persistent compile cache.
+
+    The supervisor (the monitor thread's sweep) restarts dead replicas
+    with exponential backoff + jitter, bounded by a crash-loop circuit
+    breaker: ``breaker_threshold`` deaths inside ``breaker_window``
+    seconds quarantine the replica (``cluster_replica_quarantined_
+    total``) — capacity shrinks and the tier sheds with typed
+    backpressure instead of burning a restart storm. A dead replica's
+    membership stamp is swept immediately so membership never shows a
+    ghost, and its unfinished requests fail over to its peers.
 
     Args:
         engine_factory: zero-arg callable building a fresh
-            :class:`LlamaServingEngine` (called per replica and per
-            restart/replacement).
+            :class:`LlamaServingEngine` (in-process replicas; ignored
+            when ``engine_spec`` is given).
         num_replicas: replica count at start().
         store_path: membership directory (a shared filesystem in a
             real deployment); default: a private temp dir.
@@ -538,23 +1101,59 @@ class ServingCluster:
         auto_replace: rebuild dead replicas automatically
             (kill-and-replace).
         failover_budget: default per-request failover budget.
+        engine_spec: JSON-able spec for subprocess replicas (see
+            :mod:`paddle_tpu.inference.replica_worker`); switches the
+            cluster to process-isolated mode.
+        restart_backoff / restart_backoff_max / restart_jitter:
+            supervisor restart delay: ``min(max, backoff * 2**(deaths
+            in window - 1)) * (1 + jitter*rand)``.
+        breaker_threshold / breaker_window: crash-loop circuit breaker
+            (N deaths in window seconds -> quarantine).
+        spawn_grace: seconds a subprocess may spend starting (imports +
+            compiles) before a missing membership stamp means "wedged".
+        subprocess_env: extra environment for worker processes (e.g.
+            ``PADDLE_TPU_COMPILE_CACHE_DIR`` so replicas share a warm
+            cache).
+        log_dir: per-worker stdout/stderr log files (default: discard).
     """
 
-    def __init__(self, engine_factory, num_replicas=2, store_path=None,
-                 ttl=2.0, monitor_interval=0.05, auto_replace=True,
-                 failover_budget=3, max_backlog=None, burst=None):
+    def __init__(self, engine_factory=None, num_replicas=2,
+                 store_path=None, ttl=2.0, monitor_interval=0.05,
+                 auto_replace=True, failover_budget=3, max_backlog=None,
+                 burst=None, engine_spec=None, subprocess_env=None,
+                 restart_backoff=0.1, restart_backoff_max=30.0,
+                 restart_jitter=0.25, breaker_threshold=5,
+                 breaker_window=30.0, spawn_grace=180.0,
+                 submit_timeout=15.0, log_dir=None, prewarm=True):
+        if engine_factory is None and engine_spec is None:
+            raise ValueError(
+                "ServingCluster needs engine_factory (in-process "
+                "replicas) or engine_spec (subprocess replicas)")
         self._factory = engine_factory
+        self._spec = engine_spec
         self.num_replicas = int(num_replicas)
         self.ttl = ttl
-        self.store = FileStore(
-            store_path or tempfile.mkdtemp(prefix="paddle_tpu_cluster_"),
-            ttl=ttl)
+        self._store_path = store_path \
+            or tempfile.mkdtemp(prefix="paddle_tpu_cluster_")
+        self.store = FileStore(self._store_path, ttl=ttl)
         self.monitor_interval = float(monitor_interval)
         self.auto_replace = auto_replace
         self.failover_budget = int(failover_budget)
         self.max_backlog = max_backlog
         self.burst = burst
-        self._replicas: dict[str, EngineReplica] = {}
+        self.subprocess_env = dict(subprocess_env or {})
+        self.restart_backoff = float(restart_backoff)
+        self.restart_backoff_max = float(restart_backoff_max)
+        self.restart_jitter = float(restart_jitter)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_window = float(breaker_window)
+        self.spawn_grace = float(spawn_grace)
+        self.submit_timeout = float(submit_timeout)
+        self.log_dir = log_dir
+        self.prewarm = prewarm
+        self._endpoint = None
+        self._replicas: dict[str, object] = {}
+        self._restarts: dict[str, _RestartState] = {}
         self._maintenance: set[str] = set()   # ids mid-rolling-restart
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -565,18 +1164,50 @@ class ServingCluster:
         self._started = False
 
     # ------------------------------------------------------------------
+    def _make_replica(self, rid):
+        if self._spec is not None:
+            return SubprocessReplica(
+                rid, self._spec, self._endpoint, self.store,
+                self._store_path, ttl=self.ttl,
+                max_backlog=self.max_backlog, burst=self.burst,
+                spawn_grace=self.spawn_grace,
+                submit_timeout=self.submit_timeout,
+                env=self.subprocess_env, on_orphan=self._orphaned,
+                prewarm=self.prewarm, log_dir=self.log_dir)
+        return EngineReplica(rid, self._factory, store=self.store,
+                             ttl=self.ttl, max_backlog=self.max_backlog,
+                             burst=self.burst)
+
+    def _restart_state(self, rid):
+        with self._lock:
+            st = self._restarts.get(rid)
+            if st is None:
+                st = self._restarts[rid] = _RestartState()
+            return st
+
     def start(self):
         with self._lock:
             if self._started:
                 return self
             self._started = True
+        if self._spec is not None and self._endpoint is None:
+            from ..distributed.rpc import RpcEndpoint
+
+            self._endpoint = RpcEndpoint("router", is_master=True,
+                                         port=0)
         for i in range(self.num_replicas):
             rid = f"replica-{i}"
-            rep = EngineReplica(rid, self._factory, store=self.store,
-                                ttl=self.ttl,
-                                max_backlog=self.max_backlog,
-                                burst=self.burst)
-            rep.start()
+            rep = self._make_replica(rid)
+            try:
+                rep.start()
+            except Exception:
+                # a failed first spawn is a death like any other: the
+                # same bookkeeping backs off, counts toward the
+                # breaker, and quarantines — the cluster comes up on
+                # the replicas that did start
+                st = self._restart_state(rid)
+                st.down = True
+                self._record_death(rid, st)
             self._replicas[rid] = rep
         self._elastic = ElasticManager(self.store, "router",
                                        self.num_replicas)
@@ -584,6 +1215,11 @@ class ServingCluster:
             target=self._monitor, daemon=True, name="cluster-monitor")
         self._monitor_thread.start()
         return self
+
+    def _orphaned(self, creq, rid):
+        """A subprocess replica forgot a tracked request (lost submit
+        reply, mid-restart race): fail it over like a death would."""
+        self._failover(creq, dead_rid=rid)
 
     def replicas(self):
         with self._lock:
@@ -659,12 +1295,12 @@ class ServingCluster:
 
     def cancel(self, creq):
         """Cancel a cluster request: the handle turns terminal and the
-        current engine attempt (if any) is cancelled on its replica."""
+        current attempt (if any) is cancelled on its replica — in
+        process directly, over rpc for a subprocess replica."""
         req = creq.cancel()
         rep = self._replicas.get(creq.replica_id)
-        if req is not None and rep is not None \
-                and rep.engine is not None:
-            rep.engine.cancel(req)
+        if req is not None and rep is not None:
+            rep.cancel_attempt(creq)
 
     # -- membership monitor --------------------------------------------
     def _monitor(self):
@@ -698,14 +1334,27 @@ class ServingCluster:
         if self._elastic is not None:
             self._elastic.watch_once()      # live-host gauge + events
         live_hosts = set(self.store.hosts())
+        now = time.monotonic()
         with self._lock:
             reps = [(rid, r) for rid, r in self._replicas.items()
                     if rid not in self._maintenance]
         ready = 0
         for rid, rep in reps:
-            dead = (not rep.alive()) or (rid not in live_hosts
-                                         and not rep._draining)
-            if dead:
+            st = self._restart_state(rid)
+            if st.quarantined:
+                continue        # held out by the breaker; capacity down
+            if st.down:
+                # death already processed — restart when the backoff
+                # delay is up (never block the sweep sleeping on it)
+                if self.auto_replace and st.restart_at is not None \
+                        and now >= st.restart_at \
+                        and self._claim(rid, rep):
+                    try:
+                        self._try_restart(rid, rep, st)
+                    finally:
+                        self._release_claim(rid)
+                continue
+            if rep.is_dead(rid in live_hosts):
                 # claim BEFORE touching the replica: rolling_restart
                 # may have started on it since the snapshot (its
                 # stop_worker looks like a death), and two rebuilders
@@ -713,23 +1362,88 @@ class ServingCluster:
                 if not self._claim(rid, rep):
                     continue
                 try:
-                    self._handle_death(rid, rep)
+                    self._handle_death(rid, rep, st)
                 finally:
                     self._release_claim(rid)
             elif rep.ready():
                 ready += 1
         self._m["ready"].set(ready)
+        with self._lock:
+            quarantined = sum(1 for s in self._restarts.values()
+                              if s.quarantined)
+        self._m["quarantined_now"].set(quarantined)
 
-    def _handle_death(self, rid, rep):
-        """Fail over a dead replica's requests; optionally rebuild it.
-        Caller holds the maintenance claim for ``rid``."""
+    def _backoff_delay(self, st, now):
+        """Restart delay from the deaths inside the breaker window:
+        exponential from ``restart_backoff``, capped, jittered so a
+        correlated mass failure does not respawn in lockstep."""
+        recent = sum(1 for t in st.deaths
+                     if now - t <= self.breaker_window)
+        delay = min(self.restart_backoff_max,
+                    self.restart_backoff * (2 ** max(0, recent - 1)))
+        return delay * (1.0 + self.restart_jitter * random.random())
+
+    def _record_death(self, rid, st):
+        """Append one death; trip the breaker when the window fills.
+        Returns True when the replica is now quarantined."""
+        now = time.monotonic()
+        st.deaths.append(now)
+        recent = sum(1 for t in st.deaths
+                     if now - t <= self.breaker_window)
+        if recent >= self.breaker_threshold:
+            st.quarantined = True
+            st.restart_at = None
+            self._m["quarantined"].inc()
+            return True
+        if self.auto_replace:
+            st.restart_at = now + self._backoff_delay(st, now)
+        return False
+
+    def _handle_death(self, rid, rep, st):
+        """Fail over a dead replica's requests and schedule its
+        (backed-off) rebuild. Caller holds the maintenance claim."""
         orphans = rep.take_unfinished()
         rep.stop_worker(timeout=1.0)
+        # ghost sweep: a confirmed-dead replica leaves membership NOW —
+        # the TTL detects silent death, it is not a grace period during
+        # which routing peers may still see the ghost
+        try:
+            self.store.deregister(rid)
+        except OSError:
+            pass
         for creq in orphans:
             self._failover(creq, dead_rid=rid)
-        if self.auto_replace:
+        st.down = True
+        self._record_death(rid, st)
+
+    def _try_restart(self, rid, rep, st):
+        """One backed-off restart attempt. A failed spawn (serve.spawn
+        fault, OS error) counts as another death — backoff grows, and
+        the breaker quarantines a crash loop."""
+        try:
             rep.restart()
-            self._m["replaced"].inc()
+        except Exception:
+            self._record_death(rid, st)
+            return
+        st.down = False
+        st.restart_at = None
+        self._m["replaced"].inc()
+
+    def quarantined(self):
+        """Replica ids currently held out by the circuit breaker."""
+        with self._lock:
+            return {rid for rid, st in self._restarts.items()
+                    if st.quarantined}
+
+    def rehabilitate(self, rid):
+        """Operator override: clear a quarantined replica's breaker
+        state and schedule an immediate restart attempt."""
+        st = self._restart_state(rid)
+        with self._lock:
+            st.quarantined = False
+            st.deaths.clear()
+            st.down = True
+            st.restart_at = time.monotonic()
 
     def _failover(self, creq, dead_rid):
         if creq.done:
@@ -762,8 +1476,8 @@ class ServingCluster:
         results = {}
         for rid in list(self.replicas()):
             rep = self._replicas.get(rid)
-            if rep is None:
-                continue
+            if rep is None or self._restart_state(rid).quarantined:
+                continue        # the breaker owns quarantined replicas
             # wait out a monitor-side rebuild of this replica (it ends
             # with a fresh engine anyway — but the restart must still
             # cycle it deliberately, so claim rather than skip)
@@ -788,6 +1502,19 @@ class ServingCluster:
                     rep.stop_worker()
                     stats = rep.drain(grace)
                     rep.restart()
+                    st = self._restart_state(rid)
+                    st.down = False     # a deliberate cycle is not a
+                    st.restart_at = None    # death the supervisor owns
+                    # hold the next cycle until THIS replacement can
+                    # take routes again — an in-process restart is
+                    # ready immediately, but a subprocess replacement
+                    # pays import + (cached) compile first, and cycling
+                    # on without it would walk the tier down to zero
+                    # routable capacity
+                    t_up = time.monotonic()
+                    while not rep.ready() \
+                            and time.monotonic() - t_up < grace:
+                        time.sleep(0.05)
                     results[rid] = stats
                     self._m["restarts"].inc()
             finally:
@@ -815,12 +1542,16 @@ class ServingCluster:
         return stats
 
     def stop(self):
-        """Stop monitor + replicas (graceful; engines closed)."""
+        """Stop monitor + replicas (graceful; engines closed / worker
+        processes clean-exited) and the rpc endpoint."""
         self._stop.set()
         if self._monitor_thread is not None:
             self._monitor_thread.join(timeout=5.0)
         for rep in self.replicas().values():
             rep.stop()
+        if self._endpoint is not None:
+            self._endpoint.stop()
+            self._endpoint = None
 
     def stats(self):
         out = {}

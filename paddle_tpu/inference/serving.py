@@ -84,6 +84,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import hashlib
 import math
 import os
 import signal as _signal
@@ -139,6 +140,16 @@ class AdmissionError(MemoryError):
         self.retries = retries
         self.retry_after = retry_after
 
+    def __reduce__(self):
+        # default exception pickling replays type(self)(*args) with
+        # args=(formatted msg,) — a TypeError at unpickle time, which
+        # would turn a typed shed (retry_after and all) into an opaque
+        # rpc failure on the error-reply round trip; rebuild from the
+        # typed fields instead (mirrors RpcTimeoutError.__reduce__)
+        return (type(self), (self.reason, self.live, self.max_batch,
+                             self.free_pages, self.num_pages,
+                             self.retries, self.retry_after))
+
 
 class DeadlineExceeded(TimeoutError):
     """Typed terminal result of a request that ran out of wall-clock
@@ -154,6 +165,14 @@ class DeadlineExceeded(TimeoutError):
         self.elapsed = elapsed
         self.tokens_emitted = tokens_emitted
         self.reason = reason
+
+    def __reduce__(self):
+        # keep the carried fields (seq_id, tokens_emitted, ...) across a
+        # pickle round trip — a subprocess replica reports deadline
+        # expiry through the rpc error reply
+        return (type(self), (self.args[0] if self.args else "",
+                             self.seq_id, self.elapsed,
+                             self.tokens_emitted, self.reason))
 
 #: latency buckets tuned for serving (TTFT / per-token): 1ms .. 10s
 _LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -356,7 +375,7 @@ class LlamaServingEngine:
                  max_pages_per_seq=None, burst=None, admit_retries=0,
                  admit_backoff=0.005, stuck_factor=8.0,
                  stuck_min_timeout=30.0, prefix_cache=True,
-                 prefix_cache_pages=None):
+                 prefix_cache_pages=None, prewarm=None):
         if num_pages is None:
             num_pages = max_batch * 24 + 8
         self.model = model
@@ -434,6 +453,24 @@ class LlamaServingEngine:
             collections.deque(maxlen=512)
         self._wd = None
         self._closed = False
+        # -- warm restart (ROADMAP item 5) -----------------------------
+        # persistent XLA compile cache on by default (kill switch:
+        # PADDLE_TPU_COMPILE_CACHE=0): a restarted replica re-compiling
+        # the same serving programs gets executables from disk in
+        # seconds instead of ~19 s of backend compile. The shape
+        # registry records which programs THIS engine geometry actually
+        # dispatches (prefill buckets, decode, burst lengths) so the
+        # next process can pre-warm them before traffic arrives.
+        self._cache_dir = _cw.enable_persistent_cache()
+        self._recorded_shapes: set = set()
+        self._shape_key = self._compute_shape_key()
+        self.prewarmed = None         # prewarm() summary, or None
+        if prewarm is None:
+            prewarm = os.environ.get(
+                "PADDLE_TPU_SERVING_PREWARM", "0").lower() \
+                in ("1", "true", "on", "auto")
+        if prewarm:
+            self.prewarm()
 
     def __state_tensors__(self):
         """State-discovery override for ``to_static``: the KV pools are
@@ -696,16 +733,6 @@ class LlamaServingEngine:
             page_ids[i, :n] = rp
             offs[i, :n] = ro
             last_pos[i] = n - 1
-        if self._prefill_static is None:
-            from ..jit import StaticFunction
-
-            # no lazy state (params exist, no optimizer): skip the eager
-            # warmup and compile directly; donate pools for in-place
-            # page writes
-            self._prefill_static = StaticFunction(
-                self._prefill_forward, state=[self.model], warmup="once",
-                donate_inputs=True, name="serving.prefill")
-            self._prefill_static._warmed_any = True
         if self._m["ttft"] is not _om.NULL \
                 and bucket not in self._prefill_warm_buckets:
             # compile this bucket's program OUTSIDE the TTFT window: a
@@ -716,16 +743,7 @@ class LlamaServingEngine:
             # compile skewing the histogram's +Inf bucket forever. Under
             # PADDLE_TPU_METRICS=0 this is skipped (zero-cost mandate).
             t_w = time.perf_counter()
-            with no_grad():
-                _, wk, wv = self._prefill_static(
-                    Tensor(jnp.asarray(np.zeros((b, bucket), np.int64))),
-                    Tensor(jnp.asarray(np.zeros((b,), np.int32))),
-                    Tensor(jnp.asarray(np.full((b, bucket),
-                                               self.trash_page,
-                                               np.int32))),
-                    Tensor(jnp.asarray(np.zeros((b, bucket), np.int32))),
-                    self.k_pools, self.v_pools)
-            self.k_pools, self.v_pools = list(wk), list(wv)
+            self._warm_prefill_bucket(bucket)
             warm_dur = time.perf_counter() - t_w
             for r in reqs:
                 if r._t_admit is not None:
@@ -734,7 +752,17 @@ class LlamaServingEngine:
                     # the deadline clock starts at admission; compile
                     # warmup is engine overhead, not request time
                     r._expires_at += warm_dur
-            self._prefill_warm_buckets.add(bucket)
+        elif self._prefill_static is None:
+            from ..jit import StaticFunction
+
+            # no lazy state (params exist, no optimizer): skip the eager
+            # warmup and compile directly; donate pools for in-place
+            # page writes
+            self._prefill_static = StaticFunction(
+                self._prefill_forward, state=[self.model], warmup="once",
+                donate_inputs=True, name="serving.prefill")
+            self._prefill_static._warmed_any = True
+        self._record_shape("prefill", bucket)
         with self._lock:
             self._in_dispatch = True
         try:
@@ -799,13 +827,7 @@ class LlamaServingEngine:
             # credit the compile time back to the wave's clocks —
             # mirrors the cold prefill bucket warmup
             t_w = time.perf_counter()
-            step = self._ensure_decode_compiled()
-            with no_grad():
-                step(Tensor(jnp.asarray(np.zeros((b, 1), np.int64))),
-                     Tensor(jnp.asarray(np.full(
-                         (b, self.width), self.trash_page, np.int32))),
-                     Tensor(jnp.asarray(np.ones((b,), np.int32))),
-                     self.k_pools, self.v_pools)
+            self._warm_decode()
             warm_dur = time.perf_counter() - t_w
             for r in reqs:
                 if r._t_admit is not None:
@@ -955,6 +977,138 @@ class LlamaServingEngine:
         if self._wd is not None:
             self._wd.stop()
             self._wd = None
+
+    # ------------------------------------------------------------------
+    # warm restart: shape registry + prewarm (ROADMAP item 5)
+    # ------------------------------------------------------------------
+    def _compute_shape_key(self):
+        """Stable identity of this engine's compile surface: every
+        dimension that shapes a serving program (model dims + batch
+        geometry + pool layout + dtype). Two engines with the same key
+        compile byte-identical programs, so one's recorded shape buckets
+        are the other's valid warm-up recipe."""
+        cfg = self.model.config
+        dt = str(self.model.parameters()[0].dtype)
+        parts = (cfg.vocab_size, cfg.hidden_size, cfg.intermediate_size,
+                 cfg.num_hidden_layers, cfg.num_attention_heads,
+                 cfg.num_key_value_heads, cfg.head_dim,
+                 float(cfg.rope_theta), self.max_batch, self.page_size,
+                 self.width, len(self.k_pools) and
+                 tuple(self.k_pools[0]._data.shape), dt)
+        return "llama:" + hashlib.sha1(
+            repr(parts).encode()).hexdigest()[:16]
+
+    def _record_shape(self, kind, value):
+        """Record one dispatched shape bucket in the persistent
+        signature registry (one file write per distinct value per
+        process; a no-op when the compile cache is disabled — without
+        the cache a prewarm would re-PAY every compile, not skip it)."""
+        if self._cache_dir is None:
+            return
+        k = (kind, value)
+        if k in self._recorded_shapes:
+            return
+        self._recorded_shapes.add(k)
+        try:
+            _cw.shape_registry().record(self._shape_key, kind, value)
+        except Exception:
+            pass            # registry IO must never fail a dispatch
+
+    def _warm_prefill_bucket(self, bucket):
+        """Compile the [max_batch, bucket] prefill program via a dummy
+        dispatch: every page write lands in the trash page and the
+        emitted tokens are discarded, so no request state is touched.
+        The prefill program donates its pool inputs — the returned
+        pools must replace ours."""
+        b = self.max_batch
+        if self._prefill_static is None:
+            from ..jit import StaticFunction
+
+            # no lazy state (params exist, no optimizer): skip the eager
+            # warmup and compile directly; donate pools for in-place
+            # page writes
+            self._prefill_static = StaticFunction(
+                self._prefill_forward, state=[self.model], warmup="once",
+                donate_inputs=True, name="serving.prefill")
+            self._prefill_static._warmed_any = True
+        with no_grad():
+            _, wk, wv = self._prefill_static(
+                Tensor(jnp.asarray(np.zeros((b, bucket), np.int64))),
+                Tensor(jnp.asarray(np.zeros((b,), np.int32))),
+                Tensor(jnp.asarray(np.full((b, bucket),
+                                           self.trash_page, np.int32))),
+                Tensor(jnp.asarray(np.zeros((b, bucket), np.int32))),
+                self.k_pools, self.v_pools)
+        self.k_pools, self.v_pools = list(wk), list(wv)
+        self._prefill_warm_buckets.add(bucket)
+        self._record_shape("prefill", bucket)
+
+    def _warm_decode(self):
+        """Compile the decode-step program via a dummy dispatch (trash
+        page writes, outputs discarded — decode does not donate)."""
+        b = self.max_batch
+        step = self._ensure_decode_compiled()
+        with no_grad():
+            step(Tensor(jnp.asarray(np.zeros((b, 1), np.int64))),
+                 Tensor(jnp.asarray(np.full(
+                     (b, self.width), self.trash_page, np.int32))),
+                 Tensor(jnp.asarray(np.ones((b,), np.int32))),
+                 self.k_pools, self.v_pools)
+
+    def _warm_burst(self, n):
+        """Compile the n-step burst program via a dummy dispatch. The
+        burst donates its pool inputs — reassign from the outputs."""
+        b = self.max_batch
+        sf = self._ensure_burst_compiled(n)
+        with no_grad():
+            out = sf(Tensor(jnp.asarray(np.zeros((b, 1), np.int64))),
+                     Tensor(jnp.asarray(np.full(
+                         (b, self.width), self.trash_page, np.int32))),
+                     Tensor(jnp.asarray(np.ones((b,), np.int32))),
+                     self.k_pools, self.v_pools)
+        n_layers = len(self.k_pools)
+        self.k_pools = list(out[1:1 + n_layers])
+        self.v_pools = list(out[1 + n_layers:])
+
+    def prewarm(self, prefill_buckets=None, bursts=None, decode=None):
+        """Compile this engine's serving programs BEFORE traffic
+        arrives, so a replacement replica's first request pays
+        milliseconds, not the full compile bill. With no arguments the
+        recipe comes from the persistent shape registry — the prefill
+        buckets, burst lengths and decode program a previous engine of
+        identical geometry actually dispatched (recorded as they
+        compiled). Combined with the persistent compilation cache these
+        compiles are disk hits on a warm host (``compile_cache_hit_
+        total``), which is what turns an ~19 s restart into seconds.
+
+        Returns ``{"prefill": [...], "burst": [...], "decode": bool}``
+        — what was warmed (also kept on ``self.prewarmed``)."""
+        if prefill_buckets is None and bursts is None and decode is None:
+            recipe = {}
+            try:
+                recipe = _cw.shape_registry().lookup(self._shape_key) \
+                    if self._cache_dir is not None else {}
+            except Exception:
+                recipe = {}
+            prefill_buckets = recipe.get("prefill", ())
+            bursts = recipe.get("burst", ())
+            decode = bool(recipe.get("decode"))
+        done = {"prefill": [], "burst": [], "decode": False}
+        with self._dispatch_lock, _CROSS_ENGINE_LOCK, \
+                _span("serving.prewarm",
+                      prefill=len(prefill_buckets or ()),
+                      burst=len(bursts or ())):
+            for bucket in sorted(set(prefill_buckets or ())):
+                self._warm_prefill_bucket(int(bucket))
+                done["prefill"].append(int(bucket))
+            if decode:
+                self._warm_decode()
+                done["decode"] = True
+            for n in sorted(set(bursts or ())):
+                self._warm_burst(int(n))
+                done["burst"].append(int(n))
+        self.prewarmed = done
+        return done
 
     # ------------------------------------------------------------------
     # scheduling
@@ -1336,6 +1490,7 @@ class LlamaServingEngine:
             self._decode_static = jit.to_static(
                 self._decode_step, state=[self.model], warmup="once",
                 name="serving.decode_step")
+            self._record_shape("decode", True)
         return self._decode_static
 
     @_fatal_guard("serving.step")
@@ -1468,6 +1623,7 @@ class LlamaServingEngine:
             # would cost more than the compile it avoids
             sf._warmed_any = True
             self._burst_static[n] = sf
+            self._record_shape("burst", n)
         return sf
 
     @_fatal_guard("serving.burst")

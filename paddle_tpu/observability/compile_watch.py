@@ -36,6 +36,7 @@ dispatch stays byte-identical to the unwatched ``jax.jit`` fast path.
 from __future__ import annotations
 
 import itertools
+import json
 import os
 import sys
 import threading
@@ -51,6 +52,8 @@ __all__ = [
     "CompileWatch", "NULL_WATCH", "watch", "watched_jit", "describe_args",
     "sample_device_memory", "recent_compile_events", "reset",
     "COMPILE_BUCKETS", "DEFAULT_STORM_THRESHOLD",
+    "enable_persistent_cache", "persistent_cache_stats",
+    "SignatureRegistry", "shape_registry",
 ]
 
 #: compile-duration buckets: 10ms (tiny CPU programs) .. 300s (big TPU
@@ -121,11 +124,12 @@ def reset():
 
 
 def _ensure_listener():
-    """Register the process-wide ``jax.monitoring`` listener once: every
+    """Register the process-wide ``jax.monitoring`` listeners once: every
     XLA backend compile — watched or not — lands in the global tally and
-    the flight-recorder ring. A registration failure (a jax build
-    without the API) degrades to per-callable counting only — it must
-    never crash the user's first compiled step."""
+    the flight-recorder ring, and persistent-compilation-cache hit/miss
+    events land in the warm-restart counters. A registration failure (a
+    jax build without the API) degrades to per-callable counting only —
+    it must never crash the user's first compiled step."""
     global _listener_installed
     with _lock:
         if _listener_installed:
@@ -135,6 +139,7 @@ def _ensure_listener():
         import jax.monitoring
 
         jax.monitoring.register_event_duration_secs_listener(_on_jax_event)
+        jax.monitoring.register_event_listener(_on_jax_count_event)
     except Exception:
         pass
 
@@ -153,6 +158,180 @@ def _on_jax_event(name, duration, **kwargs):
         "ts": (time.perf_counter() - _EPOCH) * 1e6 - duration * 1e6,
         "dur": duration * 1e6,
     })
+
+
+#: raw persistent-cache tallies — kept as plain ints alongside the
+#: metric counters so a replica worker can report its warm-start hit
+#: rate over rpc even under ``PADDLE_TPU_METRICS=0``
+_cache_counts = {"hits": 0, "misses": 0}
+
+
+def _on_jax_count_event(name, **kwargs):
+    """Count-event listener: the persistent compilation cache announces
+    ``/jax/compilation_cache/cache_hits`` / ``.../cache_misses`` per
+    lookup — the signal that says whether a restarted replica's compiles
+    were served from disk (seconds) or paid in full (~19 s on a real
+    chip)."""
+    if "/jax/compilation_cache/cache_hit" in name:
+        _cache_counts["hits"] += 1
+        if enabled():
+            om.counter("compile_cache_hit_total",
+                       "XLA programs served from the persistent "
+                       "compilation cache").inc()
+    elif "/jax/compilation_cache/cache_miss" in name:
+        _cache_counts["misses"] += 1
+        if enabled():
+            om.counter("compile_cache_miss_total",
+                       "XLA programs compiled from scratch (persistent "
+                       "cache lookup missed)").inc()
+
+
+def persistent_cache_stats():
+    """``{"hits", "misses", "dir"}`` for this process — independent of
+    the metrics kill switch so workers can report warm-start health."""
+    return {"hits": _cache_counts["hits"],
+            "misses": _cache_counts["misses"],
+            "dir": _cache_dir}
+
+
+_cache_dir = None
+_cache_lock = threading.Lock()
+
+
+def default_cache_dir():
+    """Default persistent-cache location: ``PADDLE_TPU_COMPILE_CACHE_DIR``
+    or ``~/.cache/paddle_tpu/xla_cache``."""
+    return os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR") \
+        or os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "xla_cache")
+
+
+def enable_persistent_cache(path=None):
+    """Wire JAX's persistent compilation cache (ROADMAP item 5: kill the
+    ~19 s cold start). Every backend compile is keyed by its HLO and
+    stored under ``path``; a fresh process re-compiling the same serving
+    programs (prefill buckets, decode, bursts) gets executables back in
+    seconds. Called once per process by the serving engine — set
+    ``PADDLE_TPU_COMPILE_CACHE=0`` to opt out, or
+    ``PADDLE_TPU_COMPILE_CACHE_DIR`` to relocate (replicas sharing a
+    host should share the directory). ``min_compile_time_secs`` is
+    forced to 0 so even small programs cache — elastic restart is about
+    the SUM of compiles, not the largest one.
+
+    Returns the cache directory, or None when disabled/unavailable.
+    Idempotent; hit/miss land in ``compile_cache_hit_total`` /
+    ``compile_cache_miss_total`` and :func:`persistent_cache_stats`."""
+    global _cache_dir
+    if os.environ.get("PADDLE_TPU_COMPILE_CACHE", "1").lower() \
+            in ("0", "off", "false"):
+        return None
+    with _cache_lock:
+        if _cache_dir is not None:
+            return _cache_dir
+        cache = path or default_cache_dir()
+        try:
+            import jax
+
+            os.makedirs(cache, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", -1)
+            except Exception:
+                pass        # older jax: size gate stays at its default
+            try:
+                # the backend usually initializes during framework
+                # import, BEFORE this config lands — jax then latches
+                # "no cache" at its first compile and silently ignores
+                # the directory forever; reset re-arms the lazy init so
+                # the next compile picks the configured dir up
+                from jax._src import compilation_cache as _jcc
+
+                _jcc.reset_cache()
+            except Exception:
+                pass
+        except Exception:
+            return None     # unwritable dir / jax without the config
+        _cache_dir = cache
+    _ensure_listener()
+    return _cache_dir
+
+
+class SignatureRegistry:
+    """Durable record of the shape signatures a named callable compiled
+    — the compile watcher's in-memory ``_sigs``, persisted so the NEXT
+    process knows what to pre-warm before traffic arrives.
+
+    The file is JSON ``{key: {kind: [values]}}`` where ``key`` names one
+    compile surface (the serving engine hashes its model dims + batch
+    geometry into it) and each ``kind`` collects the distinct values
+    seen (prefill bucket lengths, burst sizes, ...). Writes are
+    read-merge-replace with a write-aside temp file, mirroring the
+    FileStore stamp protocol, so concurrent replicas on one host can
+    record without tearing the file (a lost race drops one record until
+    its next compile re-records it — never corruption)."""
+
+    def __init__(self, path):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def _load(self):
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def record(self, key, kind, value):
+        """Merge one (key, kind, value) into the registry. Returns True
+        when the value was new for that key/kind."""
+        with self._lock:
+            doc = self._load()
+            kinds = doc.setdefault(str(key), {})
+            vals = kinds.setdefault(str(kind), [])
+            if value in vals:
+                return False
+            vals.append(value)
+            vals.sort()
+            tmp = f"{self.path}.{os.getpid()}.tmp"
+            try:
+                os.makedirs(os.path.dirname(self.path) or ".",
+                            exist_ok=True)
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, indent=0, sort_keys=True)
+                os.replace(tmp, self.path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return False
+            return True
+
+    def lookup(self, key):
+        """``{kind: [values]}`` recorded for ``key`` (empty when none)."""
+        with self._lock:
+            return self._load().get(str(key), {})
+
+
+_shape_registry = None
+
+
+def shape_registry():
+    """The process-default :class:`SignatureRegistry`
+    (``PADDLE_TPU_SHAPE_REGISTRY`` or ``<cache_dir>/serving_shapes.json``
+    next to the persistent compile cache, so replicas sharing the cache
+    share the warm-up recipe)."""
+    global _shape_registry
+    with _cache_lock:
+        if _shape_registry is None:
+            path = os.environ.get("PADDLE_TPU_SHAPE_REGISTRY") \
+                or os.path.join(default_cache_dir(), "serving_shapes.json")
+            _shape_registry = SignatureRegistry(path)
+        return _shape_registry
 
 
 def _in_outer_trace():

@@ -32,7 +32,17 @@ Rule fields (all optional except ``point`` and ``action``):
   ``raise``/``hang`` rule kills replica N at tick K and the router's
   membership TTL + failover path runs deterministically in CI;
   ``router.route`` — fired per routing decision with ``step`` = the
-  route ordinal, so a ``raise`` rule injects routing errors).
+  route ordinal, so a ``raise`` rule injects routing errors;
+  ``serve.spawn`` — fired in the SUPERVISOR before each replica
+  spawn/restart attempt with ``path`` = the replica id and ``step`` =
+  the spawn ordinal, so a ``raise`` rule deterministically fails
+  process spawn — the supervisor's exponential backoff and crash-loop
+  circuit breaker run without a single real process; ``replica
+  .heartbeat`` — fired on the replica's heartbeat sidecar before each
+  stamp refresh with ``path`` = the replica id and ``step`` = the beat
+  ordinal, so a ``hang``/``sleep`` rule freezes heartbeats and the
+  replica silently ages out of membership, driving TTL death detection
+  and, repeated, the circuit breaker).
 - ``action``: one of ``crash`` (``os._exit``), ``sigkill``, ``sigterm``
   (signal self), ``hang`` (sleep ~forever), ``sleep`` (slow-down, then
   continue), ``raise`` (``OSError`` by default; see ``exc``),

@@ -32,7 +32,14 @@ def _seed():
 #    suite's global timeout. faulthandler dumps every thread's stack
 #    after the per-test budget and exits, so CI sees where it hung. ----
 _WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
-                        "test_cluster", "test_prefix_cache"}
+                        "test_cluster", "test_prefix_cache",
+                        "test_subprocess_cluster"}
+
+# per-module budgets where the default is wrong: subprocess-cluster
+# tests legitimately wait out several worker-process startups (import +
+# model build + compile each) inside ONE test, so their wedge budget is
+# sized to the e2e's worst case, not the in-process default
+_WEDGE_BUDGETS = {"test_subprocess_cluster": 700.0}
 
 
 @pytest.fixture(autouse=True)
@@ -44,7 +51,9 @@ def _serving_wedge_guard(request):
     import faulthandler
     # default must exceed the largest legitimate per-test wait (the
     # SIGTERM subprocess test budgets up to ~301s of compile tolerance)
-    budget = float(os.environ.get("PADDLE_TPU_TEST_WEDGE_TIMEOUT", "480"))
+    env_budget = os.environ.get("PADDLE_TPU_TEST_WEDGE_TIMEOUT")
+    budget = float(env_budget) if env_budget \
+        else _WEDGE_BUDGETS.get(mod, 480.0)
     faulthandler.dump_traceback_later(budget, exit=True)
     try:
         yield

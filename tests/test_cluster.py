@@ -1,11 +1,14 @@
 """Multi-replica serving tier: routing, membership, failover, rolling
-restart, readiness — and the acceptance e2e (3 replicas under load
-survive a kill-and-replace and a full rolling restart with zero
-dropped requests).
+restart, readiness, the supervisor's backoff + crash-loop circuit
+breaker — and the acceptance e2e (3 replicas under load survive a
+kill-and-replace and a full rolling restart with zero dropped
+requests). Subprocess-replica coverage lives in
+``test_subprocess_cluster.py``.
 """
 
 import json
 import os
+import pickle
 import time
 import urllib.request
 
@@ -14,6 +17,7 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.inference.cluster import (ClusterRequest, EngineReplica,
+                                          ReplicaLostError,
                                           ServingCluster)
 from paddle_tpu.inference.serving import (AdmissionError,
                                           DeadlineExceeded,
@@ -259,6 +263,180 @@ class TestReadyz:
         finally:
             srv.stop()
             cluster.stop()
+
+
+# ---------------------------------------------------------------------
+# typed errors survive a pickle round trip (the rpc error-reply path)
+# ---------------------------------------------------------------------
+class TestPicklableErrors:
+    """Every typed cluster error must cross the subprocess rpc
+    error-reply boundary with type, message, and carried fields intact
+    — mirroring PR 4's RpcTimeoutError.__reduce__ fix."""
+
+    def test_admission_error_round_trip(self):
+        e = AdmissionError("KV page pool exhausted", live=3, max_batch=4,
+                           free_pages=1, num_pages=32, retries=2,
+                           retry_after=0.125)
+        e2 = pickle.loads(pickle.dumps(e))
+        assert type(e2) is AdmissionError
+        assert str(e2) == str(e)
+        assert e2.reason == "KV page pool exhausted"
+        assert (e2.live, e2.max_batch, e2.free_pages, e2.num_pages,
+                e2.retries, e2.retry_after) == (3, 4, 1, 32, 2, 0.125)
+        # still a MemoryError for legacy catchers, on both sides
+        assert isinstance(e2, MemoryError)
+
+    def test_admission_error_without_retry_after(self):
+        e = AdmissionError("draining", live=0, max_batch=4,
+                           free_pages=8, num_pages=32, retries=0)
+        e2 = pickle.loads(pickle.dumps(e))
+        assert e2.retry_after is None and str(e2) == str(e)
+
+    def test_deadline_exceeded_round_trip(self):
+        d = DeadlineExceeded("request 5 exceeded its drain grace",
+                             seq_id=5, elapsed=1.25, tokens_emitted=7,
+                             reason="drain grace window")
+        d2 = pickle.loads(pickle.dumps(d))
+        assert type(d2) is DeadlineExceeded
+        assert str(d2) == str(d)
+        assert (d2.seq_id, d2.elapsed, d2.tokens_emitted, d2.reason) \
+            == (5, 1.25, 7, "drain grace window")
+        assert isinstance(d2, TimeoutError)
+
+    def test_replica_lost_round_trip(self):
+        e = ReplicaLostError("replica replica-2 died", "replica-2",
+                             failovers=4)
+        e2 = pickle.loads(pickle.dumps(e))
+        assert type(e2) is ReplicaLostError and str(e2) == str(e)
+        assert e2.replica_id == "replica-2" and e2.failovers == 4
+
+    def test_degradation_statuses_ride_the_request(self):
+        """The ladder's terminal statuses travel as plain strings plus
+        the typed error object — both pickle; a poll reply carries
+        exactly this pair."""
+        e = AdmissionError("evicted under pressure; retry budget "
+                           "exhausted", 2, 2, 0, 16, 0)
+        state = {"status": "evicted", "error": e, "output_ids": [1, 2]}
+        s2 = pickle.loads(pickle.dumps(state))
+        assert s2["status"] == "evicted"
+        assert isinstance(s2["error"], AdmissionError)
+
+
+# ---------------------------------------------------------------------
+# supervisor: backoff, crash-loop circuit breaker, ghost sweep
+# ---------------------------------------------------------------------
+class TestSupervisor:
+    def test_spawn_fault_crash_loop_trips_breaker(self, model, tmp_path):
+        """A replica whose every (re)start fails at serve.spawn is
+        quarantined by the circuit breaker after N attempts instead of
+        restart-looping; the metric fires and the surviving replica
+        keeps serving with typed backpressure — no storm, no lost
+        requests."""
+        from paddle_tpu.observability import metrics as om
+
+        q0 = om.counter("cluster_replica_quarantined_total").value \
+            if om.enabled() else 0
+        os.environ[faults.PLAN_ENV] = json.dumps(
+            [{"point": "serve.spawn", "action": "raise",
+              "exc": "OSError", "path": "replica-0"}])
+        faults.reset()
+        cluster = ServingCluster(
+            _factory(model), num_replicas=2,
+            store_path=str(tmp_path / "m"), ttl=30.0,
+            monitor_interval=0.02, restart_backoff=0.01,
+            restart_backoff_max=0.05, breaker_threshold=3,
+            breaker_window=30.0).start()
+        try:
+            deadline = time.time() + 30
+            while "replica-0" not in cluster.quarantined() \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert cluster.quarantined() == {"replica-0"}
+            if om.enabled():
+                assert om.counter(
+                    "cluster_replica_quarantined_total").value > q0
+            # no restart storm: spawn attempts stop once quarantined
+            rep = cluster.replicas()["replica-0"]
+            spawns = rep._spawns
+            time.sleep(0.3)
+            assert rep._spawns == spawns
+            # the surviving replica still serves, token-exact
+            os.environ.pop(faults.PLAN_ENV)
+            faults.reset()
+            c = cluster.submit([1, 2, 3], max_new_tokens=2)
+            assert c.result(timeout=240) \
+                == _reference_continuation(model, [1, 2, 3], 2)
+            assert c.replica_id == "replica-1"
+            # rehabilitation clears the breaker and restarts it
+            cluster.rehabilitate("replica-0")
+            deadline = time.time() + 30
+            while not cluster.replicas()["replica-0"].ready() \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert cluster.replicas()["replica-0"].ready()
+        finally:
+            cluster.stop()
+
+    def test_frozen_heartbeat_death_sweeps_ghost_stamp(self, model,
+                                                       tmp_path):
+        """A replica.heartbeat hang freezes the sidecar; the replica
+        ages out of membership (TTL), the supervisor fails it over AND
+        deregisters its stamp immediately — membership never shows the
+        ghost while the replacement spins up."""
+        os.environ[faults.PLAN_ENV] = json.dumps(
+            [{"point": "replica.heartbeat", "action": "hang",
+              "seconds": 2.0, "path": "replica-0", "count": 1}])
+        faults.reset()
+        cluster = ServingCluster(
+            _factory(model), num_replicas=2,
+            store_path=str(tmp_path / "m"), ttl=0.4,
+            monitor_interval=0.02, restart_backoff=0.01).start()
+        try:
+            # the sidecar freezes on its first beat; the stamp ages out
+            deadline = time.time() + 20
+            while "replica-0" in cluster.store.hosts() \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert "replica-0" not in cluster.store.hosts()
+            # ghost swept: the stamp FILE is gone (deregistered), not
+            # merely TTL-hidden — a reader without the ttl sees truth
+            deadline = time.time() + 20
+            store_dir = str(tmp_path / "m")
+            while os.path.exists(os.path.join(store_dir, "replica-0")) \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert not os.path.exists(
+                os.path.join(store_dir, "replica-0"))
+            # ... and the replica is rebuilt and re-registers
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                rep = cluster.replicas()["replica-0"]
+                if rep.ready() and "replica-0" in cluster.store.hosts():
+                    break
+                time.sleep(0.05)
+            assert cluster.replicas()["replica-0"].ready()
+        finally:
+            cluster.stop()
+
+    def test_stopped_incarnation_never_stamps_again(self, model,
+                                                    tmp_path):
+        """The shutdown fix: stop_worker() joins the heartbeat sidecar
+        too, so a stopped incarnation can't keep a dead replica fresh
+        in membership (the ghost a TTL can never age out)."""
+        from paddle_tpu.distributed.watchdog import FileStore
+
+        store = FileStore(str(tmp_path / "m"), ttl=0.4)
+        rep = EngineReplica("g1", _factory(model), store=store, ttl=0.4)
+        rep.start()
+        assert "g1" in store.hosts()
+        rep.stop_worker()
+        assert rep._hb_thread is None or not rep._hb_thread.is_alive()
+        # with no sidecar alive the stamp must age out within the TTL
+        deadline = time.time() + 10
+        while "g1" in store.hosts() and time.time() < deadline:
+            time.sleep(0.05)
+        assert "g1" not in store.hosts()
+        rep.engine.close()
 
 
 # ---------------------------------------------------------------------
