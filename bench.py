@@ -257,43 +257,108 @@ def bench_paged(batch=8, heads=16, kv_heads=8, dim=128, page=64,
     }
 
 
+def bench_ragged(rows=8, qb=16, heads=16, kv_heads=8, dim=128, page=64,
+                 ctx=2048, iters=50):
+    """Ragged paged-attention kernel (mixed prefill chunks + decode
+    rows, ONE dispatch) vs the XLA gather reference, on device — the
+    `paged_parity_ok`-style gate for the chunked serving engine's
+    kernel."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import ragged_paged_attention as RPA
+
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    max_pages = ctx // page
+    num_pages = rows * max_pages + 8
+    q = jnp.asarray(rng.randn(rows, qb, heads, dim), dt)
+    kp = jnp.asarray(rng.randn(num_pages, kv_heads, page, dim), dt)
+    vp = jnp.asarray(rng.randn(num_pages, kv_heads, page, dim), dt)
+    perm = rng.permutation(num_pages)[:rows * max_pages]
+    tables = jnp.asarray(perm.reshape(rows, max_pages), jnp.int32)
+    # half the rows decode (q_len 1), half are ragged prefill chunks
+    q_lens = np.asarray([1 if i % 2 else 1 + rng.randint(qb)
+                         for i in range(rows)], np.int32)
+    kv = rng.randint(ctx // 2, ctx + 1, (rows,)).astype(np.int32)
+    kv = np.maximum(kv, q_lens)
+    q_starts = kv - q_lens
+    kv_lens = jnp.asarray(kv)
+    q_starts = jnp.asarray(q_starts)
+    q_lens = jnp.asarray(q_lens)
+
+    def timeit(f):
+        g = jax.jit(f)
+        out = g(q, kp, vp, tables, kv_lens, q_starts, q_lens)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g(q, kp, vp, tables, kv_lens, q_starts, q_lens)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3, out
+
+    def pallas_path(q, kp, vp, tables, kl, qs, ql):
+        return RPA._ragged_impl(q, kp, vp, tables, kl, qs, ql,
+                                scale=1.0 / float(np.sqrt(dim)))
+
+    pallas_ms, o_p = timeit(pallas_path)
+    xla_ms, o_x = timeit(RPA.ragged_paged_attention_xla)
+    err = float(jnp.max(jnp.abs(o_p.astype(jnp.float32)
+                                - o_x.astype(jnp.float32))))
+    scale = float(jnp.max(jnp.abs(o_x.astype(jnp.float32))))
+    return {
+        "ragged_pallas_ms": round(pallas_ms, 3),
+        "ragged_xla_ms": round(xla_ms, 3),
+        "ragged_speedup": round(xla_ms / pallas_ms, 3),
+        "ragged_parity_ok": bool(err < 0.05 * max(scale, 1.0)),
+    }
+
+
 def bench_serving(model, n_requests=24, new_tokens=48, max_batch=16,
-                  decode_ceiling=None):
-    """Continuous-batching engine throughput: ragged prompts admitted on
-    the fly over the Pallas paged-attention decode program. Steady state
-    runs the scanned burst program (BURST decode steps per dispatch)."""
-    from paddle_tpu.inference.serving import LlamaServingEngine
+                  decode_ceiling=None, on_tpu=True):
+    """Chunked-prefill engine throughput: ragged prompts admitted on the
+    fly over ONE mixed prefill+decode program (the ragged paged-
+    attention kernel). Three regimes:
+
+    - ``serving_tokens_per_sec``: the historical e2e number — admit
+      n_requests ragged prompts, run to completion (prefill + decode +
+      admission bookkeeping included).
+    - ``serving_steady_tokens_per_sec`` (+ ``serving_ceiling_frac``):
+      a full batch on the scanned decode path, no retirements — the
+      sustained rate vs the raw decode ceiling.
+    - ``serving_chunked_tokens_per_sec`` (+ TTFT p50/p99): the MIXED
+      workload — long prompts admitted while a decode-heavy batch is
+      live, chunks interleaving with decodes every step. The gate
+      ``serving_chunked_ok`` requires >= 1.5x the e2e rate measured in
+      the same run."""
+    from paddle_tpu.inference.serving import LlamaServingEngine, Request
 
     model.eval()
     engine = LlamaServingEngine(model, max_batch=max_batch, page_size=64,
                                 num_pages=max_batch * 8 + 8,
-                                max_pages_per_seq=8, burst=32)
+                                max_pages_per_seq=8, decode_ticks=32)
     rng = np.random.RandomState(0)
-    prompts = [rng.randint(0, model.config.vocab_size,
-                           (int(rng.randint(16, 128)),)).tolist()
+    v = model.config.vocab_size
+    prompts = [rng.randint(0, v, (int(rng.randint(16, 128)),)).tolist()
                for _ in range(n_requests)]
-    # warm TWICE: pass 1 runs the eager warmup + traces, pass 2 lands
-    # every prefill bucket and the decode program in the compile cache
+    # warm TWICE: pass 1 traces, pass 2 lands both mixed-program shapes
+    # and the full-length scan in the compile cache
     engine.generate(prompts, max_new_tokens=2)
-    # instance burst length (not the class default!) — the warm pass
-    # must land the full-length burst program in the compile cache or
-    # the timed run pays its compile
-    engine.generate(prompts, max_new_tokens=engine.burst + 2)
+    engine.generate(prompts, max_new_tokens=engine.decode_ticks + 2)
     t0 = time.perf_counter()
     outs = engine.generate(prompts, max_new_tokens=new_tokens)
     dt = time.perf_counter() - t0
     total = sum(len(o) for o in outs)
+    e2e = total / dt
 
-    # steady-state decode throughput: a full batch bursting with no
-    # retirements (the serving engine's sustained rate, free of prefill
-    # and admission bookkeeping)
-    from paddle_tpu.inference.serving import Request
+    # steady-state decode throughput: a full batch scanning with no
+    # retirements (the engine's sustained rate, free of prefill and
+    # admission bookkeeping)
     rng2 = np.random.RandomState(1)
     for _ in range(max_batch):
         engine.add_request(Request(
-            rng2.randint(0, model.config.vocab_size, (32,)).tolist(),
+            rng2.randint(0, v, (32,)).tolist(),
             max_new_tokens=new_tokens * 8 + 64))
-    engine.decode_many(engine.burst)  # warm the burst path
+    engine.decode_many(engine.decode_ticks)  # warm the scan path
     # best-of-3: the tunneled chip's per-dispatch latency is noisy, and
     # a single timed window under-reports the engine's sustained rate
     steady = 0.0
@@ -304,14 +369,60 @@ def bench_serving(model, n_requests=24, new_tokens=48, max_batch=16,
     for r in list(engine._live.values()):
         engine.alloc.release(r.seq_id)
         engine._live.pop(r.seq_id)
+
+    # mixed long-prompt + decode-heavy workload: decode-bound requests
+    # stay live while multi-chunk prompts stream in; TTFT of each long
+    # admission is measured with the batch busy (the number the old
+    # wave/burst split could not bound)
+    n_dec = max(1, max_batch - 2)
+    decoders = [Request(rng2.randint(0, v, (32,)).tolist(),
+                        max_new_tokens=100000)
+                for _ in range(n_dec)]
+    for r in decoders:
+        engine.add_request(r)
+    long_len = 4 * engine.page_size          # 4 pages, multi-chunk
+    n_long = 6 if on_tpu else 3
+    ttfts = []
+    done0 = sum(len(r.output_ids) for r in decoders)
+    longs = []
+    t0 = time.perf_counter()
+    for i in range(n_long):
+        lr = Request(rng2.randint(0, v, (long_len,)).tolist(),
+                     max_new_tokens=4)
+        longs.append(lr)
+        ts = time.perf_counter()
+        engine.add_request(lr)               # chunks + decodes interleave
+        ttfts.append(time.perf_counter() - ts)
+        engine.decode_many(8 if on_tpu else 4)
+    dt_mixed = time.perf_counter() - t0
+    # mixed throughput counts every token the engine PROCESSED in the
+    # window: decode tokens emitted plus prompt tokens chunk-prefilled
+    # (the standard chunked-prefill accounting — prefill is the work
+    # the old wave/burst split serialized)
+    mixed_tokens = (sum(len(r.output_ids) for r in decoders) - done0
+                    + sum(len(r.output_ids) + r._prefilled
+                          for r in longs))
+    chunked = mixed_tokens / dt_mixed
+    for r in list(engine._live.values()):
+        engine.cancel(r)
+    engine.close()
     model.train()
     out = {
         "serving_requests": n_requests,
         "serving_tokens": total,
-        "serving_tokens_per_sec": round(total / dt, 1),
+        "serving_tokens_per_sec": round(e2e, 1),
         "serving_steady_tokens_per_sec": round(steady, 1),
+        "serving_chunked_tokens_per_sec": round(chunked, 1),
+        "serving_chunked_speedup": round(chunked / max(e2e, 1e-9), 3),
+        "serving_chunked_ok": bool(chunked >= 1.5 * e2e),
+        "serving_ttft_p50_ms": round(
+            float(np.percentile(ttfts, 50)) * 1e3, 2),
+        "serving_ttft_p99_ms": round(
+            float(np.percentile(ttfts, 99)) * 1e3, 2),
         "serving_max_batch": max_batch,
-        "serving_burst": engine.burst,
+        "serving_chunk_budget": engine.chunk_budget,
+        "serving_chunk_block": engine.chunk_block,
+        "serving_decode_ticks": engine.decode_ticks,
     }
     if decode_ceiling:
         out["serving_ceiling_frac"] = round(steady / decode_ceiling, 3)
@@ -567,6 +678,16 @@ def main():
         result["paged_error"] = repr(e)[:200]
 
     try:
+        if on_tpu:
+            result.update(bench_ragged())
+        else:
+            result.update(bench_ragged(rows=4, qb=8, heads=4, kv_heads=2,
+                                       dim=32, page=8, ctx=64, iters=2))
+    except Exception as e:
+        log(f"ragged bench failed: {e!r:.300}")
+        result["ragged_error"] = repr(e)[:200]
+
+    try:
         model = bench_train_step.last_model
         result.update(bench_decode(
             model, batch=16 if on_tpu else 1,
@@ -589,7 +710,8 @@ def main():
             model, n_requests=24 if on_tpu else 2,
             new_tokens=48 if on_tpu else 4,
             max_batch=16 if on_tpu else 2,
-            decode_ceiling=result.get("decode_tokens_per_sec")))
+            decode_ceiling=result.get("decode_tokens_per_sec"),
+            on_tpu=on_tpu))
     except Exception as e:
         log(f"serving bench failed: {e!r:.300}")
         result["serving_error"] = repr(e)[:200]

@@ -83,10 +83,20 @@ def check_dtype(dtype, name, expected_dtypes, op_name):
 
 def attach_op_context(exc, op_name):
     """Tag an in-flight exception with the operator it crossed (PEP 678
-    note — the analog of enforce.h's operator-context frames)."""
-    if hasattr(exc, "add_note"):
-        try:
-            exc.add_note(f"[operator '{op_name}' of paddle_tpu]")
-        except TypeError:
-            pass
+    note — the analog of enforce.h's operator-context frames). On
+    Python < 3.11, where ``add_note`` doesn't exist, the ``__notes__``
+    list is maintained by hand — same attribute, same traceback
+    rendering under 3.11+ semantics."""
+    note = f"[operator '{op_name}' of paddle_tpu]"
+    try:
+        if hasattr(exc, "add_note"):
+            exc.add_note(note)
+        else:
+            notes = getattr(exc, "__notes__", None)
+            if not isinstance(notes, list):
+                notes = []
+                exc.__notes__ = notes
+            notes.append(note)
+    except (TypeError, AttributeError):
+        pass
     return exc

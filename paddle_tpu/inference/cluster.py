@@ -407,18 +407,27 @@ class EngineReplica:
     def load(self):
         """Load score from the engine's own admission gauges: live
         batch occupancy + backlog depth (normalized to max_batch) +
-        KV-page utilization. Lower is better."""
+        KV-page utilization + pending prefill work. Lower is better.
+
+        The prefill-backlog term (prompt tokens admitted but not yet
+        chunk-prefilled, normalized to the engine's per-step
+        ``chunk_budget``) makes a replica chewing through a long prompt
+        look busier than its live count alone suggests — its decode
+        budget is partly spoken for over the next
+        ``backlog / chunk_budget`` steps."""
         e = self.engine
         with self._lock:
             backlog = len(self._backlog)
         if e is None:
             return {"score": float("inf"), "live": 0, "backlog": backlog,
-                    "kv_util": 1.0}
+                    "kv_util": 1.0, "prefill_backlog": 0}
         live = len(e._live)
         kv_util = 1.0 - e.alloc.free_pages / e.alloc.num_pages
-        score = (live + backlog) / max(1, e.max_batch) + kv_util
+        pb = e.prefill_backlog()
+        score = (live + backlog) / max(1, e.max_batch) + kv_util \
+            + pb / max(1, e.chunk_budget)
         return {"score": score, "live": live, "backlog": backlog,
-                "kv_util": kv_util}
+                "kv_util": kv_util, "prefill_backlog": pb}
 
     def submit(self, creq):
         """Queue a request for this replica's worker. Raises a typed
@@ -511,8 +520,10 @@ class EngineReplica:
                 self._tracked[req] = creq
                 self._pending_admit.remove(creq)
             admitted.append(req)
-        if admitted:
-            e._prefill_wave(admitted)
+        # no explicit prefill here: admitted prompts chunk-prefill
+        # inside the worker tick's very next mixed dispatch
+        # (engine.step()/decode_many), interleaved with live decodes
+        return admitted
 
     def _unpend(self, creq):
         with self._lock:
@@ -857,7 +868,7 @@ class SubprocessReplica:
         l = self._load
         if not self.alive() or l is None:
             return {"score": float("inf"), "live": 0, "backlog": 0,
-                    "kv_util": 1.0}
+                    "kv_util": 1.0, "prefill_backlog": 0}
         return l
 
     def submit(self, creq):

@@ -281,8 +281,8 @@ def replica_main():
     def factory():
         # prewarm rides the engine default (PADDLE_TPU_SERVING_PREWARM,
         # which the supervisor sets to 1 for workers): registry-recorded
-        # prefill buckets / decode / bursts compile here, against the
-        # persistent cache — BEFORE this replica enters membership
+        # mixed-program shapes / decode-scan ticks compile here, against
+        # the persistent cache — BEFORE this replica enters membership
         return LlamaServingEngine(model, **engine_kw)
 
     store = FileStore(store_path, ttl=ttl)

@@ -10,36 +10,44 @@ every live sequence decodes one token per engine step in a single
 batched program, and KV lives in a shared paged pool so ragged contexts
 waste no HBM.
 
-Design (TPU-first):
+Design (TPU-first, chunked prefill over ONE mixed program):
 - ONE :class:`PageAllocator` shared by all layers (page structure is
   identical per layer); per-layer K/V pools are device arrays updated
   functionally.
-- Prefill runs the model's own submodules densely (flash/XLA attention)
-  while collecting post-rope K/V per layer, then scatters them into
-  pages — per request, compiled per prompt-length bucket.
-- The decode step is ONE ``to_static`` program of static shape
-  [max_batch]: embed → per layer (rms_norm → qkv → rope at per-row
-  positions → page write → Pallas ``paged_attention`` → o_proj →
-  swiglu MLP) → logits → greedy argmax. Inactive batch slots point at a
-  reserved trash page with length 1, so shapes never change and the
-  executable is reused for the engine's lifetime.
-- Sustained decode runs as a **burst**: ``lax.scan`` over the same
-  traced decode step, so BURST tokens per sequence cost ONE dispatch,
-  one host→device transfer of (tokens, tables, lens) and one
-  device→host fetch of the emitted block — the per-step host round
-  trip (the dominant cost of dispatch-per-token serving) is amortized
-  away. Pages for the whole burst are reserved up front; sequence
-  lengths advance on device as the scan carry.
+- EVERY engine step is one dispatch of a single **mixed program** over
+  a token-packed batch: variable-length prefill chunks and single-token
+  decode rows ride in the same static-shape dispatch, attention served
+  by the Pallas ``ragged_paged_attention`` kernel (per-row
+  ``(q_start, q_len, kv_len)`` metadata over the shared block tables —
+  the *Ragged Paged Attention* design, arXiv 2604.15464). There is no
+  separate prefill program, no per-bucket compilation, and no
+  wave-then-burst phase split: a long prompt is split into
+  ``chunk_block``-sized chunks that interleave with live decodes under
+  a per-step ``chunk_budget`` token budget, so admitting a 10k-token
+  prompt never stalls a live decode for more than one chunk.
+- The program packs real tokens [T = chunk_budget] (embed → per layer:
+  rms_norm → qkv → rope at per-token positions → page write → ragged
+  paged attention → o_proj → swiglu MLP → logits at each row's last
+  token → greedy argmax); pad tokens scatter to a reserved trash page
+  and inactive rows carry ``kv_len 0``, so shapes never change and two
+  executables (the ``chunk_budget``-token mixed shape and the
+  [max_batch]-token decode-only shape) cover the engine's lifetime.
+- Sustained decode amortizes the host round trip with ``lax.scan``
+  over the SAME mixed step (``decode_ticks`` tokens per sequence per
+  dispatch, pages reserved up front, lengths advancing on device as
+  the scan carry) — the scan body is the one mixed-program function,
+  not a separate decode path.
 
 Shared-prefix KV cache (scale-out layer):
 - Page-aligned prompt prefixes are content-addressed
   (:mod:`paddle_tpu.inference.prefix_cache`): a cold prompt's full
-  pages are pinned after its prefill wave, and a later prompt sharing
-  that prefix admits directly against the cached pages (refcounted in
-  :class:`PageAllocator`, copy-on-write on any write into a shared
-  page). Only the un-cached suffix runs through the model — via the
-  compiled decode program, teacher-forced — so a 1k-token system
-  prompt is prefilled once per replica, not once per request.
+  pages are pinned once its prefill completes, and a later prompt
+  sharing that prefix admits directly against the cached pages
+  (refcounted in :class:`PageAllocator`, copy-on-write on any write
+  into a shared page). Only the un-cached suffix runs through the
+  model — as ordinary prefill chunks of the mixed program, typically
+  ONE dispatch — so a 1k-token system prompt is prefilled once per
+  replica, not once per request.
   ``serving_prefix_cache_hit_total`` /
   ``serving_prefix_saved_prefill_tokens_total`` make the win visible;
   under pool pressure cached pages are evicted (LRU, chain tails
@@ -53,9 +61,9 @@ Request lifecycle (robustness layer):
   in ``req.error`` — never a silently truncated output.
 - **Deadlines**: ``Request(deadline=...)`` (wall-clock TTL from
   admission) and ``Request(token_budget=...)`` (seconds per generated
-  token) are enforced at wave/step/burst boundaries; an expired
-  request's pages go back to the :class:`PageAllocator` and the next
-  wave can admit into them.
+  token) are enforced at step/scan boundaries; an expired request's
+  pages go back to the :class:`PageAllocator` and the next admission
+  can use them.
 - **Cancellation**: :meth:`LlamaServingEngine.cancel` is thread-safe
   and idempotent — safe to fire from a client-abandon callback while
   another thread drives ``step()``; page release is deferred past any
@@ -96,12 +104,11 @@ import numpy as np
 
 from ..framework.tensor import Tensor, no_grad, run_op
 from ..incubate.nn import functional as FI
-from ..nn import functional as F
 from ..observability import compile_watch as _cw
 from ..observability import flight_recorder as _fr
 from ..observability import metrics as _om
 from ..observability.trace import span as _span
-from ..ops.paged_attention import paged_attention
+from ..ops.ragged_paged_attention import ragged_paged_attention
 from ..testing import faults as _faults
 from .paged_cache import PageAllocator
 
@@ -231,7 +238,7 @@ def _serving_metrics():
             "admission -> first emitted token", buckets=_LATENCY_BUCKETS),
         "tpot": _om.histogram(
             "serving_token_latency_seconds",
-            "per-token decode latency (burst dispatches amortized)",
+            "per-token decode latency (scan dispatches amortized)",
             buckets=_LATENCY_BUCKETS),
         "prefill_tokens": _om.counter(
             "serving_prefill_tokens_total", "prompt tokens prefilled"),
@@ -250,6 +257,10 @@ def _serving_metrics():
         "prefix_pages": _om.gauge(
             "serving_prefix_cache_pages",
             "KV pages currently pinned by the shared-prefix cache"),
+        "prefill_backlog": _om.gauge(
+            "serving_prefill_backlog_tokens",
+            "prompt tokens admitted but not yet prefilled (the "
+            "chunked-prefill queue; load-routing signal)"),
     }
 
 
@@ -293,17 +304,14 @@ def _page_write(pages, new, page_ids, offs):
                   differentiable=False)
 
 
-def _page_write_seq(pages, new, page_ids, offs):
-    """Scatter a wave of sequences ``new [B, S, Hk, D]`` into ``pages``
-    at (page_ids[b, s], h, offs[b, s]) — the prefill write, inside the
-    compiled program (trash-page entries absorb bucket padding and pad
-    rows)."""
-    def fn(pages, new, page_ids, offs):
-        hidx = jnp.arange(pages.shape[1])[None, None, :]
-        return pages.at[page_ids[:, :, None], hidx, offs[:, :, None]].set(
-            new.astype(pages.dtype))
+def _token_gather(x, idx):
+    """Gather rows of ``x`` by an integer index array — the mixed
+    program's pack/unpack between the flat token axis [T, ...] and the
+    ragged kernel's row-blocked layout [R, QB, ...]."""
+    def fn(x, idx):
+        return x[idx.astype(jnp.int32)]
 
-    return run_op("paged_kv_write_seq", fn, (pages, new, page_ids, offs),
+    return run_op("serving_token_gather", fn, (x, idx),
                   differentiable=False)
 
 
@@ -315,7 +323,7 @@ class Request:
         max_new_tokens: generation budget, >= 1.
         eos_token_id: optional early-stop token.
         deadline: wall-clock TTL in seconds, measured from admission.
-            Past it the request is expired at the next wave/step/burst
+            Past it the request is expired at the next step/scan
             boundary: its pages are released and ``error`` is set to a
             :class:`DeadlineExceeded` (partial output preserved).
         token_budget: seconds allowed per generated token — an
@@ -364,16 +372,18 @@ class Request:
         self._expires_at = None       # perf_counter stamp, or None
         self._cancel_requested = False  # honored at (re-)admission
         self._cached_tokens = 0       # prefix tokens served from cache
+        self._prefilled = 0           # prompt tokens written to pages
 
 
 class LlamaServingEngine:
-    #: default compiled burst length — one scanned decode program serves
-    #: this many tokens per sequence per dispatch
-    BURST = 16
+    #: default scanned decode run — one dispatch of the mixed program
+    #: scanned over this many ticks serves that many tokens/sequence
+    DECODE_TICKS = 16
 
     def __init__(self, model, max_batch=16, page_size=16, num_pages=None,
-                 max_pages_per_seq=None, burst=None, admit_retries=0,
-                 admit_backoff=0.005, stuck_factor=8.0,
+                 max_pages_per_seq=None, chunk_budget=None,
+                 chunk_block=None, decode_ticks=None, burst=None,
+                 admit_retries=0, admit_backoff=0.005, stuck_factor=8.0,
                  stuck_min_timeout=30.0, prefix_cache=True,
                  prefix_cache_pages=None, prewarm=None):
         if num_pages is None:
@@ -383,14 +393,42 @@ class LlamaServingEngine:
         self.max_batch = max_batch
         self.page_size = page_size
         # Keep block tables as narrow as the workload allows: the Pallas
-        # decode grid is (B, Hk, width), so a table sized to the whole
+        # ragged grid is (R, Hk, width), so a table sized to the whole
         # pool pays a grid step (and an HBM->VMEM page fetch) per UNUSED
         # table slot. max_pages_per_seq is the knob.
-        self.burst = int(burst) if burst else self.BURST
+        #
+        # Chunked-prefill scheduler knobs:
+        # - chunk_budget: token budget per mixed dispatch — the sum of
+        #   query tokens (decode rows count 1, prefill chunks their
+        #   length) packed into one step. Floored at 2*max_batch so a
+        #   full decode batch always leaves prefill headroom.
+        # - chunk_block: the ragged kernel's per-row query block — the
+        #   largest single prefill chunk. Rounded up so the kernel's
+        #   [QB*group] query tile stays sublane-aligned.
+        # - decode_ticks: scan length of the all-decode dispatch (the
+        #   host-round-trip amortizer). ``burst=`` is accepted as a
+        #   legacy alias.
+        group = max(1, cfg.num_attention_heads
+                    // max(1, cfg.num_key_value_heads))
+        align = 8 // math.gcd(group, 8)
+        qb = int(chunk_block) if chunk_block else min(
+            32, max(8, 2 * page_size))
+        self.chunk_block = -(-qb // align) * align
+        budget = int(chunk_budget) if chunk_budget \
+            else max(64, 4 * max_batch)
+        self.chunk_budget = max(budget, 2 * max_batch, self.chunk_block)
+        if decode_ticks is None and burst is not None:
+            decode_ticks = burst
+        self.decode_ticks = int(decode_ticks) if decode_ticks \
+            else self.DECODE_TICKS
+        # mixed-program row capacity: every live sequence may hold one
+        # decode row, and the remaining budget splits into chunk rows
+        self.rows_cap = max_batch + -(-self.chunk_budget
+                                      // self.chunk_block)
         # admission backpressure: retry this many times (exponential
         # backoff from admit_backoff seconds) before a typed rejection.
         # Default 0 (instant rejection): retries only help when another
-        # thread drives step()/burst and can retire a request
+        # thread drives step()/scans and can retire a request
         # mid-backoff — opt in for such multithreaded deployments.
         self.admit_retries = int(admit_retries)
         self.admit_backoff = float(admit_backoff)
@@ -423,15 +461,19 @@ class LlamaServingEngine:
         self._live: dict[int, Request] = {}
         self._m = _serving_metrics()
         self._next_id = 0
-        self._decode_static = None
-        self._prefill_static = None
-        self._prefill_warm_buckets: set[int] = set()
-        self._burst_static: dict[int, object] = {}  # burst length -> program
+        # ONE traced mixed-program function covers every dispatch; its
+        # per-signature cache holds the chunk_budget-token shape and the
+        # [max_batch]-token decode-only shape. Scanned multi-tick
+        # variants (lax.scan over the same function) key by tick count.
+        self._mixed_static = None
+        self._scan_static: dict[int, object] = {}   # ticks -> program
+        self._warmed_keys: set = set()  # ("mixed", T) / ("scan", k)
+        self._warm_dispatches = 0       # dummy compile-warm dispatches
         # lifecycle state: one re-entrant lock guards _live, the
         # requeue, deferred releases and entry-depth accounting so
         # cancel()/drain handlers may fire from any thread
         self._lock = threading.RLock()
-        # dispatch mutex: step()/_burst()/_prefill_wave bodies are
+        # dispatch mutex: step()/_decode_scan() bodies are
         # serialized — two driver threads (or a drain racing an
         # external driver loop) must never interleave allocator extends
         # and pool reassignments for the same sequences. Re-entrant so
@@ -459,7 +501,7 @@ class LlamaServingEngine:
         # the same serving programs gets executables from disk in
         # seconds instead of ~19 s of backend compile. The shape
         # registry records which programs THIS engine geometry actually
-        # dispatches (prefill buckets, decode, burst lengths) so the
+        # dispatches (mixed token shapes, scan tick counts) so the
         # next process can pre-warm them before traffic arrives.
         self._cache_dir = _cw.enable_persistent_cache()
         self._recorded_shapes: set = set()
@@ -474,8 +516,8 @@ class LlamaServingEngine:
 
     def __state_tensors__(self):
         """State-discovery override for ``to_static``: the KV pools are
-        explicit inputs/outputs of every compiled program (donated by the
-        burst path) and must NOT also be captured as closure state —
+        explicit inputs/outputs of every compiled program (donated for
+        in-place page writes) and must NOT also be captured as closure state —
         that would donate the same buffers twice. Model params enter via
         ``state=[self.model]``."""
         return []
@@ -591,7 +633,7 @@ class LlamaServingEngine:
 
     def _expire_deadlines(self):
         """Expire every live request past its deadline — called at
-        wave/step/burst boundaries (the granularity that exists once a
+        step/scan boundaries (the granularity that exists once a
         dispatch is on device)."""
         now = time.perf_counter()
         with self._lock:
@@ -650,145 +692,83 @@ class LlamaServingEngine:
                 return False
 
     # ------------------------------------------------------------------
-    # prefill
+    # the mixed program: prefill chunks + decode rows, one dispatch
     # ------------------------------------------------------------------
-    def _prefill_forward(self, ids, last_pos, page_ids, offs, k_pools,
-                         v_pools):
-        """Dense forward of a WAVE of prompts [max_batch, Sb]
-        (bucket-padded; causal attention keeps each padded tail from
-        touching the real prefix) that also scatters the post-rope K/V
-        into the page pools INSIDE the compiled program. Pad rows and
-        pad positions scatter to the trash page. One dispatch admits up
-        to max_batch requests — the reference serving stack's batched
-        context step (`block_multi_head_attention`) done the XLA way.
-        Returns (next token id [B, 1], new k_pools, new v_pools)."""
-        from ..tensor import creation, manipulation, search
+    def _mixed_forward(self, tokens, pos, page_ids, offs, row_tok,
+                       flat_idx, last_idx, tables, kv_lens, q_starts,
+                       q_lens, k_pools, v_pools):
+        """ONE token-packed model step: embed [1, T] real tokens (a mix
+        of prefill-chunk tokens and decode tokens, back to back with no
+        inter-row padding), scatter every token's post-rope K/V into the
+        page pools, run the Pallas ragged-paged-attention kernel over
+        the per-row ``(q_start, q_len, kv_len)`` metadata, and read the
+        greedy next token at each row's last valid position. Pure in
+        its inputs so ``to_static`` compiles it once per token-count
+        signature; the decode-only shape (T == max_batch, QB == 1) and
+        the chunk-budget shape share this function.
+
+        tokens/pos [1, T]; page_ids/offs/flat_idx [T]; row_tok [R, QB];
+        last_idx/kv_lens/q_starts/q_lens [R]; tables [R, W].
+        Returns (next token id [R, 1], new k_pools, new v_pools)."""
+        from ..tensor import search
 
         m = self.model.model
         cfg = self.model.config
-        b, s = ids.shape[0], ids.shape[1]
-        pos = creation.arange(0, s, dtype="int64").reshape([1, s]) \
-            .expand([b, s])
-        x = m.embed_tokens(ids)
+        t = tokens.shape[1]
+        r_rows, qb = row_tok.shape[0], row_tok.shape[1]
+        pos64 = pos.astype("int64")
+        x = m.embed_tokens(tokens)                       # [1, T, H]
         new_k, new_v = [], []
         for li, layer in enumerate(m.layers):
             h = layer.input_layernorm(x)
             att = layer.self_attn
-            q = att.q_proj(h).reshape([b, s, att.num_heads, att.head_dim])
-            k = att.k_proj(h).reshape([b, s, att.num_kv_heads, att.head_dim])
-            v = att.v_proj(h).reshape([b, s, att.num_kv_heads, att.head_dim])
+            q = att.q_proj(h).reshape([1, t, att.num_heads, att.head_dim])
+            k = att.k_proj(h).reshape([1, t, att.num_kv_heads,
+                                       att.head_dim])
+            v = att.v_proj(h).reshape([1, t, att.num_kv_heads,
+                                       att.head_dim])
             q, k, v = FI.fused_rotary_position_embedding(
-                q, k, v, position_ids=pos, rotary_emb_base=cfg.rope_theta)
-            new_k.append(_page_write_seq(k_pools[li], k, page_ids, offs))
-            new_v.append(_page_write_seq(v_pools[li], v, page_ids, offs))
-            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
-            x = x + att.o_proj(out.reshape([b, s, -1]))
+                q, k, v, position_ids=pos64,
+                rotary_emb_base=cfg.rope_theta)
+            k2 = k.reshape([t, att.num_kv_heads, att.head_dim])
+            v2 = v.reshape([t, att.num_kv_heads, att.head_dim])
+            kp = _page_write(k_pools[li], k2, page_ids, offs)
+            vp = _page_write(v_pools[li], v2, page_ids, offs)
+            new_k.append(kp)
+            new_v.append(vp)
+            # pack the flat token axis into the kernel's [R, QB] row
+            # blocks; every row's K/V is already in the pool (the
+            # scatter above covers ALL rows of this dispatch), so a
+            # later chunk of the same sequence may attend an earlier
+            # chunk from the same step
+            q4 = _token_gather(
+                q.reshape([t, att.num_heads, att.head_dim]), row_tok)
+            attn4 = ragged_paged_attention(q4, kp, vp, tables, kv_lens,
+                                           q_starts, q_lens)
+            attn = _token_gather(
+                attn4.reshape([r_rows * qb, att.num_heads,
+                               att.head_dim]), flat_idx)
+            x = x + att.o_proj(attn.reshape([1, t, -1]))
             x = x + layer.mlp(layer.post_attention_layernorm(x))
         x = m.norm(x)
-        h_last = manipulation.take_along_axis(
-            x, last_pos.astype("int64").reshape([b, 1, 1])
-            .expand([b, 1, x.shape[-1]]), 1)         # [B, 1, H]
-        logits = self.model._logits(h_last)
+        h_last = _token_gather(x.reshape([t, x.shape[-1]]), last_idx)
+        logits = self.model._logits(
+            h_last.reshape([r_rows, 1, h_last.shape[-1]]))
         nxt = search.argmax(logits, axis=-1).astype("int64")
         return nxt, new_k, new_v
 
-    PREFILL_BUCKET = 32
-
-    @_fatal_guard("serving.prefill_wave")
-    def _prefill_wave(self, reqs):
-        """Prefill 1..max_batch admitted requests in ONE compiled call
-        (cold prompts), then advance cached-prefix admissions through
-        the decode program over their un-cached suffix (warm prompts —
-        see :meth:`_suffix_prefill`). Requests that expired or were
-        cancelled since admission are skipped (their pages are already
-        back in the pool)."""
-        with self._entry(), self._dispatch_lock, _CROSS_ENGINE_LOCK:
-            self._expire_deadlines()
-            with self._lock:
-                reqs = [r for r in reqs
-                        if not r.done and r.seq_id in self._live]
-                cold = [r for r in reqs if not r._cached_tokens]
-                warm = [r for r in reqs if r._cached_tokens]
-                cold_sids = [r.seq_id for r in cold]
-                warm_sids = [r.seq_id for r in warm]
-            if cold:
-                self._do_prefill_wave(cold, cold_sids)
-            if warm:
-                self._suffix_prefill(warm, warm_sids)
-
-    def _do_prefill_wave(self, reqs, sids):
-        b = self.max_batch
-        n_max = max(len(r.prompt_ids) for r in reqs)
-        # bucket the padded length so ragged prompts share compiled
-        # prefill programs (one per bucket, not one per length)
-        bucket = -(-n_max // self.PREFILL_BUCKET) * self.PREFILL_BUCKET
-        padded = np.zeros((b, bucket), np.int64)
-        page_ids = np.full((b, bucket), self.trash_page, np.int32)
-        offs = np.zeros((b, bucket), np.int32)
-        last_pos = np.zeros((b,), np.int32)
-        for i, r in enumerate(reqs):
-            n = len(r.prompt_ids)
-            padded[i, :n] = r.prompt_ids
-            rp, ro = self.alloc.page_positions(sids[i], 0, n)
-            page_ids[i, :n] = rp
-            offs[i, :n] = ro
-            last_pos[i] = n - 1
-        if self._m["ttft"] is not _om.NULL \
-                and bucket not in self._prefill_warm_buckets:
-            # compile this bucket's program OUTSIDE the TTFT window: a
-            # dummy dispatch (all page writes land in the trash page,
-            # emitted tokens discarded) triggers the one-time trace +
-            # compile, and the wave's admission stamps shift past it so
-            # TTFT keeps one sample per request without the multi-second
-            # compile skewing the histogram's +Inf bucket forever. Under
-            # PADDLE_TPU_METRICS=0 this is skipped (zero-cost mandate).
-            t_w = time.perf_counter()
-            self._warm_prefill_bucket(bucket)
-            warm_dur = time.perf_counter() - t_w
-            for r in reqs:
-                if r._t_admit is not None:
-                    r._t_admit += warm_dur
-                if r._expires_at is not None:
-                    # the deadline clock starts at admission; compile
-                    # warmup is engine overhead, not request time
-                    r._expires_at += warm_dur
-        elif self._prefill_static is None:
+    def _ensure_mixed_compiled(self):
+        if self._mixed_static is None:
             from ..jit import StaticFunction
 
             # no lazy state (params exist, no optimizer): skip the eager
             # warmup and compile directly; donate pools for in-place
             # page writes
-            self._prefill_static = StaticFunction(
-                self._prefill_forward, state=[self.model], warmup="once",
-                donate_inputs=True, name="serving.prefill")
-            self._prefill_static._warmed_any = True
-        self._record_shape("prefill", bucket)
-        with self._lock:
-            self._in_dispatch = True
-        try:
-            with no_grad(), _span("serving.prefill_wave", wave=len(reqs),
-                                  bucket=bucket):
-                nxt, new_k, new_v = self._prefill_static(
-                    Tensor(jnp.asarray(padded)),
-                    Tensor(jnp.asarray(last_pos)),
-                    Tensor(jnp.asarray(page_ids)), Tensor(jnp.asarray(offs)),
-                    self.k_pools, self.v_pools)
-        finally:
-            with self._lock:
-                self._in_dispatch = False
-        self._flush_deferred()
-        self.k_pools, self.v_pools = list(new_k), list(new_v)
-        # register full prompt pages for reuse BEFORE emitting: a
-        # max_new_tokens=1 request retires (and releases) at emit, and
-        # its prefix must still make it into the cache
-        if self.prefix is not None:
-            self._prefix_insert(reqs, sids)
-        first = np.asarray(nxt._data).reshape(-1)
-        for i, r in enumerate(reqs):
-            if not r.done and r.seq_id == sids[i]:
-                self._emit(r, int(first[i]))
-        self._expire_deadlines()
-        self._set_pool_gauges()
+            self._mixed_static = StaticFunction(
+                self._mixed_forward, state=[self.model], warmup="once",
+                donate_inputs=True, name="serving.mixed_step")
+            self._mixed_static._warmed_any = True
+        return self._mixed_static
 
     def _prefix_insert(self, reqs, sids):
         """Pin freshly written full prompt pages in the prefix cache
@@ -811,136 +791,193 @@ class LlamaServingEngine:
             self.k_pools[li] = Tensor(kd.at[new].set(kd[old]))
             self.v_pools[li] = Tensor(vd.at[new].set(vd[old]))
 
-    def _suffix_prefill(self, reqs, sids):
-        """Write warm requests' un-cached suffix K/V by teacher-forcing
-        the compiled decode program over the suffix tokens (emitted
-        logits are discarded until the final prompt token, whose argmax
-        IS the first generated token). A shared 1k-token system prompt
-        costs ``len(suffix)`` decode dispatches instead of a full
-        prefill — the prefix-cache TTFT win. All warm requests in the
-        wave advance in lockstep, one batched dispatch per position."""
-        b = self.max_batch
-        if self._decode_static is None \
-                and self._m["ttft"] is not _om.NULL:
-            # compile the decode program OUTSIDE the TTFT window (all
-            # writes land in the trash page, outputs discarded) and
-            # credit the compile time back to the wave's clocks —
-            # mirrors the cold prefill bucket warmup
-            t_w = time.perf_counter()
-            self._warm_decode()
-            warm_dur = time.perf_counter() - t_w
-            for r in reqs:
-                if r._t_admit is not None:
-                    r._t_admit += warm_dur
-                if r._expires_at is not None:
-                    r._expires_at += warm_dur
-        step = self._ensure_decode_compiled()
-        cur = {r.seq_id: r._cached_tokens for r in reqs}
-        total = {r.seq_id: len(r.prompt_ids) for r in reqs}
-        while True:
-            with self._lock:
-                rows = [(i, r) for i, r in enumerate(reqs)
-                        if not r.done and r.seq_id == sids[i]
-                        and cur[sids[i]] < total[sids[i]]]
-                cow = []
-                for i, r in rows:
-                    # defensive copy-on-write: page-aligned matches
-                    # always write into privately owned pages, but a
-                    # shared page must stay immutable regardless
-                    cp = self.alloc.ensure_writable(sids[i],
-                                                    cur[sids[i]])
-                    if cp is not None:
-                        cow.append(cp)
-            if not rows:
+    # ------------------------------------------------------------------
+    # chunked-prefill scheduler: rows -> one mixed dispatch
+    # ------------------------------------------------------------------
+    def _schedule_rows(self):
+        """Build one mixed step's row list (caller holds the engine
+        lock): every fully-prefilled live sequence gets a decode row
+        (one token, allocator extended, COW-guarded), then the
+        remaining ``chunk_budget`` fills with prefill chunks of at most
+        ``chunk_block`` tokens each, FIFO by admission — a long prompt
+        may take several chunk rows of ONE dispatch when the budget
+        allows, and what doesn't fit waits for the next step, so a
+        10k-token prompt never stalls a live decode for more than one
+        budget. Returns (rows, cow) where each row is
+        ``(req, sid, start, n, toks, is_decode)``."""
+        live = [r for r in self._live.values() if not r.done]
+        decode = [r for r in live if r._prefilled >= len(r.prompt_ids)]
+        prefill = [r for r in live if r._prefilled < len(r.prompt_ids)]
+        decode = self._relieve_pressure(decode, 1)
+        rows, cow = [], []
+        budget = self.chunk_budget
+        for r in decode:
+            sid = r.seq_id
+            self.alloc.extend(sid, 1)
+            # copy-on-write backstop: the write position must never
+            # land in a page shared with the prefix cache
+            cp = self.alloc.ensure_writable(sid,
+                                            self.alloc._lens[sid] - 1)
+            if cp is not None:
+                cow.append(cp)
+            start = self.alloc._lens[sid] - 1
+            tok = r.output_ids[-1] if r.output_ids \
+                else int(r.prompt_ids[-1])
+            rows.append((r, sid, start, 1, (tok,), True))
+            budget -= 1
+        for r in prefill:
+            if budget <= 0 or len(rows) >= self.rows_cap:
                 break
-            for old, new in cow:
-                self._copy_page(old, new)
-            tokens = np.zeros((b, 1), np.int64)
-            tables = np.full((b, self.width), self.trash_page, np.int32)
-            lens = np.ones((b,), np.int32)
-            for i, r in rows:
-                sid = sids[i]
-                t = self.alloc._tables[sid]
-                tables[i, :len(t)] = t
-                lens[i] = cur[sid] + 1      # context incl. this token
-                tokens[i, 0] = int(r.prompt_ids[cur[sid]])
+            off = int(r._prefilled)
+            n_total = len(r.prompt_ids)
+            # defensive copy-on-write for the chunk's first position:
+            # page-aligned prefix matches always continue into pages
+            # this sequence owns, but a shared page must stay immutable
+            # regardless
+            cp = self.alloc.ensure_writable(r.seq_id, off)
+            if cp is not None:
+                cow.append(cp)
+            while off < n_total and budget > 0 \
+                    and len(rows) < self.rows_cap:
+                n = min(self.chunk_block, n_total - off, budget)
+                toks = tuple(int(x) for x in r.prompt_ids[off:off + n])
+                rows.append((r, r.seq_id, off, n, toks, False))
+                off += n
+                budget -= n
+        return rows, cow
+
+    def _dispatch_rows(self, rows, cow):
+        """Dispatch ONE mixed program over an already-scheduled row
+        list (caller holds the dispatch locks) and apply the results:
+        prefill progress, prefix-cache pins, emitted tokens. Returns
+        tokens emitted."""
+        any_prefill = any(not is_dec for *_, is_dec in rows)
+        if any_prefill:
+            t_cap, r_cap, qb = (self.chunk_budget, self.rows_cap,
+                                self.chunk_block)
+        else:
+            t_cap, r_cap, qb = self.max_batch, self.max_batch, 1
+        for old, new in cow:
+            self._copy_page(old, new)
+        key = ("mixed", t_cap)
+        cold = key not in self._warmed_keys
+        if cold and self._m["ttft"] is not _om.NULL:
+            # compile this token shape OUTSIDE the TTFT window: a dummy
+            # dispatch (all page writes land in the trash page, emitted
+            # tokens discarded) triggers the one-time trace + compile,
+            # and the affected clocks shift past it so TTFT keeps one
+            # honest sample per request without the multi-second
+            # compile skewing the histogram's +Inf bucket forever.
+            # Under PADDLE_TPU_METRICS=0 this is skipped (zero-cost
+            # mandate) and the cold dispatch just skips tpot.
+            t_w = time.perf_counter()
+            self._warm_mixed(t_cap)
+            warm_dur = time.perf_counter() - t_w
             with self._lock:
-                self._in_dispatch = True
-            try:
-                with no_grad(), _span("serving.suffix_prefill",
-                                      rows=len(rows)):
-                    nxt, new_k, new_v = step(
-                        Tensor(jnp.asarray(tokens)),
-                        Tensor(jnp.asarray(tables)),
-                        Tensor(jnp.asarray(lens)),
-                        self.k_pools, self.v_pools)
-            finally:
-                with self._lock:
-                    self._in_dispatch = False
-            self._flush_deferred()
-            self.k_pools, self.v_pools = list(new_k), list(new_v)
-            out = np.asarray(nxt._data).reshape(-1)
-            for i, r in rows:
-                sid = sids[i]
-                cur[sid] += 1
-                if cur[sid] >= total[sid] and not r.done \
-                        and r.seq_id == sid:
-                    self._emit(r, int(out[i]))
-        # chain extension: a warm prompt longer than its cached prefix
-        # contributes its additional full pages
-        if self.prefix is not None:
-            self._prefix_insert(reqs, sids)
-        self._expire_deadlines()
-        self._set_pool_gauges()
-
-    # ------------------------------------------------------------------
-    # decode
-    # ------------------------------------------------------------------
-    def _decode_step(self, tokens, tables, lens, k_pools, v_pools):
-        """Batched one-token decode: pure in its inputs so ``to_static``
-        compiles it once. tokens [B, 1] int64; tables [B, W]; lens [B]."""
-        from ..tensor import search
-
-        m = self.model.model
-        cfg = self.model.config
-        b = tokens.shape[0]
-        pos = (lens.astype("int64") - 1).reshape([b, 1])
-        page_ids = self._gather_tables(tables, lens)
-        offs = (lens - 1).astype("int32") % self.page_size
-        x = m.embed_tokens(tokens)
-        new_k, new_v = [], []
-        for li, layer in enumerate(m.layers):
-            h = layer.input_layernorm(x)
-            att = layer.self_attn
-            q = att.q_proj(h).reshape([b, 1, att.num_heads, att.head_dim])
-            k = att.k_proj(h).reshape([b, 1, att.num_kv_heads, att.head_dim])
-            v = att.v_proj(h).reshape([b, 1, att.num_kv_heads, att.head_dim])
-            q, k, v = FI.fused_rotary_position_embedding(
-                q, k, v, position_ids=pos, rotary_emb_base=cfg.rope_theta)
-            kp = _page_write(k_pools[li], k[:, 0], page_ids, offs)
-            vp = _page_write(v_pools[li], v[:, 0], page_ids, offs)
-            new_k.append(kp)
-            new_v.append(vp)
-            attn = paged_attention(q[:, 0], kp, vp, tables, lens)
-            x = x + att.o_proj(attn.reshape([b, 1, -1]))
-            x = x + layer.mlp(layer.post_attention_layernorm(x))
-        x = m.norm(x)
-        logits = self.model._logits(x)
-        nxt = search.argmax(logits, axis=-1).astype("int64")
-        return nxt, new_k, new_v
-
-    def _gather_tables(self, tables, lens):
-        """Page id holding each row's current token:
-        ``tables[b, (len-1) // page_size]``."""
-        page = self.page_size
-
-        def fn(tables, lens):
-            b = tables.shape[0]
-            idx = (lens.astype(jnp.int32) - 1) // page
-            return tables[jnp.arange(b), idx]
-
-        return run_op("paged_table_gather", fn, (tables, lens),
-                      differentiable=False)
+                for r in {row[0] for row in rows}:
+                    if r._t_admit is not None:
+                        r._t_admit += warm_dur
+                    if r._expires_at is not None:
+                        # the deadline clock starts at admission;
+                        # compile warmup is engine overhead, not
+                        # request time
+                        r._expires_at += warm_dur
+            cold = False
+        # host-built metadata: reads of the allocator's tables are safe
+        # here — cross-thread releases defer past the whole _entry
+        tokens = np.zeros((1, t_cap), np.int64)
+        pos = np.zeros((1, t_cap), np.int32)
+        page_ids = np.full((t_cap,), self.trash_page, np.int32)
+        offs = np.zeros((t_cap,), np.int32)
+        row_tok = np.zeros((r_cap, qb), np.int32)
+        flat_idx = np.full((t_cap,), r_cap * qb - 1, np.int32)
+        last_idx = np.zeros((r_cap,), np.int32)
+        tables = np.full((r_cap, self.width), self.trash_page, np.int32)
+        kv_lens = np.zeros((r_cap,), np.int32)
+        q_starts = np.zeros((r_cap,), np.int32)
+        q_lens = np.zeros((r_cap,), np.int32)
+        t = 0
+        for i, (r, sid, start, n, toks, is_dec) in enumerate(rows):
+            tb = self.alloc._tables[sid]
+            tables[i, :len(tb)] = tb
+            kv_lens[i] = start + n
+            q_starts[i] = start
+            q_lens[i] = n
+            pg, of = self.alloc.page_positions(sid, start, n)
+            tokens[0, t:t + n] = toks
+            pos[0, t:t + n] = start + np.arange(n)
+            page_ids[t:t + n] = pg
+            offs[t:t + n] = of
+            row_tok[i, :n] = np.arange(t, t + n)
+            flat_idx[t:t + n] = i * qb + np.arange(n)
+            t += n
+            last_idx[i] = t - 1
+        self._record_shape("mixed", t_cap)
+        sf = self._ensure_mixed_compiled()
+        self._arm_watchdog(cold)
+        with self._lock:
+            self._in_dispatch = True
+        t0 = time.perf_counter()
+        try:
+            with no_grad(), _span("serving.mixed_step", rows=len(rows),
+                                  tokens=int(t), prefill=any_prefill):
+                nxt, new_k, new_v = sf(
+                    Tensor(jnp.asarray(tokens)),
+                    Tensor(jnp.asarray(pos)),
+                    Tensor(jnp.asarray(page_ids)),
+                    Tensor(jnp.asarray(offs)),
+                    Tensor(jnp.asarray(row_tok)),
+                    Tensor(jnp.asarray(flat_idx)),
+                    Tensor(jnp.asarray(last_idx)),
+                    Tensor(jnp.asarray(tables)),
+                    Tensor(jnp.asarray(kv_lens)),
+                    Tensor(jnp.asarray(q_starts)),
+                    Tensor(jnp.asarray(q_lens)),
+                    self.k_pools, self.v_pools)
+        finally:
+            with self._lock:
+                self._in_dispatch = False
+            dur = time.perf_counter() - t0
+            self._disarm_watchdog(dur, cold=cold)
+            self._warmed_keys.add(key)
+        self._flush_deferred()
+        self.k_pools, self.v_pools = list(new_k), list(new_v)
+        out = np.asarray(nxt._data).reshape(-1)
+        if not cold and not any_prefill:
+            # a pure-decode dispatch is one token per live row: honest
+            # per-token latency. Mixed dispatches carry prefill work
+            # and would skew the histogram.
+            self._m["tpot"].observe(dur)
+            self._token_times.append(dur)
+        finished, fin_sids = [], []
+        with self._lock:
+            for (r, sid, start, n, toks, is_dec) in rows:
+                if is_dec or r.done or r.seq_id != sid:
+                    continue
+                # the seq_id check drops rows whose request was evicted
+                # and requeued mid-dispatch — its reset progress must
+                # not be advanced by this stale chunk
+                self._m["prefill_tokens"].inc(n)
+                r._prefilled = max(r._prefilled, start + n)
+                if r._prefilled >= len(r.prompt_ids) \
+                        and r not in finished:
+                    finished.append(r)
+                    fin_sids.append(sid)
+        # pin finished prompts' pages in the prefix cache BEFORE
+        # emitting: a max_new_tokens=1 request retires (and releases)
+        # at emit, and its prefix must still make it into the cache
+        if finished and self.prefix is not None:
+            self._prefix_insert(finished, fin_sids)
+        emitted = 0
+        for i, (r, sid, start, n, toks, is_dec) in enumerate(rows):
+            if r.done or r.seq_id != sid:
+                continue
+            if is_dec or (start + n) >= len(r.prompt_ids):
+                # decode rows and FINAL prompt chunks emit; a mid-
+                # prompt chunk's argmax is meaningless and discarded
+                self._emit(r, int(out[i]))
+                emitted += 1
+        return emitted
 
     # ------------------------------------------------------------------
     # stuck-dispatch watchdog
@@ -993,7 +1030,8 @@ class LlamaServingEngine:
                  cfg.num_hidden_layers, cfg.num_attention_heads,
                  cfg.num_key_value_heads, cfg.head_dim,
                  float(cfg.rope_theta), self.max_batch, self.page_size,
-                 self.width, len(self.k_pools) and
+                 self.width, self.chunk_budget, self.chunk_block,
+                 len(self.k_pools) and
                  tuple(self.k_pools[0]._data.shape), dt)
         return "llama:" + hashlib.sha1(
             repr(parts).encode()).hexdigest()[:16]
@@ -1014,52 +1052,50 @@ class LlamaServingEngine:
         except Exception:
             pass            # registry IO must never fail a dispatch
 
-    def _warm_prefill_bucket(self, bucket):
-        """Compile the [max_batch, bucket] prefill program via a dummy
-        dispatch: every page write lands in the trash page and the
+    def _warm_mixed(self, t_cap):
+        """Compile one mixed-program token shape via a dummy dispatch:
+        every row is inactive (kv_len 0 — the ragged kernel emits
+        zeros), every page write lands in the trash page and the
         emitted tokens are discarded, so no request state is touched.
-        The prefill program donates its pool inputs — the returned
-        pools must replace ours."""
-        b = self.max_batch
-        if self._prefill_static is None:
-            from ..jit import StaticFunction
-
-            # no lazy state (params exist, no optimizer): skip the eager
-            # warmup and compile directly; donate pools for in-place
-            # page writes
-            self._prefill_static = StaticFunction(
-                self._prefill_forward, state=[self.model], warmup="once",
-                donate_inputs=True, name="serving.prefill")
-            self._prefill_static._warmed_any = True
+        The program donates its pool inputs — the returned pools must
+        replace ours. Returns False for a token count that doesn't
+        match this engine's geometry (a stale registry entry)."""
+        t_cap = int(t_cap)
+        if t_cap == self.chunk_budget:
+            r_cap, qb = self.rows_cap, self.chunk_block
+        elif t_cap == self.max_batch:
+            r_cap, qb = self.max_batch, 1
+        else:
+            return False
+        sf = self._ensure_mixed_compiled()
         with no_grad():
-            _, wk, wv = self._prefill_static(
-                Tensor(jnp.asarray(np.zeros((b, bucket), np.int64))),
-                Tensor(jnp.asarray(np.zeros((b,), np.int32))),
-                Tensor(jnp.asarray(np.full((b, bucket),
+            _, wk, wv = sf(
+                Tensor(jnp.asarray(np.zeros((1, t_cap), np.int64))),
+                Tensor(jnp.asarray(np.zeros((1, t_cap), np.int32))),
+                Tensor(jnp.asarray(np.full((t_cap,), self.trash_page,
+                                           np.int32))),
+                Tensor(jnp.asarray(np.zeros((t_cap,), np.int32))),
+                Tensor(jnp.asarray(np.zeros((r_cap, qb), np.int32))),
+                Tensor(jnp.asarray(np.zeros((t_cap,), np.int32))),
+                Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
+                Tensor(jnp.asarray(np.full((r_cap, self.width),
                                            self.trash_page, np.int32))),
-                Tensor(jnp.asarray(np.zeros((b, bucket), np.int32))),
+                Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
+                Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
+                Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
                 self.k_pools, self.v_pools)
         self.k_pools, self.v_pools = list(wk), list(wv)
-        self._prefill_warm_buckets.add(bucket)
-        self._record_shape("prefill", bucket)
+        self._warmed_keys.add(("mixed", t_cap))
+        self._warm_dispatches += 1
+        self._record_shape("mixed", t_cap)
+        return True
 
-    def _warm_decode(self):
-        """Compile the decode-step program via a dummy dispatch (trash
-        page writes, outputs discarded — decode does not donate)."""
+    def _warm_scan(self, n):
+        """Compile the n-tick decode-scan program via a dummy dispatch
+        (trash tables, lens 1). The scan donates its pool inputs —
+        reassign from the outputs."""
         b = self.max_batch
-        step = self._ensure_decode_compiled()
-        with no_grad():
-            step(Tensor(jnp.asarray(np.zeros((b, 1), np.int64))),
-                 Tensor(jnp.asarray(np.full(
-                     (b, self.width), self.trash_page, np.int32))),
-                 Tensor(jnp.asarray(np.ones((b,), np.int32))),
-                 self.k_pools, self.v_pools)
-
-    def _warm_burst(self, n):
-        """Compile the n-step burst program via a dummy dispatch. The
-        burst donates its pool inputs — reassign from the outputs."""
-        b = self.max_batch
-        sf = self._ensure_burst_compiled(n)
+        sf = self._ensure_scan_compiled(int(n))
         with no_grad():
             out = sf(Tensor(jnp.asarray(np.zeros((b, 1), np.int64))),
                      Tensor(jnp.asarray(np.full(
@@ -1069,56 +1105,64 @@ class LlamaServingEngine:
         n_layers = len(self.k_pools)
         self.k_pools = list(out[1:1 + n_layers])
         self.v_pools = list(out[1 + n_layers:])
+        self._warmed_keys.add(("scan", int(n)))
+        self._warm_dispatches += 1
 
-    def prewarm(self, prefill_buckets=None, bursts=None, decode=None):
+    def prewarm(self, mixed=None, scans=None):
         """Compile this engine's serving programs BEFORE traffic
         arrives, so a replacement replica's first request pays
         milliseconds, not the full compile bill. With no arguments the
-        recipe comes from the persistent shape registry — the prefill
-        buckets, burst lengths and decode program a previous engine of
-        identical geometry actually dispatched (recorded as they
-        compiled). Combined with the persistent compilation cache these
-        compiles are disk hits on a warm host (``compile_cache_hit_
-        total``), which is what turns an ~19 s restart into seconds.
+        recipe comes from the persistent shape registry — the
+        mixed-program token shapes and decode-scan tick counts an
+        engine of identical geometry actually dispatched (recorded as
+        they compiled). Combined with the persistent compilation cache
+        these compiles are disk hits on a warm host
+        (``compile_cache_hit_total``), which is what turns an ~19 s
+        restart into seconds.
 
-        Returns ``{"prefill": [...], "burst": [...], "decode": bool}``
-        — what was warmed (also kept on ``self.prewarmed``)."""
-        if prefill_buckets is None and bursts is None and decode is None:
+        Returns ``{"mixed": [...], "scan": [...]}`` — what was warmed
+        (also kept on ``self.prewarmed``)."""
+        if mixed is None and scans is None:
             recipe = {}
             try:
                 recipe = _cw.shape_registry().lookup(self._shape_key) \
                     if self._cache_dir is not None else {}
             except Exception:
                 recipe = {}
-            prefill_buckets = recipe.get("prefill", ())
-            bursts = recipe.get("burst", ())
-            decode = bool(recipe.get("decode"))
-        done = {"prefill": [], "burst": [], "decode": False}
+            mixed = recipe.get("mixed", ())
+            scans = recipe.get("scan", ())
+        done = {"mixed": [], "scan": []}
         with self._dispatch_lock, _CROSS_ENGINE_LOCK, \
-                _span("serving.prewarm",
-                      prefill=len(prefill_buckets or ()),
-                      burst=len(bursts or ())):
-            for bucket in sorted(set(prefill_buckets or ())):
-                self._warm_prefill_bucket(int(bucket))
-                done["prefill"].append(int(bucket))
-            if decode:
-                self._warm_decode()
-                done["decode"] = True
-            for n in sorted(set(bursts or ())):
-                self._warm_burst(int(n))
-                done["burst"].append(int(n))
+                _span("serving.prewarm", mixed=len(mixed or ()),
+                      scan=len(scans or ())):
+            for t_cap in sorted(set(mixed or ())):
+                if self._warm_mixed(int(t_cap)):
+                    done["mixed"].append(int(t_cap))
+            for n in sorted(set(scans or ())):
+                self._warm_scan(int(n))
+                done["scan"].append(int(n))
         self.prewarmed = done
         return done
 
     # ------------------------------------------------------------------
     # scheduling
     # ------------------------------------------------------------------
+    def prefill_backlog(self):
+        """Prompt tokens admitted but not yet written to pages — the
+        chunked scheduler's pending prefill work. A routing signal for
+        the cluster's load-aware router: a replica chewing through a
+        long prompt is busier than its live count suggests."""
+        with self._lock:
+            return sum(max(0, len(r.prompt_ids) - r._prefilled)
+                       for r in self._live.values() if not r.done)
+
     def _set_pool_gauges(self):
         self._m["queue_depth"].set(len(self._live))
+        self._m["prefill_backlog"].set(self.prefill_backlog())
         self._m["kv_util"].set(
             1.0 - self.alloc.free_pages / self.alloc.num_pages)
         if _om.enabled():
-            # per-wave device-memory accounting (host metadata walks
+            # per-dispatch device-memory accounting (host metadata walks
             # only, no sync), throttled so the live-array enumeration
             # never rides the per-token decode path, + a rate-limited
             # flight-recorder snapshot
@@ -1207,6 +1251,11 @@ class LlamaServingEngine:
                     if self.alloc.free_pages < need:
                         return "KV page pool exhausted"
             req._cached_tokens = cached
+            # stamp the prefill cursor BEFORE the request becomes
+            # visible in _live: a concurrent dispatch thread must never
+            # see a warm request at _prefilled 0 and schedule chunks
+            # over its still-shared cached-prefix pages
+            req._prefilled = cached
             self._live[req.seq_id] = req
             req.status = "live"
             if self.prefix is not None:
@@ -1262,6 +1311,7 @@ class LlamaServingEngine:
                 v._t_admit = None
                 v._expires_at = None
                 v._cached_tokens = 0    # re-matched at re-admission
+                v._prefilled = 0        # KV is gone; prefill restarts
                 # a fresh seq_id on re-admission: the old id may still
                 # have a deferred page release in flight
                 v.seq_id = None
@@ -1335,12 +1385,12 @@ class LlamaServingEngine:
         return live
 
     def _pump_requeue(self):
-        """Continuous-batching re-admission at step/burst boundaries:
+        """Continuous-batching re-admission at step boundaries:
         requests the ladder parked on the requeue rejoin the batch as
         capacity allows, so plain ``add_request()`` + ``step()``
         drivers (no :meth:`generate` loop) never strand an evicted
-        request in limbo. Everything admitted prefills as ONE wave."""
-        admitted = []
+        request in limbo. Re-admitted prompts prefill as ordinary
+        chunks of the very next mixed dispatch — no separate wave."""
         while True:
             with self._lock:
                 if self._draining or not self._requeue \
@@ -1358,9 +1408,6 @@ class LlamaServingEngine:
                 with self._lock:
                     self._requeue.appendleft(nxt)
                 break
-            admitted.append(nxt)
-        if admitted:
-            self._prefill_wave(admitted)
 
     def _admit(self, req):
         """Admit one request, walking the degradation ladder under
@@ -1413,7 +1460,7 @@ class LlamaServingEngine:
                     continue
             if reason != "draining":
                 if not quiet_retry and attempt < self.admit_retries:
-                    # bounded backoff: a concurrent step()/burst may
+                    # bounded backoff: a concurrent step()/scan may
                     # retire a request and release its pages before the
                     # retry
                     attempt += 1
@@ -1444,16 +1491,20 @@ class LlamaServingEngine:
                 ttl = budget if ttl is None else min(ttl, budget)
             req._expires_at = None if ttl is None else now + ttl
         self._m["admitted"].inc()
-        # cached-prefix tokens are NOT prefilled — only the suffix is
-        self._m["prefill_tokens"].inc(
-            len(req.prompt_ids) - req._cached_tokens)
+        # prefill_tokens counts per APPLIED chunk in _dispatch_rows —
+        # under chunked prefill, admission no longer implies the work
         self._set_pool_gauges()
         return req.seq_id
 
     def add_request(self, req):
-        """Admit a request (prefill immediately). Returns its seq_id."""
+        """Admit a request and drive the chunked prefill through to its
+        first emitted token (the admission-prefills-immediately
+        contract; live decodes ride along in the same mixed dispatches,
+        chunk by chunk). Returns its seq_id."""
         sid = self._admit(req)
-        self._prefill_wave([req])
+        while not req.done and req._prefilled < len(req.prompt_ids):
+            if self.step() == 0:
+                break       # nothing dispatchable (drained/expired)
         return sid
 
     def _emit(self, req, token):
@@ -1466,141 +1517,92 @@ class LlamaServingEngine:
                 or len(req.output_ids) >= req.max_new_tokens:
             if self._retire(req, "completed"):
                 self._m["completed"].inc()
-        # pool gauges are refreshed once per wave/step/burst by the
+        # pool gauges are refreshed once per dispatch by the
         # caller, not per emitted token — only the post-loop value is
         # observable anyway
 
-    def _views_np(self, sids):
-        """Padded (tables, lens) numpy views for the full [max_batch]
-        slot layout — pure host work, ONE H2D per array. Takes the
-        dispatch's seq-id snapshot, not live Request objects, so
-        concurrent lifecycle transitions can't tear the view."""
-        b = self.max_batch
-        tables = np.full((b, self.width), self.trash_page, np.int32)
-        lens = np.ones((b,), np.int32)
-        for i, sid in enumerate(sids):
-            t = self.alloc._tables[sid]
-            tables[i, :len(t)] = t
-            lens[i] = self.alloc._lens[sid]
-        return tables, lens
-
-    def _ensure_decode_compiled(self):
-        if self._decode_static is None:
-            from .. import jit
-            self._decode_static = jit.to_static(
-                self._decode_step, state=[self.model], warmup="once",
-                name="serving.decode_step")
-            self._record_shape("decode", True)
-        return self._decode_static
+    def step(self):
+        """Advance the engine by ONE mixed dispatch: every live
+        fully-prefilled sequence decodes one token and pending prompt
+        chunks pack into the remaining ``chunk_budget``. Returns the
+        number of rows dispatched (0 = nothing live)."""
+        return self._mixed_step()[0]
 
     @_fatal_guard("serving.step")
-    def step(self):
-        """Decode one token for every live request. Returns the number of
-        live requests served."""
+    def _mixed_step(self):
+        """One mixed dispatch. Returns (rows dispatched, tokens
+        emitted) — a dispatch that only advanced mid-prompt chunks
+        reports rows > 0 with emitted == 0."""
         with self._entry(), self._dispatch_lock, _CROSS_ENGINE_LOCK:
             self._expire_deadlines()
             self._pump_requeue()
             with self._lock:
                 if not any(not r.done for r in self._live.values()):
-                    return 0
+                    return 0, 0
             # before any allocator mutation: an injected raise aborts
             # the dispatch cleanly instead of leaving lens advanced
             # with no K/V written
             _faults.fire("serve.decode", step=self._dispatch_count)
             self._dispatch_count += 1
             with self._lock:
-                live = [r for r in self._live.values() if not r.done]
-                live = self._relieve_pressure(live, 1)
-                # seq ids and last tokens are snapshotted under the
-                # lock: a concurrent cancel/evict may null seq_id or
-                # swap output_ids mid-setup, but this dispatch keeps
-                # reading its own consistent view (the pages stay
-                # reserved — cross-thread releases defer past _entry)
-                sids = [r.seq_id for r in live]
-                last_tok = [r.output_ids[-1] if r.output_ids
-                            else int(r.prompt_ids[-1]) for r in live]
-                # account the new token while still holding the lock:
-                # _relieve_pressure proved the pages exist, and the lock
-                # keeps a concurrent admission from consuming them
-                # between the proof and the extend
-                cow = []
-                for sid in sids:
-                    self.alloc.extend(sid, 1)
-                    # copy-on-write backstop: the write position must
-                    # never land in a page shared with the prefix cache
-                    cp = self.alloc.ensure_writable(
-                        sid, self.alloc._lens[sid] - 1)
-                    if cp is not None:
-                        cow.append(cp)
-            if not live:
-                return 0
-            for old, new in cow:
-                self._copy_page(old, new)
-            # a cold call traces + compiles inside the timed window; that
-            # one-time multi-second sample would skew the tpot histogram
-            # (top bucket 10s) forever, so it is not observed
-            cold = self._decode_static is None
-            t0 = time.perf_counter()
-            tokens = np.zeros((self.max_batch, 1), np.int64)
-            for i, t in enumerate(last_tok):
-                tokens[i, 0] = t
-            tables, lens = self._views_np(sids)
-            step = self._ensure_decode_compiled()
-            self._arm_watchdog(cold)
-            with self._lock:
-                self._in_dispatch = True
-            try:
-                with _span("serving.decode_step", live=len(live)):
-                    nxt, new_k, new_v = step(
-                        Tensor(jnp.asarray(tokens)),
-                        Tensor(jnp.asarray(tables)),
-                        Tensor(jnp.asarray(lens)),
-                        self.k_pools, self.v_pools)
-            finally:
-                with self._lock:
-                    self._in_dispatch = False
-                dur = time.perf_counter() - t0
-                self._disarm_watchdog(dur, cold=cold)
-            self._flush_deferred()
-            self.k_pools, self.v_pools = list(new_k), list(new_v)
-            out = np.asarray(nxt._data).reshape(-1)
-            if not cold:
-                self._m["tpot"].observe(dur)
-                self._token_times.append(dur)
-            for i, r in enumerate(live):
-                # the seq_id check drops rows whose request was evicted
-                # and requeued mid-dispatch — its (cleared) output must
-                # not receive this stale token
-                if not r.done and r.seq_id == sids[i]:
-                    self._emit(r, int(out[i]))
+                # rows are snapshotted under the lock: a concurrent
+                # cancel/evict may null seq_id or swap output_ids
+                # mid-setup, but this dispatch keeps reading its own
+                # consistent view (the pages stay reserved —
+                # cross-thread releases defer past _entry); the decode
+                # extends happen while still holding the lock, so a
+                # concurrent admission can't consume the pages between
+                # _relieve_pressure's proof and the extend
+                rows, cow = self._schedule_rows()
+            if not rows:
+                return 0, 0
+            emitted = self._dispatch_rows(rows, cow)
             self._expire_deadlines()
             self._set_pool_gauges()
-            return len(live)
+            return len(rows), emitted
 
     # ------------------------------------------------------------------
-    # burst decode: n steps = ONE compiled program (lax.scan)
+    # decode scan: n all-decode ticks = ONE compiled program (lax.scan)
     # ------------------------------------------------------------------
-    def _decode_burst_fn(self, n):
-        """Build the n-step burst: ``lax.scan`` whose body is the SAME
-        Tensor-level :meth:`_decode_step` (traced, not re-implemented —
-        parity with the per-step program is by construction). The carry
-        is (tokens, lens, pools); tables are scan-invariant because
-        pages for the whole burst are reserved before launch."""
+    def _decode_scan_fn(self, n):
+        """Build the n-tick decode scan: ``lax.scan`` whose body is the
+        SAME Tensor-level :meth:`_mixed_forward` specialized to the
+        decode-only shape (T == R == max_batch, QB == 1) — parity with
+        the per-step program is by construction, and the dispatch path
+        stays singular. The carry is (tokens, lens, pools); tables are
+        scan-invariant because pages for the whole run are reserved
+        before launch; per-tick write positions derive from the length
+        carry on device."""
         import jax
+
+        page = self.page_size
 
         def fn(tokens, tables, lens, k_pools, v_pools):
             tab = tables._data
-            kp = [t._data for t in k_pools]
-            vp = [t._data for t in v_pools]
+            b = tab.shape[0]
+            kp = [x._data for x in k_pools]
+            vp = [x._data for x in v_pools]
+            rows = jnp.arange(b, dtype=jnp.int32)
+            row_tok = rows.reshape(b, 1)
+            ones = jnp.ones((b,), jnp.int32)
 
             def body(carry, _):
                 tok, lc, kc, vc = carry
-                nxt, nk, nv = self._decode_step(
-                    Tensor(tok), Tensor(tab), Tensor(lc),
+                start = (lc - 1).astype(jnp.int32)
+                pids = tab[rows, jnp.clip(start // page, 0,
+                                          tab.shape[1] - 1)]
+                offs = (start % page).astype(jnp.int32)
+                nxt, nk, nv = self._mixed_forward(
+                    Tensor(tok.reshape(1, b)),
+                    Tensor(start.reshape(1, b)),
+                    Tensor(pids), Tensor(offs), Tensor(row_tok),
+                    Tensor(rows), Tensor(rows), Tensor(tab),
+                    Tensor(lc.astype(jnp.int32)), Tensor(start),
+                    Tensor(ones),
                     [Tensor(a) for a in kc], [Tensor(a) for a in vc])
                 nxt_arr = nxt._data.reshape(tok.shape).astype(tok.dtype)
                 return ((nxt_arr, lc + 1,
-                         [t._data for t in nk], [t._data for t in nv]),
+                         [x._data for x in nk], [x._data for x in nv]),
                         nxt_arr[:, 0])
 
             (_, _, kf, vf), toks = jax.lax.scan(
@@ -1609,30 +1611,30 @@ class LlamaServingEngine:
 
         return fn
 
-    def _ensure_burst_compiled(self, n):
-        sf = self._burst_static.get(n)
+    def _ensure_scan_compiled(self, n):
+        sf = self._scan_static.get(n)
         if sf is None:
             from ..jit import StaticFunction
 
-            sf = StaticFunction(self._decode_burst_fn(n),
+            sf = StaticFunction(self._decode_scan_fn(n),
                                 state=[self.model], warmup="once",
                                 donate_inputs=True,
-                                name=f"serving.decode_burst[{n}]")
+                                name=f"serving.mixed_scan[{n}]")
             # no lazy state to materialize (params exist; no optimizer):
             # skip the eager warmup — n scanned steps of per-op dispatch
             # would cost more than the compile it avoids
             sf._warmed_any = True
-            self._burst_static[n] = sf
-            self._record_shape("burst", n)
+            self._scan_static[n] = sf
+            self._record_shape("scan", n)
         return sf
 
-    @_fatal_guard("serving.burst")
-    def _burst(self, n):
-        """Decode ``n`` tokens for every live request in one dispatch.
-        Pages for all n tokens are reserved up front; requests that
-        retire mid-burst (EOS / max_new_tokens / expired deadline) have
-        their tail tokens discarded at emit time — bounded waste, no
-        correctness impact."""
+    @_fatal_guard("serving.decode_scan")
+    def _decode_scan(self, n):
+        """Decode ``n`` tokens for every live (fully-prefilled) request
+        in one dispatch. Pages for all n tokens are reserved up front;
+        requests that retire mid-scan (EOS / max_new_tokens / expired
+        deadline) have their tail tokens discarded at emit time —
+        bounded waste, no correctness impact."""
         with self._entry(), self._dispatch_lock, _CROSS_ENGINE_LOCK:
             self._expire_deadlines()
             self._pump_requeue()
@@ -1644,17 +1646,18 @@ class LlamaServingEngine:
             _faults.fire("serve.decode", step=self._dispatch_count)
             self._dispatch_count += 1
             with self._lock:
-                live = [r for r in self._live.values() if not r.done]
+                live = [r for r in self._live.values() if not r.done
+                        and r._prefilled >= len(r.prompt_ids)]
                 live = self._relieve_pressure(live, n)
                 sids = [r.seq_id for r in live]
                 last_tok = [r.output_ids[-1] if r.output_ids
                             else int(r.prompt_ids[-1]) for r in live]
-                # reserve the whole burst under the lock (see step())
+                # reserve the whole scan under the lock (see step())
                 start_lens = {sid: self.alloc._lens[sid] for sid in sids}
                 cow = []
                 for sid in sids:
                     self.alloc.extend(sid, n)
-                    # only the burst's FIRST write position can sit in
+                    # only the scan's FIRST write position can sit in
                     # a pre-existing (possibly shared) page; the rest
                     # land in pages this extend just allocated
                     cp = self.alloc.ensure_writable(sid, start_lens[sid])
@@ -1664,9 +1667,10 @@ class LlamaServingEngine:
                 return 0
             for old, new in cow:
                 self._copy_page(old, new)
-            # as in step(): each new burst length compiles on its first
+            # as in step(): each new scan length compiles on its first
             # call — don't let that land n inflated samples in tpot
-            cold = n not in self._burst_static
+            key = ("scan", n)
+            cold = key not in self._warmed_keys
             t0 = time.perf_counter()
             b = self.max_batch
             tables = np.full((b, self.width), self.trash_page, np.int32)
@@ -1677,13 +1681,13 @@ class LlamaServingEngine:
                 tables[i, :len(t)] = t
                 lens[i] = start_lens[sid] + 1       # first new token incl.
                 tokens[i, 0] = last_tok[i]
-            sf = self._ensure_burst_compiled(n)
+            sf = self._ensure_scan_compiled(n)
             self._arm_watchdog(cold)
             with self._lock:
                 self._in_dispatch = True
             try:
-                with no_grad(), _span("serving.decode_burst",
-                                      live=len(live), burst=n):
+                with no_grad(), _span("serving.decode_scan",
+                                      live=len(live), ticks=n):
                     out = sf(
                         Tensor(jnp.asarray(tokens)),
                         Tensor(jnp.asarray(tables)),
@@ -1694,6 +1698,7 @@ class LlamaServingEngine:
                     self._in_dispatch = False
                 dur = time.perf_counter() - t0
                 self._disarm_watchdog(dur, cold=cold)
+                self._warmed_keys.add(key)
             self._flush_deferred()
             n_layers = len(self.k_pools)
             toks = out[0]
@@ -1710,7 +1715,7 @@ class LlamaServingEngine:
             served = 0
             for i, r in enumerate(live):
                 for t in range(n):
-                    # done: retired mid-burst (EOS / budget); seq_id
+                    # done: retired mid-scan (EOS / budget); seq_id
                     # mismatch: evicted + requeued mid-dispatch — the
                     # stale tail must not land in its cleared output
                     if r.done or r.seq_id != sids[i]:
@@ -1721,8 +1726,8 @@ class LlamaServingEngine:
             self._set_pool_gauges()
             return served
 
-    def _burst_fits(self, live, n):
-        """Largest burst <= n whose page reservations fit the pool and
+    def _scan_fits(self, live, n):
+        """Largest scan <= n whose page reservations fit the pool and
         no sequence's per-seq table cap."""
         page = self.page_size
         for r in live:
@@ -1744,35 +1749,46 @@ class LlamaServingEngine:
         return n
 
     def decode_many(self, n, exact=True):
-        """``n`` decode steps for the current live set, chunked into
-        compiled scans: full :attr:`burst`-length bursts, then
-        burst/4-length bursts, then single steps. With ``exact=False``
-        the tail may overshoot by up to burst/4 - 1 ticks — callers use
-        this when every live request retires by step ``n`` (the
-        overshot ticks are discarded at emit time), trading a few idle
-        ticks for never paying the per-step dispatch round trip.
-        Returns tokens served."""
+        """``n`` decode steps for the current live set. While any live
+        prompt still has unprefilled chunks the engine takes single
+        mixed steps (chunks + decodes together); once the batch is all
+        decode it switches to compiled scans — full
+        :attr:`decode_ticks` runs, then ticks/4 runs, then single
+        steps. With ``exact=False`` the tail may overshoot by up to
+        ticks/4 - 1 — callers use this when every live request retires
+        by step ``n`` (the overshot ticks are discarded at emit time),
+        trading a few idle ticks for never paying the per-step dispatch
+        round trip. Returns tokens served."""
         served = 0
-        small = max(self.burst // 4, 2)
+        small = max(self.decode_ticks // 4, 2)
         while n > 0:
             with self._lock:
-                # _burst_fits reads the allocator's per-seq state: hold
+                # _scan_fits reads the allocator's per-seq state: hold
                 # the lock so a concurrent evict can't null a seq_id
                 # between the snapshot and the fit computation
                 live = [r for r in self._live.values() if not r.done]
-                if not live:
+                if not live and not self._requeue:
                     break
-                if n >= self.burst:
-                    chunk = self._burst_fits(live, self.burst)
+                prefilling = any(r._prefilled < len(r.prompt_ids)
+                                 for r in live)
+                if not live:
+                    chunk = 1       # pump parked requests via a step
+                elif prefilling:
+                    chunk = 1
+                elif n >= self.decode_ticks:
+                    chunk = self._scan_fits(live, self.decode_ticks)
                 elif n >= small or not exact:
-                    chunk = self._burst_fits(live, small)
+                    chunk = self._scan_fits(live, small)
                 else:
                     chunk = 1
             if chunk > 1:
-                served += self._burst(chunk)
+                served += self._decode_scan(chunk)
                 n -= chunk
             else:
-                served += self.step()
+                rows, emitted = self._mixed_step()
+                if rows == 0:
+                    break
+                served += emitted
                 n -= 1
         return served
 
@@ -1780,13 +1796,12 @@ class LlamaServingEngine:
     def generate(self, prompts, max_new_tokens=16, eos_token_id=None):
         """Convenience batch API: admit all prompts (continuous batching
         handles ragged finish times), run to completion, return output id
-        lists in order. Admissions happen in waves — every pending
-        request that fits prefills in ONE compiled call. Requests the
-        ladder re-queued are re-admitted ahead of new ones."""
+        lists in order. Every pending request that fits is admitted and
+        its prompt chunks pack into the shared mixed dispatches.
+        Requests the ladder re-queued are re-admitted ahead of new ones."""
         reqs = [Request(p, max_new_tokens, eos_token_id) for p in prompts]
         pending = list(reqs)
         while pending or any(not r.done for r in reqs):
-            wave = []
             while True:
                 with self._lock:
                     if len(self._live) >= self.max_batch:
@@ -1811,21 +1826,27 @@ class LlamaServingEngine:
                             self._requeue.appendleft(nxt)
                         break
                     raise
-                wave.append(nxt)
-            self._prefill_wave(wave)
-            live = [r for r in self._live.values() if not r.done]
+            with self._lock:
+                live = [r for r in self._live.values() if not r.done]
+                prefilling = any(r._prefilled < len(r.prompt_ids)
+                                 for r in live)
             if live:
-                # burst until the earliest possible retirement; with EOS
-                # or pending admissions cap at the burst length so a
+                if prefilling:
+                    # mixed steps until every admitted prompt is in:
+                    # prefill chunks and live decodes share dispatches
+                    self.step()
+                    continue
+                # scan until the earliest possible retirement; with EOS
+                # or pending admissions cap at decode_ticks so a
                 # retirement (and the admission it unblocks) is never
                 # far away. The tail may overshoot (exact=False): every
                 # live request retires by then, so overshot ticks are
                 # discarded, never mis-emitted.
-                burst = min(r.max_new_tokens - len(r.output_ids)
-                            for r in live)
+                run = min(r.max_new_tokens - len(r.output_ids)
+                          for r in live)
                 if pending or eos_token_id is not None:
-                    burst = min(burst, self.burst)
-                self.decode_many(burst, exact=False)
+                    run = min(run, self.decode_ticks)
+                self.decode_many(max(1, run), exact=False)
                 continue
             if not pending and all(r.done for r in reqs):
                 break
@@ -1938,7 +1959,7 @@ class LlamaServingEngine:
         or expire within ``grace`` seconds; then the process exits with
         ``exit_code`` (default 0 — a drained exit is a clean exit). A
         signal landing while a dispatch is in flight defers the drain
-        to the next wave/step/burst boundary, so engine state is never
+        to the next step/scan boundary, so engine state is never
         torn mid-update — mirroring the checkpoint callback's deferred
         emergency save. ``on_drained(stats)`` runs just before exit
         (e.g. to flush metrics).

@@ -210,7 +210,7 @@ def enable_persistent_cache(path=None):
     """Wire JAX's persistent compilation cache (ROADMAP item 5: kill the
     ~19 s cold start). Every backend compile is keyed by its HLO and
     stored under ``path``; a fresh process re-compiling the same serving
-    programs (prefill buckets, decode, bursts) gets executables back in
+    programs (mixed-step shapes, decode scans) gets executables back in
     seconds. Called once per process by the serving engine — set
     ``PADDLE_TPU_COMPILE_CACHE=0`` to opt out, or
     ``PADDLE_TPU_COMPILE_CACHE_DIR`` to relocate (replicas sharing a
@@ -267,7 +267,7 @@ class SignatureRegistry:
     The file is JSON ``{key: {kind: [values]}}`` where ``key`` names one
     compile surface (the serving engine hashes its model dims + batch
     geometry into it) and each ``kind`` collects the distinct values
-    seen (prefill bucket lengths, burst sizes, ...). Writes are
+    seen (mixed-program token shapes, decode-scan tick counts, ...). Writes are
     read-merge-replace with a write-aside temp file, mirroring the
     FileStore stamp protocol, so concurrent replicas on one host can
     record without tearing the file (a lost race drops one record until

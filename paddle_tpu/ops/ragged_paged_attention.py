@@ -1,0 +1,275 @@
+"""Ragged paged attention: ONE kernel for a mixed prefill+decode batch.
+
+Capability reference: *Ragged Paged Attention* (arXiv 2604.15464) — a
+single TPU kernel that consumes a batch of variable-length prefill
+chunks AND single-token decode rows over a shared paged KV pool, so a
+serving scheduler never has to serialize the two phases into separate
+dispatches. This is the kernel behind the chunked-prefill serving
+engine (`paddle_tpu/inference/serving.py`): every engine step is one
+dispatch of this kernel over rows described by per-row
+``(query_len, kv_len)`` metadata, whether the row is a 128-token
+prompt chunk or one decode token.
+
+Shapes (R rows, each a prefill chunk or a decode step of one sequence):
+  q             [R, QB, H, D]       per-row query block; rows are padded
+                                    to the static block QB — entries at
+                                    qi >= q_lens[r] are padding and come
+                                    back as zeros
+  k_pages       [P, Hk, page, D]    global pool, head-major (same layout
+                                    as `paged_attention`)
+  v_pages       [P, Hk, page, D]
+  block_tables  [R, W] int32        page ids per ROW's sequence (tail
+                                    entries clamped into [0, P))
+  kv_lens       [R] int32           total context of the row's sequence
+                                    *including* this row's query tokens
+                                    (0 marks an inactive row — output 0)
+  q_starts      [R] int32           absolute position of the row's first
+                                    query token in its sequence
+  q_lens        [R] int32           valid query tokens in the row
+                                    (1 for decode rows, up to QB for
+                                    prefill chunks)
+  -> out        [R, QB, H, D]
+
+Semantics: query token qi of row r sits at absolute position
+``p = q_starts[r] + qi`` and attends kv positions ``[0, p]`` (causal)
+clipped to ``[0, kv_lens[r])``. A decode row (q_len 1,
+q_start = kv_len - 1) reduces EXACTLY to `paged_attention`'s math — the
+same online-softmax update in the same order — so decode tokens are
+bitwise-identical to the decode-only kernel. Two chunks of the same
+sequence may appear as two rows of one batch (same block table,
+consecutive q_starts): their K/V must already be in the pool, which the
+serving engine guarantees by scattering every row's K/V before the
+attention of any row.
+
+The kernel runs grid (R, Hk, W) with one online-softmax accumulator in
+VMEM scratch per (row, kv-head); the prefetched block table picks which
+HBM page each grid step streams into VMEM, and pages at or past
+``kv_lens[r]`` are skipped. Inference-only: no VJP.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from ..framework.tensor import run_op
+
+__all__ = ["ragged_paged_attention", "ragged_paged_attention_xla",
+           "supported"]
+
+NEG_INF = -1e30
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def supported(q, k_pages, v_pages, block_tables, kv_lens, q_starts,
+              q_lens):
+    if not _HAS_PLTPU:
+        return False
+    qs = getattr(q, "_data", q).shape
+    ks = getattr(k_pages, "_data", k_pages).shape
+    bt = getattr(block_tables, "_data", block_tables).shape
+    shapes1 = [getattr(a, "_data", a).shape
+               for a in (kv_lens, q_starts, q_lens)]
+    if len(qs) != 4 or len(ks) != 4 or len(bt) != 2 \
+            or any(len(s) != 1 for s in shapes1):
+        return False
+    r, qb, h, d = qs
+    p, hk, page_size, dk = ks
+    if getattr(v_pages, "_data", v_pages).shape != tuple(ks):
+        return False
+    if d != dk or hk == 0 or h % hk or bt[0] != r:
+        return False
+    if any(s[0] != r for s in shapes1):
+        return False
+    if d % 8 or d > 256 or page_size % 8 or qb < 1:
+        return False
+    return True
+
+
+def _ragged_kernel(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
+                   q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, page_size, group, scale):
+    r = pl.program_id(0)
+    p = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = kv_lens_ref[r]
+    page_start = p * page_size
+
+    @pl.when(page_start < ctx)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # [QB*G, D]
+        k = k_ref[0, 0].astype(jnp.float32)              # [page, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kpos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # query rows are laid out [QB, G] flattened (qi major): the
+        # token index of softmax row i is i // G
+        qrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+        qpos = q_starts_ref[r] + qrow
+        valid = (kpos <= qpos) & (kpos < ctx) & (qrow < q_lens_ref[r])
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        # fully-masked softmax rows (a padded query, or a page entirely
+        # behind this query's causal horizon) must contribute nothing:
+        # with finite NEG_INF, exp(s - m_new) would be exp(0) = 1 when
+        # m_new is still NEG_INF, silently polluting l and acc
+        pexp = jnp.where(valid, pexp, 0.0)
+        l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(p == num_pages - 1)
+    def _finish():
+        l = l_ref[...]
+        # l == 0: inactive row (kv_len 0) or padded query row — emit
+        # zeros, never NaN
+        out = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = jnp.where(l > 0.0, out, 0.0).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=32)
+def _make_ragged(scale, page_size, qb, group, interpret):
+    def call(q4, k_pages, v_pages, tables, kv_lens, q_starts, q_lens):
+        r, hk, qbg, d = q4.shape
+        max_pages = tables.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(r, hk, max_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, qbg, d),
+                             lambda ri, hi, pi, *refs: (ri, hi, 0, 0)),
+                # the prefetched block table picks the HBM page to stream
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, qbg, d),
+                lambda ri, hi, pi, *refs: (ri, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((qbg, d), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+            ],
+        )
+        return pl.pallas_call(
+            functools.partial(_ragged_kernel, page_size=page_size,
+                              group=group, scale=scale),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((r, hk, qbg, d), q4.dtype),
+            interpret=interpret,
+        )(tables, kv_lens, q_starts, q_lens, q4, k_pages, v_pages)
+
+    return call
+
+
+def _ragged_impl(q, k_pages, v_pages, block_tables, kv_lens, q_starts,
+                 q_lens, scale):
+    r, qb, h, d = q.shape
+    hk = k_pages.shape[1]
+    group = h // hk
+    page_size = k_pages.shape[2]
+    # [R, QB, Hk, G, D] -> [R, Hk, QB*G, D]: one MXU operand per
+    # (row, kv-head) with the GQA group riding inside the query block
+    q4 = q.reshape(r, qb, hk, group, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(r, hk, qb * group, d)
+    call = _make_ragged(scale, page_size, qb, group, _interpret())
+    # clamp table tails (see paged_attention): they feed the index map
+    tables = jnp.clip(block_tables.astype(jnp.int32), 0,
+                      k_pages.shape[0] - 1)
+    out = call(q4, k_pages, v_pages, tables, kv_lens.astype(jnp.int32),
+               q_starts.astype(jnp.int32), q_lens.astype(jnp.int32))
+    return out.reshape(r, hk, qb, group, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(r, qb, h, d)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
+                           q_starts, q_lens, scale=None):
+    """Mixed prefill+decode attention over the paged pool (see module
+    docstring). Tape-integrated but non-differentiable (serving path)."""
+    if not supported(q, k_pages, v_pages, block_tables, kv_lens,
+                     q_starts, q_lens):
+        raise ValueError(
+            "ragged_paged_attention preconditions not met: need q "
+            "[R,QB,H,D], pages [P,Hk,page,D] (page % 8 == 0, D % 8 == 0, "
+            "D <= 256, H % Hk == 0), tables [R,max_pages], kv_lens/"
+            "q_starts/q_lens [R]")
+    d = getattr(q, "_data", q).shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def fn(q, kp, vp, bt, kl, qs, ql):
+        return _ragged_impl(q, kp, vp, bt, kl, qs, ql, s)
+
+    return run_op("ragged_paged_attention", fn,
+                  (q, k_pages, v_pages, block_tables, kv_lens, q_starts,
+                   q_lens), differentiable=False)
+
+
+def ragged_paged_attention_xla(q, k_pages, v_pages, block_tables,
+                               kv_lens, q_starts, q_lens, scale=None):
+    """XLA reference path: gather every row's pages to a contiguous
+    [R, S, Hk, D] window, apply the causal/ragged mask, softmax.
+    Semantically identical to the kernel (zeros on padded query rows
+    and inactive rows); used for parity tests and as the fallback where
+    Pallas is unavailable."""
+    q, k_pages, v_pages, block_tables, kv_lens, q_starts, q_lens = (
+        getattr(a, "_data", a)
+        for a in (q, k_pages, v_pages, block_tables, kv_lens, q_starts,
+                  q_lens))
+    r, qb, h, d = q.shape
+    p, hk, page_size, _ = k_pages.shape
+    group = h // hk
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    tables = jnp.clip(block_tables.astype(jnp.int32), 0, p - 1)
+    # [R, W, Hk, page, D] -> [R, S, Hk, D]
+    k = jnp.swapaxes(k_pages[tables], 2, 3).reshape(r, -1, hk, d)
+    v = jnp.swapaxes(v_pages[tables], 2, 3).reshape(r, -1, hk, d)
+    kq = jnp.repeat(k, group, axis=2)
+    vq = jnp.repeat(v, group, axis=2)
+    logits = jnp.einsum("rqhd,rshd->rhqs", q.astype(jnp.float32),
+                        kq.astype(jnp.float32)) * s
+    S = k.shape[1]
+    kpos = jnp.arange(S)[None, None, None, :]
+    qpos = (q_starts[:, None] + jnp.arange(qb)[None, :])[:, None, :, None]
+    qvalid = (jnp.arange(qb)[None, :]
+              < q_lens[:, None])[:, None, :, None]
+    mask = (kpos <= qpos) & (kpos < kv_lens[:, None, None, None]) & qvalid
+    logits = jnp.where(mask, logits, NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    # fully-masked rows (padding / inactive) -> zeros, matching the
+    # kernel's l == 0 guard rather than softmax's uniform fallback
+    any_valid = jnp.any(mask, axis=-1, keepdims=True)
+    w = jnp.where(any_valid, w, 0.0)
+    out = jnp.einsum("rhqs,rshd->rqhd", w, vq.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -33,7 +33,8 @@ def _seed():
 #    after the per-test budget and exits, so CI sees where it hung. ----
 _WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
                         "test_cluster", "test_prefix_cache",
-                        "test_subprocess_cluster"}
+                        "test_subprocess_cluster",
+                        "test_chunked_scheduler"}
 
 # per-module budgets where the default is wrong: subprocess-cluster
 # tests legitimately wait out several worker-process startups (import +

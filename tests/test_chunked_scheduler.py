@@ -1,0 +1,287 @@
+"""Chunked-prefill scheduler invariants.
+
+The engine's contract after the ragged rewrite: ONE mixed dispatch per
+step serves prefill chunks and live decodes together under a
+``chunk_budget`` token budget. These tests pin the scheduler-level
+guarantees (tier-1, CPU, host-driven):
+
+- chunking is invisible to outputs: token-exact vs the model's own
+  static-cache greedy decode, whatever the chunk/budget geometry;
+- a long prompt admitted mid-stream NEVER stalls live decodes — every
+  step emits one token per live decoder while the prompt chunks in;
+- prefill progress per step is bounded by the budget;
+- deadlines, cancellation and pool-pressure eviction fire at chunk
+  boundaries, mid-prefill included, with pages released;
+- a prefix-cache warm admission prefills its whole suffix in ONE
+  mixed dispatch (the PR-6 per-position teacher-forcing loop is gone).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.inference.serving import LlamaServingEngine, Request
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(tiny_llama_config())
+    m.eval()
+    return m
+
+
+def _reference_continuation(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], np.int64))
+    out = model.generate(ids, max_new_tokens=n)
+    return np.asarray(out._data)[0, len(prompt):].tolist()
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 64)
+    return LlamaServingEngine(model, **kw)
+
+
+def test_chunked_prefill_token_exact(model):
+    """A prompt far longer than chunk_block prefills across several
+    rows/steps and still reproduces the reference exactly."""
+    rng = np.random.RandomState(0)
+    v = model.config.vocab_size
+    p = rng.randint(0, v, (41,)).tolist()
+    want = _reference_continuation(model, p, 6)
+    engine = _engine(model, chunk_block=8, chunk_budget=16)
+    assert engine.chunk_block == 8
+    got = engine.generate([p], max_new_tokens=6)[0]
+    assert got == want
+    assert not engine._live
+    engine.close()
+
+
+def test_multi_chunk_single_dispatch_token_exact(model):
+    """A prompt spanning several chunk rows of ONE dispatch (budget >=
+    prompt > chunk_block) is still exact — later chunks attend K/V the
+    same dispatch wrote."""
+    rng = np.random.RandomState(1)
+    v = model.config.vocab_size
+    p = rng.randint(0, v, (30,)).tolist()
+    want = _reference_continuation(model, p, 4)
+    engine = _engine(model, chunk_block=8, chunk_budget=32)
+    d0 = engine._dispatch_count
+    r = Request(p, max_new_tokens=4)
+    engine.add_request(r)
+    # 30 tokens / block 8 = 4 chunk rows, all inside one 32-token budget
+    assert engine._dispatch_count == d0 + 1
+    while not r.done:
+        engine.step()
+    assert r.output_ids == want
+    engine.close()
+
+
+def test_long_prompt_never_stalls_live_decodes(model):
+    """THE latency property chunked prefill buys: while a long prompt
+    chunks in, every already-live decoder still emits one token per
+    step — the prompt never serializes the batch."""
+    rng = np.random.RandomState(2)
+    v = model.config.vocab_size
+    d1 = Request(rng.randint(0, v, (5,)).tolist(), max_new_tokens=64)
+    d2 = Request(rng.randint(0, v, (3,)).tolist(), max_new_tokens=64)
+    engine = _engine(model, chunk_block=4, chunk_budget=8)
+    engine.add_request(d1)
+    engine.add_request(d2)
+    long = Request(rng.randint(0, v, (40,)).tolist(), max_new_tokens=2)
+    engine._admit(long)
+    steps = 0
+    while long._prefilled < len(long.prompt_ids):
+        n1, n2 = len(d1.output_ids), len(d2.output_ids)
+        before = long._prefilled
+        engine.step()
+        steps += 1
+        # decoders advanced THIS step, prefill advanced at most budget
+        assert len(d1.output_ids) == n1 + 1
+        assert len(d2.output_ids) == n2 + 1
+        assert 0 < long._prefilled - before <= engine.chunk_budget
+        assert steps < 50
+    assert steps > 1                    # it really was chunked
+    # and everyone remains token-exact
+    while not (d1.done and d2.done and long.done):
+        engine.step()
+    for r in (d1, d2, long):
+        want = _reference_continuation(model, list(r.prompt_ids),
+                                       r.max_new_tokens)
+        assert r.output_ids == want
+    engine.close()
+
+
+def test_deadline_fires_at_chunk_boundary_mid_prefill(model):
+    """A deadline lapsing while the prompt is still chunking in expires
+    the request at the next chunk boundary — typed, pages released,
+    before a single token was emitted."""
+    from paddle_tpu.inference.serving import DeadlineExceeded
+
+    rng = np.random.RandomState(3)
+    v = model.config.vocab_size
+    engine = _engine(model, chunk_block=4, chunk_budget=8)
+    free0 = engine.alloc.free_pages
+    r = Request(rng.randint(0, v, (40,)).tolist(), max_new_tokens=8,
+                deadline=0.005)
+    engine._admit(r)
+    engine.step()                       # first chunk(s) only
+    assert 0 < r._prefilled < len(r.prompt_ids)
+    time.sleep(0.02)
+    engine.step()                       # boundary check trips it
+    assert r.done and r.status == "deadline_exceeded"
+    assert isinstance(r.error, DeadlineExceeded)
+    assert r.output_ids == []
+    assert engine.alloc.free_pages == free0
+    engine.close()
+
+
+def test_cancel_mid_prefill_releases_pages(model):
+    rng = np.random.RandomState(4)
+    v = model.config.vocab_size
+    engine = _engine(model, chunk_block=4, chunk_budget=8)
+    free0 = engine.alloc.free_pages
+    r = Request(rng.randint(0, v, (40,)).tolist(), max_new_tokens=8)
+    engine._admit(r)
+    engine.step()
+    assert 0 < r._prefilled < len(r.prompt_ids)
+    assert engine.cancel(r) is True
+    assert r.status == "cancelled" and r.output_ids == []
+    assert engine.alloc.free_pages == free0
+    # the engine is still healthy and exact afterwards
+    p = rng.randint(0, v, (5,)).tolist()
+    want = _reference_continuation(model, p, 4)
+    assert engine.generate([p], max_new_tokens=4)[0] == want
+    engine.close()
+
+
+def test_pressure_evicts_at_chunk_boundary_and_recovers(model):
+    """Decode-boundary pool pressure during mixed steps walks the
+    ladder (evict + requeue) and both requests end typed — the chunked
+    scheduler preserves the PR-4 contract."""
+    from paddle_tpu.observability import metrics as om
+
+    engine = LlamaServingEngine(model, max_batch=2, page_size=8,
+                                num_pages=8, chunk_block=4,
+                                chunk_budget=8)
+    free0 = engine.alloc.free_pages
+    r1 = Request([1, 2, 3], max_new_tokens=10000)
+    r2 = Request([4, 5], max_new_tokens=10000)
+    engine.add_request(r1)
+    engine.add_request(r2)
+    for _ in range(400):
+        if r1.done and r2.done:
+            break
+        engine.step()
+    assert r1.done and r2.done
+    for r in (r1, r2):
+        assert r.status in ("completed", "evicted"), r.status
+    if om.enabled():
+        ev = om.counter("serving_degraded_total",
+                        labelnames=("rung",)).labels("evict").value
+        assert ev >= 1
+    assert engine.alloc.free_pages == free0
+    assert not engine._live and not engine._requeue
+    engine.close()
+
+
+def test_prefix_suffix_prefills_in_one_dispatch(model):
+    """Satellite contract: a warm (prefix-cached) admission prefills
+    its whole un-cached suffix as chunk rows of ONE mixed dispatch —
+    not one teacher-forced dispatch per suffix position."""
+    rng = np.random.RandomState(5)
+    v = model.config.vocab_size
+    prefix = rng.randint(0, v, (16,)).tolist()      # two full pages
+    engine = _engine(model, chunk_block=8, chunk_budget=32)
+    cold = Request(prefix + rng.randint(0, v, (6,)).tolist(),
+                   max_new_tokens=2)
+    engine.add_request(cold)
+    while not cold.done:
+        engine.step()
+    warm_prompt = prefix + rng.randint(0, v, (6,)).tolist()
+    want = _reference_continuation(model, warm_prompt, 3)
+    warm = Request(warm_prompt, max_new_tokens=3)
+    d0 = engine._dispatch_count
+    engine.add_request(warm)
+    assert warm._cached_tokens == 16                # cache hit
+    assert engine._dispatch_count == d0 + 1         # ONE dispatch
+    while not warm.done:
+        engine.step()
+    assert warm.output_ids == want                  # token-exact reuse
+    engine.close()
+
+
+def test_decode_only_steps_use_compact_shape(model):
+    """Once every prompt is in, steps dispatch the [max_batch]-token
+    decode shape, not the full chunk_budget shape (no padded-token
+    compute on the decode hot path)."""
+    rng = np.random.RandomState(6)
+    v = model.config.vocab_size
+    engine = _engine(model, chunk_block=8, chunk_budget=32)
+    r = Request(rng.randint(0, v, (5,)).tolist(), max_new_tokens=8)
+    engine.add_request(r)
+    engine.step()
+    assert ("mixed", engine.chunk_budget) in engine._warmed_keys
+    assert ("mixed", engine.max_batch) in engine._warmed_keys
+    engine.close()
+
+
+def test_requeue_pump_reprefills_through_chunks(model):
+    """An evicted+requeued request re-admitted by the boundary pump
+    restarts its prefill from scratch through the chunked path and
+    still ends token-exact."""
+    engine = LlamaServingEngine(model, max_batch=2, page_size=8,
+                                num_pages=8, chunk_block=4,
+                                chunk_budget=8)
+    p1, p2 = [1, 2, 3, 4, 5, 6, 7, 8, 9], [7, 8]
+    r1 = Request(p1, max_new_tokens=30, priority=1)
+    r2 = Request(p2, max_new_tokens=30, retry_budget=3)
+    engine.add_request(r1)
+    engine.add_request(r2)
+    for _ in range(400):
+        if r1.done and r2.done:
+            break
+        engine.step()
+    assert r1.done and r1.status == "completed"
+    assert r2.done and r2.status in ("completed", "evicted")
+    if r2.status == "completed" and not r2.trimmed and not r1.trimmed:
+        assert r1.output_ids == _reference_continuation(model, p1, 30)
+        assert r2.output_ids == _reference_continuation(model, p2, 30)
+    engine.close()
+
+
+@pytest.mark.slow
+def test_mixed_workload_e2e_token_exact(model):
+    """Acceptance e2e: a decode-heavy batch with long prompts admitted
+    mid-stream, driven through mixed steps and decode scans, every
+    request token-exact vs its standalone reference."""
+    rng = np.random.RandomState(7)
+    v = model.config.vocab_size
+    engine = _engine(model, num_pages=128, chunk_block=8,
+                     chunk_budget=16)
+    decoders = [Request(rng.randint(0, v, (k,)).tolist(),
+                        max_new_tokens=24) for k in (3, 5)]
+    for r in decoders:
+        engine.add_request(r)
+    engine.decode_many(4)
+    longs = [Request(rng.randint(0, v, (n,)).tolist(), max_new_tokens=8)
+             for n in (37, 52)]
+    for r in longs:
+        engine._admit(r)
+    reqs = decoders + longs
+    for _ in range(600):
+        if all(r.done for r in reqs):
+            break
+        if not engine.step():
+            break
+    for r in reqs:
+        assert r.done and r.status == "completed", r.status
+        want = _reference_continuation(model, list(r.prompt_ids),
+                                       r.max_new_tokens)
+        assert r.output_ids == want
+    engine.close()

@@ -281,8 +281,11 @@ class TestEngineSharedPrefix:
         prefix = rng.randint(0, v, (16,)).tolist()
         engine = LlamaServingEngine(model, max_batch=4, page_size=8,
                                     num_pages=48)
+        # budgets sized so every request is still LIVE once all three
+        # are admitted: chunked admissions interleave decode steps, so
+        # a tiny budget could retire mid-admission and drop its ref
         reqs = [Request(prefix + rng.randint(0, v, (2 + i,)).tolist(),
-                        max_new_tokens=3 + i) for i in range(3)]
+                        max_new_tokens=8 + i) for i in range(3)]
         for r in reqs:
             engine.add_request(r)
         assert [r._cached_tokens for r in reqs] == [0, 16, 16]

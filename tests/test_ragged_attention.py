@@ -1,0 +1,201 @@
+"""Ragged paged attention: Pallas (interpret mode on CPU) vs the XLA
+reference, across ragged mixed prefill+decode shapes.
+
+The exact-parity contract mirrors `test_paged_attention`: both paths
+compute f32 softmax attention over the same paged pool, so outputs must
+agree to float rounding on EVERY position — including the kernel's
+defined zeros on padded query rows and inactive rows. Decode rows
+(q_len 1) must additionally reproduce the decode-only `paged_attention`
+kernel bit-for-bit, because the serving engine replaced that dispatch
+path with this kernel.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import paged_attention as PA
+from paddle_tpu.ops import ragged_paged_attention as RPA
+
+
+def _pool(rng, num_pages=32, hk=2, page=8, d=16, dtype=jnp.float32):
+    kp = jnp.asarray(rng.randn(num_pages, hk, page, d), dtype)
+    vp = jnp.asarray(rng.randn(num_pages, hk, page, d), dtype)
+    return kp, vp
+
+
+def _rows(rng, rows, width, num_pages):
+    """Random per-row metadata: (tables, kv_lens, q_starts, q_lens).
+    ``rows`` is a list of (kv_len, q_len) pairs; q_start = kv - q."""
+    r = len(rows)
+    tables = rng.randint(0, num_pages, (r, width)).astype(np.int32)
+    kv = np.asarray([k for k, _ in rows], np.int32)
+    ql = np.asarray([q for _, q in rows], np.int32)
+    qs = kv - ql
+    return (jnp.asarray(tables), jnp.asarray(kv), jnp.asarray(qs),
+            jnp.asarray(ql))
+
+
+def _run_both(q, kp, vp, tables, kv, qs, ql):
+    d = q.shape[-1]
+    out_p = RPA._ragged_impl(q, kp, vp, tables, kv, qs, ql,
+                             scale=1.0 / np.sqrt(d))
+    out_x = RPA.ragged_paged_attention_xla(q, kp, vp, tables, kv, qs, ql)
+    return out_p, out_x
+
+
+def _assert_parity(out_p, out_x, tol=1e-5):
+    err = float(jnp.max(jnp.abs(out_p.astype(jnp.float32)
+                                - out_x.astype(jnp.float32))))
+    scale = float(jnp.max(jnp.abs(out_x.astype(jnp.float32))))
+    assert err < tol * max(scale, 1.0), err
+
+
+@pytest.mark.parametrize("qb", [1, 4, 8])
+def test_mixed_batch_parity(qb):
+    rng = np.random.RandomState(0)
+    kp, vp = _pool(rng)
+    width, page = 4, 8
+    spec = [(min(29, qb + 3), min(qb, 3)),   # prefill chunk mid-prompt
+            (17, 1),                          # decode row
+            (qb, qb),                         # fresh full chunk
+            (0, 0)]                           # inactive row
+    tables, kv, qs, ql = _rows(rng, spec, width, kp.shape[0])
+    q = jnp.asarray(rng.randn(len(spec), qb, 4, 16), jnp.float32)
+    out_p, out_x = _run_both(q, kp, vp, tables, kv, qs, ql)
+    _assert_parity(out_p, out_x)
+    # inactive row and padded query rows are defined zeros in BOTH
+    assert float(jnp.max(jnp.abs(out_p[3]))) == 0.0
+    assert float(jnp.max(jnp.abs(out_x[3]))) == 0.0
+
+
+def test_empty_decode_batch_parity():
+    """All rows are prefill chunks (no decode row in the batch)."""
+    rng = np.random.RandomState(1)
+    kp, vp = _pool(rng)
+    spec = [(8, 8), (13, 5), (24, 8)]
+    tables, kv, qs, ql = _rows(rng, spec, 4, kp.shape[0])
+    q = jnp.asarray(rng.randn(3, 8, 4, 16), jnp.float32)
+    _assert_parity(*_run_both(q, kp, vp, tables, kv, qs, ql))
+
+
+def test_empty_prefill_batch_parity_and_decode_equivalence():
+    """All rows are decode rows — and the ragged kernel must reproduce
+    the decode-only `paged_attention` kernel exactly (same online
+    softmax, same order: the serving engine's decode numerics must not
+    change when this kernel replaces the decode dispatch)."""
+    rng = np.random.RandomState(2)
+    kp, vp = _pool(rng)
+    spec = [(9, 1), (32, 1), (1, 1), (17, 1)]
+    tables, kv, qs, ql = _rows(rng, spec, 4, kp.shape[0])
+    q = jnp.asarray(rng.randn(4, 1, 4, 16), jnp.float32)
+    out_p, out_x = _run_both(q, kp, vp, tables, kv, qs, ql)
+    _assert_parity(out_p, out_x)
+    out_d = PA._paged_impl(q[:, 0], kp, vp, tables, kv,
+                           scale=1.0 / np.sqrt(16))
+    assert float(jnp.max(jnp.abs(out_d - out_p[:, 0]))) == 0.0
+
+
+def test_two_chunks_of_one_sequence_match_single_chunk():
+    """Chunked prefill correctness: a prompt processed as two rows
+    (q_starts 0 and c) of one batch must produce the same outputs as
+    the same prompt processed as one row — chunking is invisible."""
+    rng = np.random.RandomState(3)
+    kp, vp = _pool(rng)
+    n, c, qb = 12, 8, 8
+    table = rng.randint(0, kp.shape[0], (1, 4)).astype(np.int32)
+    tables2 = jnp.asarray(np.vstack([table, table]))
+    kv2 = jnp.asarray([c, n], np.int32)
+    qs2 = jnp.asarray([0, c], np.int32)
+    ql2 = jnp.asarray([c, n - c], np.int32)
+    q_full = rng.randn(n, 4, 16).astype(np.float32)
+    q2 = np.zeros((2, qb, 4, 16), np.float32)
+    q2[0, :c] = q_full[:c]
+    q2[1, :n - c] = q_full[c:]
+    out2 = RPA._ragged_impl(jnp.asarray(q2), kp, vp, tables2, kv2, qs2,
+                            ql2, scale=0.25)
+    # one-row version needs QB >= n
+    q1 = np.zeros((1, 16, 4, 16), np.float32)
+    q1[0, :n] = q_full
+    out1 = RPA._ragged_impl(jnp.asarray(q1), kp, vp,
+                            jnp.asarray(table), jnp.asarray([n], np.int32),
+                            jnp.asarray([0], np.int32),
+                            jnp.asarray([n], np.int32), scale=0.25)
+    got = jnp.concatenate([out2[0, :c], out2[1, :n - c]], axis=0)
+    err = float(jnp.max(jnp.abs(got - out1[0, :n])))
+    assert err < 1e-5, err
+
+
+def test_causal_mask_within_chunk():
+    """Query token at absolute position p must see exactly kv [0, p]:
+    compare against dense causal attention built by hand."""
+    rng = np.random.RandomState(4)
+    hk, page, d, g = 2, 8, 16, 2
+    kp, vp = _pool(rng, num_pages=8, hk=hk, page=page, d=d)
+    table = np.asarray([[3, 5]], np.int32)
+    n = 11
+    q = np.zeros((1, 16, hk * g, d), np.float32)
+    q[0, :n] = rng.randn(n, hk * g, d)
+    out = RPA._ragged_impl(jnp.asarray(q), kp, vp, jnp.asarray(table),
+                           jnp.asarray([n], np.int32),
+                           jnp.asarray([0], np.int32),
+                           jnp.asarray([n], np.int32),
+                           scale=1.0 / np.sqrt(d))
+    k_seq = jnp.swapaxes(kp[table[0]], 1, 2).reshape(-1, hk, d)[:n]
+    v_seq = jnp.swapaxes(vp[table[0]], 1, 2).reshape(-1, hk, d)[:n]
+    kq = jnp.repeat(k_seq, g, axis=1)
+    vq = jnp.repeat(v_seq, g, axis=1)
+    lg = jnp.einsum("qhd,shd->hqs", jnp.asarray(q[0, :n]), kq) \
+        / np.sqrt(d)
+    causal = np.tril(np.ones((n, n)))[None]
+    lg = jnp.where(causal > 0, lg, -1e30)
+    ref = jnp.einsum("hqs,shd->qhd", jax.nn.softmax(lg, axis=-1), vq)
+    err = float(jnp.max(jnp.abs(ref - out[0, :n])))
+    assert err < 1e-5, err
+
+
+def test_kv_spanning_many_ragged_pages():
+    """Long contexts crossing several pages, ragged lens not multiples
+    of the page size, tables deliberately permuted."""
+    rng = np.random.RandomState(5)
+    kp, vp = _pool(rng, num_pages=64)
+    spec = [(57, 8), (63, 1), (33, 7), (64, 8)]
+    tables, kv, qs, ql = _rows(rng, spec, 8, kp.shape[0])
+    q = jnp.asarray(rng.randn(4, 8, 4, 16), jnp.float32)
+    _assert_parity(*_run_both(q, kp, vp, tables, kv, qs, ql))
+
+
+def test_supported_rejects_bad_shapes():
+    rng = np.random.RandomState(6)
+    kp, vp = _pool(rng)
+    tables = jnp.zeros((2, 4), jnp.int32)
+    ones = jnp.ones((2,), jnp.int32)
+    q = jnp.zeros((2, 4, 4, 16), jnp.float32)
+    assert RPA.supported(q, kp, vp, tables, ones, ones, ones)
+    # row-count mismatch
+    assert not RPA.supported(q, kp, vp, tables[:1], ones, ones, ones)
+    # head dim not a multiple of 8
+    qb = jnp.zeros((2, 4, 4, 12), jnp.float32)
+    assert not RPA.supported(qb, kp, vp, tables, ones, ones, ones)
+    with pytest.raises(ValueError):
+        RPA.ragged_paged_attention(qb, kp, vp, tables, ones, ones, ones)
+
+
+def test_table_tail_garbage_is_clamped():
+    """Unused table tail entries may hold anything — including ids past
+    the pool — without observable effect (they are clamped before the
+    index map, exactly like `paged_attention`)."""
+    rng = np.random.RandomState(7)
+    kp, vp = _pool(rng)
+    spec = [(9, 2)]
+    tables, kv, qs, ql = _rows(rng, spec, 4, kp.shape[0])
+    q = jnp.asarray(rng.randn(1, 4, 4, 16), jnp.float32)
+    out_a, _ = _run_both(q, kp, vp, tables, kv, qs, ql)
+    poisoned = np.asarray(tables).copy()
+    poisoned[0, 2:] = 10_000            # way past the pool
+    out_b = RPA._ragged_impl(q, kp, vp, jnp.asarray(poisoned), kv, qs,
+                             ql, scale=0.25)
+    out_a2 = RPA._ragged_impl(q, kp, vp, tables, kv, qs, ql, scale=0.25)
+    assert float(jnp.max(jnp.abs(out_a2 - out_b))) == 0.0

@@ -164,21 +164,21 @@ def test_page_boundary_crossing(model):
     assert got == want
 
 
-def test_burst_matches_per_step(model):
-    """decode_many's scanned burst program must emit exactly the tokens
-    the per-step program does."""
+def test_scan_matches_per_step(model):
+    """decode_many's scanned decode program must emit exactly the
+    tokens the per-step mixed program does."""
     rng = np.random.RandomState(4)
     v = model.config.vocab_size
     prompts = [rng.randint(0, v, (n,)).tolist() for n in (5, 11)]
-    n_new = LlamaServingEngine.BURST + 3     # one burst + step remainder
+    n_new = LlamaServingEngine.DECODE_TICKS + 3  # one scan + remainder
 
     e1 = LlamaServingEngine(model, max_batch=2, page_size=8, num_pages=32)
-    for p in prompts:
-        e1.add_request(Request(p, max_new_tokens=n_new))
-    while any(not r.done for r in e1._live.values()) or e1._live:
+    reqs1 = [Request(p, max_new_tokens=n_new) for p in prompts]
+    for r in reqs1:
+        e1.add_request(r)
+    while any(not r.done for r in reqs1):
         if not e1.step():
             break
-    per_step = [None, None]
 
     e2 = LlamaServingEngine(model, max_batch=2, page_size=8, num_pages=32)
     reqs = [Request(p, max_new_tokens=n_new) for p in prompts]
@@ -186,29 +186,32 @@ def test_burst_matches_per_step(model):
         e2.add_request(r)
     e2.decode_many(n_new - 1)
     want = [_reference_continuation(model, p, n_new) for p in prompts]
+    assert [r.output_ids for r in reqs1] == want
     assert [r.output_ids for r in reqs] == want
 
 
-def test_eos_mid_burst(model):
-    """A request hitting EOS inside a burst retires with the tail
-    tokens discarded."""
+def test_eos_mid_scan(model):
+    """A request hitting EOS inside a decode scan retires with the
+    tail tokens discarded."""
     rng = np.random.RandomState(5)
     v = model.config.vocab_size
     p = rng.randint(0, v, (5,)).tolist()
-    ref = _reference_continuation(model, p, LlamaServingEngine.BURST + 8)
+    ref = _reference_continuation(model, p,
+                                  LlamaServingEngine.DECODE_TICKS + 8)
     eos = ref[3]
     engine = LlamaServingEngine(model, max_batch=2, page_size=8,
                                 num_pages=48)
-    out = engine.generate([p], max_new_tokens=LlamaServingEngine.BURST + 8,
-                          eos_token_id=eos)[0]
+    out = engine.generate(
+        [p], max_new_tokens=LlamaServingEngine.DECODE_TICKS + 8,
+        eos_token_id=eos)[0]
     want = ref[:ref.index(eos) + 1]
     assert out == want
     assert not engine._live and engine.alloc.free_pages == 47
 
 
-def test_burst_page_pressure_falls_back(model):
-    """When the page pool can't hold a full burst reservation the engine
-    still makes progress via smaller chunks / single steps."""
+def test_scan_page_pressure_falls_back(model):
+    """When the page pool can't hold a full scan reservation the engine
+    still makes progress via smaller runs / single steps."""
     p = [1, 2, 3, 4, 5]
     want = _reference_continuation(model, p, 24)
     engine = LlamaServingEngine(model, max_batch=1, page_size=8,
@@ -286,7 +289,10 @@ def test_drain_under_load_completes_or_expires(model):
     engine = LlamaServingEngine(model, max_batch=2, page_size=8,
                                 num_pages=128)
     free0 = engine.alloc.free_pages
-    short = Request([1, 2, 3], max_new_tokens=3)
+    # short must still be LIVE at drain entry: admissions interleave
+    # decode steps (chunked prefill), so give it headroom beyond the
+    # few tokens it decodes while `long` is admitted
+    short = Request([1, 2, 3], max_new_tokens=8)
     long = Request([4, 5], max_new_tokens=100000)
     engine.add_request(short)
     engine.add_request(long)
@@ -372,7 +378,15 @@ out_path = sys.argv[1]
 paddle.seed(0)
 m = LlamaForCausalLM(tiny_llama_config())
 m.eval()
-engine = LlamaServingEngine(m, max_batch=2, page_size=8, num_pages=32)
+# the pool must outlast the whole grace window at the chunked engine's
+# decode rate: if a sequence hits the per-seq/pool wall first, the
+# degradation ladder retires it (trim/evict) before the drain's
+# DeadlineExceeded can — which is not what this test is about. Keep
+# max_pages_per_seq explicit: it bounds the ragged kernel's grid width
+# (a pool-sized default would make every interpret-mode dispatch walk
+# the whole pool).
+engine = LlamaServingEngine(m, max_batch=2, page_size=8, num_pages=256,
+                            max_pages_per_seq=64)
 free0 = engine.alloc.free_pages
 reqs = [Request([1, 2, 3], max_new_tokens=100000),
         Request([4, 5], max_new_tokens=100000)]

@@ -73,8 +73,8 @@ class TestRequestValidation:
         assert "KV capacity" in str(ei.value)
 
     def test_validation_beats_opaque_shape_error(self, engine):
-        # the old failure mode was a shape error deep in _prefill_wave;
-        # now add_request rejects before any program is built
+        # the old failure mode was a shape error deep in the prefill
+        # dispatch; now add_request rejects before any program is built
         cap = engine.alloc.num_pages * engine.page_size
         with pytest.raises(ValueError, match="KV capacity"):
             engine.add_request(Request(list(range(cap + 50))))
