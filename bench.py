@@ -518,6 +518,188 @@ def bench_prefix_cluster(model, on_tpu=True):
     return out
 
 
+def bench_speculative(model, on_tpu=True):
+    """Speculative decoding gates (ROADMAP item 3a): a self-speculative
+    (n-gram prompt-lookup) engine vs the same chunked engine with
+    speculation off, on the same decode-heavy workload.
+
+    Both engines are driven by the SERVING loop regime — one
+    :meth:`step` per tick, the way a cluster replica's worker actually
+    serves (a multi-tick decode scan would block admissions and prompt
+    chunks for its whole length, so the admission-responsive tick is
+    the production decode path). In that regime every non-speculative
+    tick emits exactly one token per live row; speculation multiplies
+    what one dispatch commits — exactly the dispatch-amortization lever
+    named in ROADMAP item 3.
+
+    - ``spec_parity_ok``: greedy outputs TOKEN-EXACT vs the
+      non-speculative engine — the hard gate; speculation may only
+      change dispatch counts, never a token.
+    - ``spec_accept_rate`` / ``serving_spec_tokens_per_dispatch``: how
+      much each verify dispatch commits.
+    - ``serving_spec_tokens_per_sec`` + ``spec_throughput_ok``: >= 1.3x
+      the chunked baseline measured in the same run (CPU smoke gate;
+      greedy decode settles into repetition the drafter locks onto).
+    - ``serving_spec_batch_tokens_per_sec`` (informational): the same
+      engines under the batch :meth:`generate` regime, where the
+      baseline may amortize host round trips with decode scans and the
+      speculative engine auto-falls back to them when the drafter has
+      nothing (speculation never costs more than not speculating)."""
+    from paddle_tpu.inference.serving import LlamaServingEngine, Request
+
+    model.eval()
+    kw = dict(max_batch=2, page_size=16, num_pages=48,
+              max_pages_per_seq=8, chunk_block=16, chunk_budget=16,
+              prefix_cache=False)
+    # long enough for greedy decode to settle into the repetition the
+    # drafter locks onto — the first few dozen tokens are a cold
+    # history with nothing to propose
+    new_toks = 96
+    rng = np.random.RandomState(0)
+    v = model.config.vocab_size
+    cands = [rng.randint(0, v, (12,)).tolist() for _ in range(4)]
+    pairs = [[p, p[::-1]] for p in cands]
+
+    def serve_loop(spec_k):
+        e = LlamaServingEngine(model, spec_k=spec_k, **kw)
+        # pair 0 warms every dispatched shape end to end; pairs 1..N
+        # are the timed workload (one engine, compile excluded)
+        e.generate(pairs[0], max_new_tokens=4)
+        warm = [Request(p, max_new_tokens=new_toks) for p in pairs[0]]
+        for r in warm:
+            e.add_request(r)
+        while not all(r.done for r in warm):
+            e.step()
+        tokens, dt, dispatches, outs = 0, 0.0, 0, []
+        for pair in pairs[1:]:
+            reqs = [Request(p, max_new_tokens=new_toks) for p in pair]
+            for r in reqs:
+                e.add_request(r)
+            d0 = e._dispatch_count
+            pre = sum(len(r.output_ids) for r in reqs)
+            t0 = time.perf_counter()
+            while not all(r.done for r in reqs):
+                e.step()
+            dt += time.perf_counter() - t0
+            dispatches += e._dispatch_count - d0
+            tokens += sum(len(r.output_ids) for r in reqs) - pre
+            outs.append([r.output_ids for r in reqs])
+        stats = e.spec_stats()
+        # batch regime (scans allowed) on the same engine, second pass
+        t0 = time.perf_counter()
+        bouts = e.generate(pairs[1], max_new_tokens=new_toks)
+        bt = sum(len(o) for o in bouts) / (time.perf_counter() - t0)
+        e.close()
+        return (tokens / dt, tokens / max(1, dispatches), stats, outs,
+                bt)
+
+    base_tps, base_tpd, _, outs_base, base_batch = serve_loop(0)
+    spec_tps, spec_tpd, stats, outs_spec, spec_batch = serve_loop(7)
+    model.train()
+    return {
+        "spec_parity_ok": bool(outs_spec == outs_base),
+        "spec_k": stats["k"],
+        "spec_accept_rate": round(stats["accept_rate"], 4),
+        "serving_spec_tokens_per_dispatch": round(spec_tpd, 3),
+        "serving_spec_baseline_tokens_per_dispatch": round(base_tpd, 3),
+        "serving_spec_tokens_per_sec": round(spec_tps, 1),
+        "serving_spec_baseline_tokens_per_sec": round(base_tps, 1),
+        "spec_speedup": round(spec_tps / max(base_tps, 1e-9), 3),
+        "spec_throughput_ok": bool(spec_tps >= 1.3 * base_tps),
+        "serving_spec_batch_tokens_per_sec": round(spec_batch, 1),
+        "serving_spec_batch_baseline_tokens_per_sec": round(base_batch,
+                                                            1),
+    }
+
+
+def bench_kv_int8(model, on_tpu=True):
+    """Int8 KV-page gates (ROADMAP item 3b).
+
+    - ``kv_int8_parity_ok``: attention over int8 pages + scale
+      sidecars within exact-logit tolerance of float pages (the same
+      0.05x-scale bar as every other ``*_parity_ok`` kernel gate).
+    - ``kv_int8_capacity_x``: float KV bytes / int8 KV bytes per cached
+      token (sidecars counted) — how many times more tokens one HBM
+      pool admits before the degradation ladder fires (~2x at bf16
+      head_dim 128; higher for f32 pools).
+    - ``kv_int8_tokens_per_sec``: the int8 engine on the e2e workload
+      (the win is capacity, not speed — this guards against a
+      dequant-path regression)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.inference.paged_cache import quantize_kv_int8
+    from paddle_tpu.inference.serving import LlamaServingEngine
+    from paddle_tpu.ops import ragged_paged_attention as RPA
+
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    rows, qb, h, hk, d = (8, 16, 16, 8, 128) if on_tpu \
+        else (4, 8, 4, 2, 32)
+    page, w = (64, 32) if on_tpu else (8, 8)
+    num_pages = rows * w + 8
+    q = jnp.asarray(rng.randn(rows, qb, h, d), dt)
+    kf = jnp.asarray(rng.randn(num_pages, hk, page, d), dt)
+    vf = jnp.asarray(rng.randn(num_pages, hk, page, d), dt)
+    kq, ks = quantize_kv_int8(kf)
+    vq, vs = quantize_kv_int8(vf)
+    ks, vs = ks[..., None], vs[..., None]
+    tables = jnp.asarray(rng.permutation(num_pages)[:rows * w]
+                         .reshape(rows, w), jnp.int32)
+    q_lens = np.asarray([1 if i % 2 else qb for i in range(rows)],
+                        np.int32)
+    kv = np.maximum(rng.randint(page, page * w + 1, (rows,))
+                    .astype(np.int32), q_lens)
+    q_starts = jnp.asarray(kv - q_lens)
+    kv_lens, q_lens = jnp.asarray(kv), jnp.asarray(q_lens)
+
+    ref = jax.jit(RPA.ragged_paged_attention_xla)(
+        q, kf, vf, tables, kv_lens, q_starts, q_lens)
+    got = jax.jit(_q8_attention_fn(RPA))(
+        q, kq, vq, ks, vs, tables, kv_lens, q_starts, q_lens)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    scale = float(jnp.max(jnp.abs(ref.astype(jnp.float32))))
+
+    model.eval()
+    kw = dict(max_batch=2, page_size=16 if on_tpu else 8, num_pages=64,
+              max_pages_per_seq=16, chunk_block=8, chunk_budget=16,
+              prefix_cache=False)
+    rng2 = np.random.RandomState(1)
+    v = model.config.vocab_size
+    prompts = [rng2.randint(0, v, (12,)).tolist() for _ in range(2)]
+    new_toks = 64 if on_tpu else 24
+    q8e = LlamaServingEngine(model, kv_dtype="int8", **kw)
+    q8e.generate(prompts, max_new_tokens=q8e.decode_ticks + 2)
+    t0 = time.perf_counter()
+    outs = q8e.generate(prompts, max_new_tokens=new_toks)
+    dt_q8 = time.perf_counter() - t0
+    q8_bytes = q8e.kv_bytes_per_token
+    q8e.close()
+    fpe = LlamaServingEngine(model, **kw)
+    fp_bytes = fpe.kv_bytes_per_token
+    fpe.close()
+    model.train()
+    return {
+        "kv_int8_max_err": round(err, 5),
+        "kv_int8_parity_ok": bool(err < 0.05 * max(scale, 1.0)),
+        "kv_int8_capacity_x": round(fp_bytes / q8_bytes, 3),
+        "kv_page_bytes_per_token": q8_bytes,
+        "kv_fp_page_bytes_per_token": fp_bytes,
+        "kv_int8_tokens_per_sec": round(
+            sum(len(o) for o in outs) / dt_q8, 1),
+    }
+
+
+def _q8_attention_fn(RPA):
+    """jit-able int8 ragged attention entry (module-level impl, scale
+    operands positional)."""
+    def fn(q, kq, vq, ks, vs, tables, kv_lens, q_starts, q_lens):
+        return RPA._ragged_impl_q8(
+            q, kq, vq, ks, vs, tables, kv_lens, q_starts, q_lens,
+            scale=1.0 / float(np.sqrt(q.shape[-1])))
+    return fn
+
+
 def bench_restart_ttft(on_tpu=True):
     """Cold vs warm-cache restart-to-first-token for a SUBPROCESS
     serving replica (ROADMAP item 5 / PR 7): a worker process is
@@ -722,6 +904,20 @@ def main():
     except Exception as e:
         log(f"prefix/cluster bench failed: {e!r:.300}")
         result["cluster_error"] = repr(e)[:200]
+
+    try:
+        model = bench_train_step.last_model
+        result.update(bench_speculative(model, on_tpu=on_tpu))
+    except Exception as e:
+        log(f"speculative bench failed: {e!r:.300}")
+        result["spec_error"] = repr(e)[:200]
+
+    try:
+        model = bench_train_step.last_model
+        result.update(bench_kv_int8(model, on_tpu=on_tpu))
+    except Exception as e:
+        log(f"kv-int8 bench failed: {e!r:.300}")
+        result["kv_int8_error"] = repr(e)[:200]
 
     try:
         result.update(bench_restart_ttft(on_tpu=on_tpu))

@@ -117,6 +117,7 @@ _LAZY = {
     "SubprocessReplica": "cluster", "ReplicaLostError": "cluster",
     "ClusterRequest": "cluster", "PrefixCache": "prefix_cache",
     "PageAllocator": "paged_cache", "replica_main": "replica_worker",
+    "NGramDrafter": "speculative",
 }
 
 

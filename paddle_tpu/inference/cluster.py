@@ -115,6 +115,10 @@ def _router_metrics():
         "quarantined_now": _om.gauge(
             "cluster_replicas_quarantined",
             "replicas currently held out by the circuit breaker"),
+        "affinity_hits": _om.counter(
+            "serving_prefix_affinity_hits_total",
+            "requests routed to a replica advertising their prompt's "
+            "prefix in its hot-prefix set"),
     }
 
 
@@ -414,7 +418,14 @@ class EngineReplica:
         ``chunk_budget``) makes a replica chewing through a long prompt
         look busier than its live count alone suggests — its decode
         budget is partly spoken for over the next
-        ``backlog / chunk_budget`` steps."""
+        ``backlog / chunk_budget`` steps.
+
+        The advertised hot-prefix set (``prefix_keys``: hex chain keys
+        of the engine's most recently used cached prefix pages, plus
+        the ``page_size`` they were hashed at) piggybacks on this same
+        gauge snapshot so the router's prefix-affinity scoring costs no
+        extra rpc — a subprocess replica's poll reply carries it the
+        same way."""
         e = self.engine
         with self._lock:
             backlog = len(self._backlog)
@@ -426,8 +437,12 @@ class EngineReplica:
         pb = e.prefill_backlog()
         score = (live + backlog) / max(1, e.max_batch) + kv_util \
             + pb / max(1, e.chunk_budget)
-        return {"score": score, "live": live, "backlog": backlog,
-                "kv_util": kv_util, "prefill_backlog": pb}
+        out = {"score": score, "live": live, "backlog": backlog,
+               "kv_util": kv_util, "prefill_backlog": pb}
+        if e.prefix is not None:
+            out["prefix_keys"] = e.prefix.hot_keys()
+            out["page_size"] = e.page_size
+        return out
 
     def submit(self, creq):
         """Queue a request for this replica's worker. Raises a typed
@@ -1135,7 +1150,8 @@ class ServingCluster:
                  restart_backoff=0.1, restart_backoff_max=30.0,
                  restart_jitter=0.25, breaker_threshold=5,
                  breaker_window=30.0, spawn_grace=180.0,
-                 submit_timeout=15.0, log_dir=None, prewarm=True):
+                 submit_timeout=15.0, log_dir=None, prewarm=True,
+                 affinity_weight=1.0):
         if engine_factory is None and engine_spec is None:
             raise ValueError(
                 "ServingCluster needs engine_factory (in-process "
@@ -1162,6 +1178,13 @@ class ServingCluster:
         self.submit_timeout = float(submit_timeout)
         self.log_dir = log_dir
         self.prewarm = prewarm
+        # prefix-affinity routing (ROADMAP item 2b): a full chain-hash
+        # overlap between a prompt's page-aligned prefix and a
+        # replica's advertised hot-prefix set discounts that replica's
+        # load score by this much — enough to beat modest load deltas,
+        # never enough to pile every request on one replica (a full
+        # batch of load outweighs it). 0 disables (load-only routing).
+        self.affinity_weight = float(affinity_weight)
         self._endpoint = None
         self._replicas: dict[str, object] = {}
         self._restarts: dict[str, _RestartState] = {}
@@ -1278,12 +1301,35 @@ class ServingCluster:
             self._route_count += 1
         # deterministic routing-error injection for CI plans
         _faults.fire("router.route", step=step)
-        candidates = sorted(self._routable(exclude),
-                            key=lambda r: r.load()["score"])
+        # score = load - affinity_weight * prefix overlap: replicas
+        # whose advertised hot-prefix set chain-hashes over this
+        # prompt's page-aligned prefix are preferred (their cache
+        # already holds the K/V), falling back to pure load when no
+        # replica advertises keys or nothing overlaps
+        candidates = []
+        key_cache: dict[int, set] = {}
+        for rep in self._routable(exclude):
+            l = rep.load()
+            score = l.get("score", float("inf"))
+            overlap = 0
+            adv = l.get("prefix_keys")
+            page = int(l.get("page_size") or 0)
+            if adv and page > 0 and self.affinity_weight:
+                keys = key_cache.get(page)
+                if keys is None:
+                    from .prefix_cache import chain_keys
+                    keys = key_cache[page] = {
+                        k.hex() for k in chain_keys(
+                            creq.prompt_ids, page, limit=8)}
+                if keys:
+                    overlap = len(keys & set(adv))
+                    score -= self.affinity_weight * overlap / len(keys)
+            candidates.append((score, overlap, rep))
+        candidates.sort(key=lambda t: t[0])
         retry_after = None
         stats = {"live": 0, "max_batch": 0, "free_pages": 0,
                  "num_pages": 0}
-        for rep in candidates:
+        for score, overlap, rep in candidates:
             try:
                 with _span("cluster.route", replica=rep.replica_id):
                     rep.submit(creq)
@@ -1296,6 +1342,8 @@ class ServingCluster:
                 continue
             creq.replica_id = rep.replica_id
             self._m["routed"].labels(rep.replica_id).inc()
+            if overlap:
+                self._m["affinity_hits"].inc()
             return rep
 
         self._m["backpressure"].inc()

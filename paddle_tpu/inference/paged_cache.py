@@ -27,7 +27,31 @@ import numpy as np
 from ..observability import metrics as _om
 from ..ops.paged_attention import paged_attention, paged_attention_xla
 
-__all__ = ["PageAllocator", "PagedKVCache"]
+__all__ = ["PageAllocator", "PagedKVCache", "quantize_kv_int8"]
+
+
+def quantize_kv_int8(x):
+    """Symmetric per-head int8 quantization of K/V tokens over the
+    last (head_dim) axis.
+
+    ``x`` is ``[..., D]`` float K/V; returns ``(q, scale)`` where ``q``
+    is int8 with the same shape and ``scale`` is ``x.shape[:-1]`` f32 —
+    one scale per head per token slot, so every page slot's
+    ``(int8, scale)`` pair is written exactly once by its own token
+    write and later writes to OTHER slots of the page can never skew
+    it. Dequantization is ``q.astype(f32) * scale[..., None]`` — done
+    inside the paged kernels' kv loop, so pages live in HBM at half
+    (bf16) / a quarter (f32) of their float bytes.
+
+    Pure jnp — safe under jit/trace (the serving mixed program calls
+    it per page write).
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127) \
+        .astype(jnp.int8)
+    return q, scale
 
 
 class PageAllocator:
@@ -145,6 +169,48 @@ class PageAllocator:
                 table.append(self._pop_free())
             self._lens[seq_id] = new_len
             return ln
+
+    def rollback(self, seq_id, n_tokens):
+        """Shrink a live sequence by its LAST ``n_tokens`` — the
+        speculative-decoding rejection path: draft tokens were
+        tentatively written past the committed length, verification
+        rejected a suffix of them, and the pages that existed only for
+        that suffix must return to the pool before the next step.
+
+        The length cursor moves back and table-tail pages wholly past
+        the new length drop one reference (``decref`` semantics: a
+        page another owner still holds — impossible for natural draft
+        tails, but the contract stays refcount-correct — survives for
+        them). Rejected K/V left in a *kept* page is invisible: reads
+        mask by the rolled-back ``kv_len``, and the next extend()
+        overwrites those slots. Returns pages freed to the pool."""
+        n_tokens = int(n_tokens)
+        if n_tokens <= 0:
+            return 0
+        with self._lock:
+            ln = self._lens[seq_id]
+            if n_tokens > ln:
+                raise ValueError(
+                    f"cannot roll back {n_tokens} tokens of sequence "
+                    f"{seq_id} (length {ln})")
+            table = self._tables[seq_id]
+            new_len = ln - n_tokens
+            need = max(1, math.ceil(new_len / self.page_size))
+            freed = 0
+            while len(table) > need:
+                p = table.pop()
+                if p in self._free_set or p not in self._refs:
+                    self.double_free_count += 1
+                    self._m_double_free.inc()
+                    warnings.warn(
+                        f"rollback of sequence {seq_id} found page {p} "
+                        f"already free; skipping", RuntimeWarning,
+                        stacklevel=2)
+                    continue
+                if self._decref_locked(p):
+                    freed += 1
+            self._lens[seq_id] = new_len
+            return freed
 
     def release(self, seq_id):
         """Drop a finished sequence's references; pages whose LAST

@@ -40,7 +40,41 @@ import threading
 
 import numpy as np
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "chain_keys", "cacheable_pages"]
+
+_SEED = b"paddle_tpu.prefix"
+
+
+def cacheable_pages(n_tokens, page_size):
+    """Full pages of an ``n_tokens`` prompt eligible for caching —
+    never covering the final token (the engine must run at least one
+    real token through the model to get first-output logits)."""
+    full = n_tokens // page_size
+    if full and full * page_size >= n_tokens:
+        full -= 1
+    return full
+
+
+def chain_keys(prompt_ids, page_size, n_pages=None, limit=None):
+    """Chain-hash keys for a prompt's full page-aligned prefix pages.
+
+    Page ``i`` is keyed by ``H(key_{i-1} || tokens_of_page_i)`` — the
+    content address :class:`PrefixCache` stores pages under. Module-
+    level so the cluster router can score prefix affinity with the
+    SAME hashing a replica's cache uses, without holding any cache.
+    ``limit`` caps the number of keys (hashing cost bound on the
+    routing hot path)."""
+    ids = np.asarray(prompt_ids, np.int64).reshape(-1)
+    if n_pages is None:
+        n_pages = cacheable_pages(len(ids), page_size)
+    if limit is not None:
+        n_pages = min(n_pages, int(limit))
+    keys, prev = [], _SEED
+    for i in range(n_pages):
+        chunk = ids[i * page_size:(i + 1) * page_size]
+        prev = hashlib.sha1(prev + chunk.tobytes()).digest()
+        keys.append(prev)
+    return keys
 
 
 class _Entry:
@@ -88,26 +122,30 @@ class PrefixCache:
     # ------------------------------------------------------------------
     def _keys(self, prompt_ids, n_pages):
         """Chain keys for the first ``n_pages`` full pages."""
-        ids = np.asarray(prompt_ids, np.int64).reshape(-1)
-        keys, prev = [], b"paddle_tpu.prefix"
-        for i in range(n_pages):
-            chunk = ids[i * self.page_size:(i + 1) * self.page_size]
-            prev = hashlib.sha1(prev + chunk.tobytes()).digest()
-            keys.append(prev)
-        return keys
+        return chain_keys(prompt_ids, self.page_size, n_pages=n_pages)
 
     def _cacheable_pages(self, n_tokens):
-        """Full pages of an ``n_tokens`` prompt eligible for caching —
-        never covering the final token (the engine must run at least
-        one real token through the model to get first-output logits)."""
-        full = n_tokens // self.page_size
-        if full and full * self.page_size >= n_tokens:
-            full -= 1
-        return full
+        return cacheable_pages(n_tokens, self.page_size)
 
     @property
     def pages(self):
         return len(self._entries)
+
+    def hot_keys(self, n=16):
+        """Hex chain keys of the ``n`` most recently used cached pages —
+        the replica's advertised hot-prefix set. The cluster router
+        hashes an incoming prompt with :func:`chain_keys` and scores
+        replicas by overlap (prefix-affinity routing), so requests
+        sharing a hot prefix land where its K/V already lives."""
+        import heapq
+
+        with self._lock:
+            # nlargest, not a full sort: this runs per routable replica
+            # per routing decision, and the cache can hold thousands of
+            # entries
+            es = heapq.nlargest(int(n), self._entries.values(),
+                                key=lambda e: e.last_used)
+            return [e.key.hex() for e in es]
 
     # ------------------------------------------------------------------
     def match(self, prompt_ids, record=True):

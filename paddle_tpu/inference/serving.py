@@ -38,6 +38,31 @@ Design (TPU-first, chunked prefill over ONE mixed program):
   the scan carry) — the scan body is the one mixed-program function,
   not a separate decode path.
 
+Speculative decoding (latency layer, ROADMAP item 3a):
+- With ``spec_k > 0`` every fully-prefilled decoder may carry up to k
+  draft tokens from a per-sequence self-speculative drafter
+  (:mod:`paddle_tpu.inference.speculative` — an n-gram prompt-lookup
+  table over the request's own prompt+output; no extra weights). The
+  scheduler packs the row into the mixed step as a (q_len = k+1)
+  chunk over pages the drafts were tentatively written to; batched
+  verification reads the argmax at EVERY position and accepts the
+  longest exactly-matching draft prefix, so greedy outputs are
+  token-exact vs the non-speculative engine by construction. Rejected
+  draft pages roll back via :meth:`PageAllocator.rollback` before the
+  next step, and when the drafter has nothing to propose the engine
+  falls back to ordinary decode (scans included) — speculation never
+  costs more than not speculating.
+
+Int8 KV pages (capacity layer, ROADMAP item 3b):
+- ``kv_dtype="int8"`` (or ``PADDLE_TPU_KV_DTYPE=int8``) stores the
+  page pools as int8 with per-head per-slot f32 scale sidecars,
+  quantizing on write and dequantizing inside the ragged kernel's kv
+  loop — half (bf16) to a quarter (f32) of the HBM bytes per cached
+  token (``kv_page_bytes_per_token``), so the same pool admits ~2x
+  the batch/context before the degradation ladder fires. Sidecars
+  are indexed by page id, so prefix-shared pages carry their scales
+  and a copy-on-write copies both.
+
 Shared-prefix KV cache (scale-out layer):
 - Page-aligned prompt prefixes are content-addressed
   (:mod:`paddle_tpu.inference.prefix_cache`): a cold prompt's full
@@ -110,7 +135,8 @@ from ..observability import metrics as _om
 from ..observability.trace import span as _span
 from ..ops.ragged_paged_attention import ragged_paged_attention
 from ..testing import faults as _faults
-from .paged_cache import PageAllocator
+from .paged_cache import PageAllocator, quantize_kv_int8
+from .speculative import NGramDrafter
 
 __all__ = ["LlamaServingEngine", "Request", "AdmissionError",
            "DeadlineExceeded"]
@@ -261,6 +287,23 @@ def _serving_metrics():
             "serving_prefill_backlog_tokens",
             "prompt tokens admitted but not yet prefilled (the "
             "chunked-prefill queue; load-routing signal)"),
+        "spec_proposed": _om.counter(
+            "serving_spec_proposed_tokens_total",
+            "draft tokens proposed by the speculative drafter"),
+        "spec_accepted": _om.counter(
+            "serving_spec_accepted_tokens_total",
+            "draft tokens accepted by batched verification"),
+        "spec_rate": _om.gauge(
+            "serving_spec_accept_rate",
+            "cumulative fraction of proposed draft tokens accepted"),
+        "spec_tpd": _om.gauge(
+            "serving_spec_tokens_per_dispatch",
+            "decode tokens emitted per speculative dispatch, averaged "
+            "over its decode rows (1.0 = speculation gaining nothing)"),
+        "kv_bytes": _om.gauge(
+            "kv_page_bytes_per_token",
+            "HBM bytes one cached token costs across all layers (K+V "
+            "data plus any int8 scale sidecars)"),
     }
 
 
@@ -301,6 +344,26 @@ def _page_write(pages, new, page_ids, offs):
             new.astype(pages.dtype))
 
     return run_op("paged_kv_write", fn, (pages, new, page_ids, offs),
+                  differentiable=False)
+
+
+def _page_write_q8(pages, scales, new, page_ids, offs):
+    """Quantizing scatter for int8 pools: ``new [B, Hk, D]`` float K/V
+    is int8-quantized per head (symmetric, absmax) and scattered into
+    ``pages [P, Hk, page, D]`` int8, with the per-head scale landing in
+    the ``scales [P, Hk, page, 1]`` sidecar at the same (page, head,
+    slot). Every slot's (int8, scale) pair is written exactly once by
+    its own token — later writes to other slots never skew it."""
+    def fn(pages, scales, new, page_ids, offs):
+        q, s = quantize_kv_int8(new)             # [B, Hk, D], [B, Hk]
+        hidx = jnp.arange(pages.shape[1])[None, :]
+        pages = pages.at[page_ids[:, None], hidx, offs[:, None]].set(q)
+        scales = scales.at[
+            page_ids[:, None], hidx, offs[:, None], 0].set(s)
+        return pages, scales
+
+    return run_op("paged_kv_write_q8", fn,
+                  (pages, scales, new, page_ids, offs),
                   differentiable=False)
 
 
@@ -385,7 +448,8 @@ class LlamaServingEngine:
                  chunk_block=None, decode_ticks=None, burst=None,
                  admit_retries=0, admit_backoff=0.005, stuck_factor=8.0,
                  stuck_min_timeout=30.0, prefix_cache=True,
-                 prefix_cache_pages=None, prewarm=None):
+                 prefix_cache_pages=None, prewarm=None, kv_dtype=None,
+                 spec_k=None, spec_ngram=3, drafter_factory=None):
         if num_pages is None:
             num_pages = max_batch * 24 + 8
         self.model = model
@@ -452,14 +516,60 @@ class LlamaServingEngine:
             if prefix_cache else None
         dt = model.parameters()[0].dtype
         hk, d = cfg.num_key_value_heads, cfg.head_dim
+        # int8 KV pages (ROADMAP item 3b): quantize on write, dequantize
+        # inside the ragged kernel's kv loop. Halves (bf16) / quarters
+        # (f32) the HBM bytes a cached token costs, so the same pool
+        # admits ~2x the batch/context before the degradation ladder
+        # ever trims or evicts. PADDLE_TPU_KV_DTYPE=int8 is the fleet
+        # knob; the engine arg wins when given.
+        if kv_dtype is None:
+            kv_dtype = os.environ.get("PADDLE_TPU_KV_DTYPE", "") or None
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (model dtype) or 'int8', "
+                f"got {kv_dtype!r}")
+        self.kv_quant = kv_dtype == "int8"
+        pool_dt = jnp.int8 if self.kv_quant else jnp.dtype(str(dt))
         # head-major [P, Hk, page, D] — the Pallas kernel's tiling layout
         shape = (num_pages, hk, page_size, d)
-        self.k_pools = [Tensor(jnp.zeros(shape, jnp.dtype(str(dt))))
+        self.k_pools = [Tensor(jnp.zeros(shape, pool_dt))
                         for _ in range(cfg.num_hidden_layers)]
-        self.v_pools = [Tensor(jnp.zeros(shape, jnp.dtype(str(dt))))
+        self.v_pools = [Tensor(jnp.zeros(shape, pool_dt))
                         for _ in range(cfg.num_hidden_layers)]
+        # per-head per-slot dequant scales ride sidecar arrays indexed
+        # by the SAME page ids, so prefix-shared pages carry their
+        # scales for free and a COW page copy copies both
+        sshape = (num_pages, hk, page_size, 1)
+        self.k_scales = [Tensor(jnp.zeros(sshape, jnp.float32))
+                         for _ in range(cfg.num_hidden_layers)] \
+            if self.kv_quant else []
+        self.v_scales = [Tensor(jnp.zeros(sshape, jnp.float32))
+                         for _ in range(cfg.num_hidden_layers)] \
+            if self.kv_quant else []
+        # self-speculative decoding (ROADMAP item 3a): an n-gram /
+        # prompt-lookup drafter proposes up to spec_k tokens per live
+        # decoder; the scheduler packs each speculating row into the
+        # mixed step as a (q_len = k+1) chunk and batched verification
+        # accepts the longest exactly-matching prefix — greedy outputs
+        # stay token-exact, rejected draft pages roll back via the
+        # allocator. spec_k=0 (default) disables.
+        if spec_k is None:
+            spec_k = int(os.environ.get("PADDLE_TPU_SPEC_K", "0") or 0)
+        self.spec_k = max(0, min(int(spec_k), self.chunk_block - 1))
+        self._drafter_factory = drafter_factory or \
+            (lambda: NGramDrafter(n=spec_ngram))
+        self._spec_state: dict[int, object] = {}   # seq_id -> drafter
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_idle = 0     # consecutive no-proposal probes
         self._live: dict[int, Request] = {}
         self._m = _serving_metrics()
+        n_layers = cfg.num_hidden_layers
+        tok_bytes = 2 * hk * d * jnp.dtype(pool_dt).itemsize * n_layers
+        if self.kv_quant:
+            tok_bytes += 2 * hk * 4 * n_layers     # f32 scale sidecars
+        self.kv_bytes_per_token = tok_bytes
+        self._m["kv_bytes"].set(tok_bytes)
         self._next_id = 0
         # ONE traced mixed-program function covers every dispatch; its
         # per-signature cache holds the chunk_budget-token shape and the
@@ -614,6 +724,7 @@ class LlamaServingEngine:
             req.done = True
             req.status = status
             req.error = error
+            self._spec_state.pop(req.seq_id, None)
             if req.seq_id in self._live:
                 del self._live[req.seq_id]
                 self._release_pages(req.seq_id)
@@ -696,20 +807,32 @@ class LlamaServingEngine:
     # ------------------------------------------------------------------
     def _mixed_forward(self, tokens, pos, page_ids, offs, row_tok,
                        flat_idx, last_idx, tables, kv_lens, q_starts,
-                       q_lens, k_pools, v_pools):
+                       q_lens, k_pools, v_pools, k_scales, v_scales):
         """ONE token-packed model step: embed [1, T] real tokens (a mix
-        of prefill-chunk tokens and decode tokens, back to back with no
-        inter-row padding), scatter every token's post-rope K/V into the
-        page pools, run the Pallas ragged-paged-attention kernel over
-        the per-row ``(q_start, q_len, kv_len)`` metadata, and read the
-        greedy next token at each row's last valid position. Pure in
-        its inputs so ``to_static`` compiles it once per token-count
-        signature; the decode-only shape (T == max_batch, QB == 1) and
-        the chunk-budget shape share this function.
+        of prefill-chunk tokens, speculative verify tokens and decode
+        tokens, back to back with no inter-row padding), scatter every
+        token's post-rope K/V into the page pools (int8-quantized with
+        scale sidecars when ``kv_quant``), run the Pallas
+        ragged-paged-attention kernel over the per-row ``(q_start,
+        q_len, kv_len)`` metadata, and read the greedy next token:
+        a speculative engine (``spec_k > 0``) takes the argmax at
+        EVERY packed position — position ``t`` of the [T] return is
+        the argmax continuation after token ``t``, what verification
+        compares drafts against — while a plain engine gathers each
+        row's last valid position first (an [R]-sized lm-head, not a
+        [T]-sized one; mixed dispatches with a big ``chunk_budget``
+        would otherwise pay T/R times the vocab projection for argmax
+        values nobody reads). Pure in its inputs so ``to_static``
+        compiles it once per token-count signature; the decode-only
+        shape (T == max_batch, QB == 1) and the chunk-budget shape
+        share this function.
 
         tokens/pos [1, T]; page_ids/offs/flat_idx [T]; row_tok [R, QB];
-        last_idx/kv_lens/q_starts/q_lens [R]; tables [R, W].
-        Returns (next token id [R, 1], new k_pools, new v_pools)."""
+        last_idx/kv_lens/q_starts/q_lens [R]; tables [R, W];
+        k/v_scales are empty lists for float pools.
+        Returns (next token ids — 1-D [T] when speculative, 1-D [R]
+        otherwise — new k_pools, new v_pools, new k_scales,
+        new v_scales)."""
         from ..tensor import search
 
         m = self.model.model
@@ -718,7 +841,7 @@ class LlamaServingEngine:
         r_rows, qb = row_tok.shape[0], row_tok.shape[1]
         pos64 = pos.astype("int64")
         x = m.embed_tokens(tokens)                       # [1, T, H]
-        new_k, new_v = [], []
+        new_k, new_v, new_ks, new_vs = [], [], [], []
         for li, layer in enumerate(m.layers):
             h = layer.input_layernorm(x)
             att = layer.self_attn
@@ -732,8 +855,17 @@ class LlamaServingEngine:
                 rotary_emb_base=cfg.rope_theta)
             k2 = k.reshape([t, att.num_kv_heads, att.head_dim])
             v2 = v.reshape([t, att.num_kv_heads, att.head_dim])
-            kp = _page_write(k_pools[li], k2, page_ids, offs)
-            vp = _page_write(v_pools[li], v2, page_ids, offs)
+            if self.kv_quant:
+                kp, ksc = _page_write_q8(k_pools[li], k_scales[li], k2,
+                                         page_ids, offs)
+                vp, vsc = _page_write_q8(v_pools[li], v_scales[li], v2,
+                                         page_ids, offs)
+                new_ks.append(ksc)
+                new_vs.append(vsc)
+            else:
+                kp = _page_write(k_pools[li], k2, page_ids, offs)
+                vp = _page_write(v_pools[li], v2, page_ids, offs)
+                ksc = vsc = None
             new_k.append(kp)
             new_v.append(vp)
             # pack the flat token axis into the kernel's [R, QB] row
@@ -744,18 +876,32 @@ class LlamaServingEngine:
             q4 = _token_gather(
                 q.reshape([t, att.num_heads, att.head_dim]), row_tok)
             attn4 = ragged_paged_attention(q4, kp, vp, tables, kv_lens,
-                                           q_starts, q_lens)
+                                           q_starts, q_lens,
+                                           k_scale=ksc, v_scale=vsc)
             attn = _token_gather(
                 attn4.reshape([r_rows * qb, att.num_heads,
                                att.head_dim]), flat_idx)
             x = x + att.o_proj(attn.reshape([1, t, -1]))
             x = x + layer.mlp(layer.post_attention_layernorm(x))
         x = m.norm(x)
-        h_last = _token_gather(x.reshape([t, x.shape[-1]]), last_idx)
-        logits = self.model._logits(
-            h_last.reshape([r_rows, 1, h_last.shape[-1]]))
-        nxt = search.argmax(logits, axis=-1).astype("int64")
-        return nxt, new_k, new_v
+        # returned 1-D ([T] or [R]): a 2-D [1, T] int64 output would
+        # exactly match the donated ``tokens`` input's aval and XLA
+        # would alias the output into it — but that buffer is
+        # zero-copy-backed by the caller's host array, so the alias is
+        # a use-after-free. No input carries a 1-D int64 aval, so
+        # these shapes always get a fresh buffer.
+        if self.spec_k:
+            logits = self.model._logits(x)               # [1, T, V]
+            nxt = search.argmax(logits, axis=-1).astype("int64") \
+                .reshape([t])
+        else:
+            h_last = _token_gather(x.reshape([t, x.shape[-1]]),
+                                   last_idx)
+            logits = self.model._logits(
+                h_last.reshape([r_rows, 1, h_last.shape[-1]]))
+            nxt = search.argmax(logits, axis=-1).astype("int64") \
+                .reshape([r_rows])
+        return nxt, new_k, new_v, new_ks, new_vs
 
     def _ensure_mixed_compiled(self):
         if self._mixed_static is None:
@@ -784,21 +930,76 @@ class LlamaServingEngine:
 
     def _copy_page(self, old, new):
         """Device-copy one page's K/V across every layer — the payload
-        of a :meth:`PageAllocator.ensure_writable` copy-on-write."""
+        of a :meth:`PageAllocator.ensure_writable` copy-on-write. Int8
+        pools copy the scale sidecars WITH the page: a copied page that
+        kept stale scales would dequantize to garbage for its new
+        owner."""
         for li in range(len(self.k_pools)):
             kd = self.k_pools[li]._data
             vd = self.v_pools[li]._data
             self.k_pools[li] = Tensor(kd.at[new].set(kd[old]))
             self.v_pools[li] = Tensor(vd.at[new].set(vd[old]))
+            if self.kv_quant:
+                ks = self.k_scales[li]._data
+                vs = self.v_scales[li]._data
+                self.k_scales[li] = Tensor(ks.at[new].set(ks[old]))
+                self.v_scales[li] = Tensor(vs.at[new].set(vs[old]))
 
     # ------------------------------------------------------------------
     # chunked-prefill scheduler: rows -> one mixed dispatch
     # ------------------------------------------------------------------
+    def _draft(self, r, kcap):
+        """Draft up to ``kcap`` speculative tokens for a live decoder
+        from its per-sequence drafter (created lazily; synced to the
+        committed prompt + output only — never to rejected drafts).
+        Out-of-vocab proposals from a custom drafter are dropped at the
+        first offender."""
+        st = self._spec_state.get(r.seq_id)
+        if st is None:
+            st = self._spec_state[r.seq_id] = self._drafter_factory()
+        st.sync(r.prompt_ids, r.output_ids)
+        v = self.model.config.vocab_size
+        out = []
+        for t in st.propose(kcap):
+            t = int(t)
+            if not 0 <= t < v:
+                break
+            out.append(t)
+        return tuple(out[:int(kcap)])
+
+    def _spec_worth(self, live):
+        """Probe (caller holds the engine lock): does any live decoder
+        have at least one draft to verify? Proposals are pure (sync
+        folds only committed tokens), so probing costs a dict lookup
+        per row and never skews the drafter. When nothing proposes, a
+        mixed spec step would be a plain one-token step paying the
+        chunk-shaped program — the scan is strictly better, so
+        :meth:`decode_many` falls back to it until the history gives
+        the drafter something to say."""
+        for r in live:
+            if r.max_new_tokens - len(r.output_ids) <= 1:
+                continue
+            if self._draft(r, 1):
+                return True
+        return False
+
+    def spec_stats(self):
+        """Cumulative speculative-decoding counters: proposed/accepted
+        draft tokens and the acceptance rate (also exported as
+        ``serving_spec_accept_rate``)."""
+        with self._lock:
+            p, a = self._spec_proposed, self._spec_accepted
+        return {"k": self.spec_k, "proposed": p, "accepted": a,
+                "accept_rate": a / p if p else 0.0}
+
     def _schedule_rows(self):
         """Build one mixed step's row list (caller holds the engine
         lock): every fully-prefilled live sequence gets a decode row
-        (one token, allocator extended, COW-guarded), then the
-        remaining ``chunk_budget`` fills with prefill chunks of at most
+        (one guaranteed token plus up to ``spec_k`` speculative draft
+        tokens when the drafter has proposals and pages/budget allow —
+        the row becomes a (q_len = 1+k) verify chunk over pages the
+        drafts are tentatively written to), then the remaining
+        ``chunk_budget`` fills with prefill chunks of at most
         ``chunk_block`` tokens each, FIFO by admission — a long prompt
         may take several chunk rows of ONE dispatch when the budget
         allows, and what doesn't fit waits for the next step, so a
@@ -811,20 +1012,60 @@ class LlamaServingEngine:
         decode = self._relieve_pressure(decode, 1)
         rows, cow = [], []
         budget = self.chunk_budget
-        for r in decode:
+        page = self.page_size
+        # speculative page headroom: _relieve_pressure proved ONE token
+        # per decode row fits; drafts may only spend what is left after
+        # that guarantee, so speculation can never evict or shed
+        spare = 0
+        if self.spec_k:
+            reserved = sum(
+                max(0, -(-(self.alloc._lens[r.seq_id] + 1) // page)
+                    - len(self.alloc._tables[r.seq_id]))
+                for r in decode)
+            spare = self.alloc.free_pages - reserved
+        n_dec = len(decode)
+        # drafts must never starve pending prefill: with prompts
+        # waiting, a chunk_block of budget is reserved for them, so
+        # the chunked-prefill invariant (concurrent TTFT bounded by
+        # one budget) survives sustained high acceptance — speculation
+        # throttles while prompts chunk in, not the other way around
+        reserve = self.chunk_block if prefill else 0
+        for i, r in enumerate(decode):
             sid = r.seq_id
-            self.alloc.extend(sid, 1)
+            drafts = ()
+            if self.spec_k:
+                # leave one budget token for every remaining decode row
+                # and never draft past the request's own budget
+                kcap = min(self.spec_k, self.chunk_block - 1,
+                           budget - reserve - (n_dec - i),
+                           r.max_new_tokens - len(r.output_ids) - 1)
+                if kcap > 0:
+                    drafts = self._draft(r, kcap)
+                if drafts:
+                    ln = self.alloc._lens[sid]
+                    cur = len(self.alloc._tables[sid])
+                    base = max(0, -(-(ln + 1) // page) - cur)
+                    while drafts:
+                        need = max(0, -(-(ln + 1 + len(drafts)) // page)
+                                   - cur)
+                        if need - base <= spare and cur + need \
+                                <= self.alloc.max_pages_per_seq:
+                            spare -= need - base
+                            break
+                        drafts = drafts[:-1]
+            n = 1 + len(drafts)
+            prev = self.alloc.extend(sid, n)
             # copy-on-write backstop: the write position must never
-            # land in a page shared with the prefix cache
-            cp = self.alloc.ensure_writable(sid,
-                                            self.alloc._lens[sid] - 1)
+            # land in a page shared with the prefix cache (positions
+            # past ``prev`` sit in the same now-private page or in
+            # pages the extend just allocated)
+            cp = self.alloc.ensure_writable(sid, prev)
             if cp is not None:
                 cow.append(cp)
-            start = self.alloc._lens[sid] - 1
             tok = r.output_ids[-1] if r.output_ids \
                 else int(r.prompt_ids[-1])
-            rows.append((r, sid, start, 1, (tok,), True))
-            budget -= 1
+            rows.append((r, sid, prev, n, (tok,) + drafts, True))
+            budget -= n
         for r in prefill:
             if budget <= 0 or len(rows) >= self.rows_cap:
                 break
@@ -849,10 +1090,15 @@ class LlamaServingEngine:
     def _dispatch_rows(self, rows, cow):
         """Dispatch ONE mixed program over an already-scheduled row
         list (caller holds the dispatch locks) and apply the results:
-        prefill progress, prefix-cache pins, emitted tokens. Returns
-        tokens emitted."""
-        any_prefill = any(not is_dec for *_, is_dec in rows)
-        if any_prefill:
+        prefill progress, prefix-cache pins, speculative verification
+        (accept the longest exactly-matching draft prefix, roll back
+        rejected draft pages), emitted tokens. Returns tokens
+        emitted."""
+        # speculative verify rows are multi-token decode rows: they
+        # need the chunk-shaped program exactly like prefill chunks do
+        needs_mixed = any(n > 1 or not is_dec
+                          for _, _, _, n, _, is_dec in rows)
+        if needs_mixed:
             t_cap, r_cap, qb = (self.chunk_budget, self.rows_cap,
                                 self.chunk_block)
         else:
@@ -897,6 +1143,7 @@ class LlamaServingEngine:
         q_starts = np.zeros((r_cap,), np.int32)
         q_lens = np.zeros((r_cap,), np.int32)
         t = 0
+        flat_start = []         # each row's first index in the T axis
         for i, (r, sid, start, n, toks, is_dec) in enumerate(rows):
             tb = self.alloc._tables[sid]
             tables[i, :len(tb)] = tb
@@ -910,6 +1157,7 @@ class LlamaServingEngine:
             offs[t:t + n] = of
             row_tok[i, :n] = np.arange(t, t + n)
             flat_idx[t:t + n] = i * qb + np.arange(n)
+            flat_start.append(t)
             t += n
             last_idx[i] = t - 1
         self._record_shape("mixed", t_cap)
@@ -920,8 +1168,8 @@ class LlamaServingEngine:
         t0 = time.perf_counter()
         try:
             with no_grad(), _span("serving.mixed_step", rows=len(rows),
-                                  tokens=int(t), prefill=any_prefill):
-                nxt, new_k, new_v = sf(
+                                  tokens=int(t), prefill=needs_mixed):
+                nxt, new_k, new_v, new_ks, new_vs = sf(
                     Tensor(jnp.asarray(tokens)),
                     Tensor(jnp.asarray(pos)),
                     Tensor(jnp.asarray(page_ids)),
@@ -933,7 +1181,8 @@ class LlamaServingEngine:
                     Tensor(jnp.asarray(kv_lens)),
                     Tensor(jnp.asarray(q_starts)),
                     Tensor(jnp.asarray(q_lens)),
-                    self.k_pools, self.v_pools)
+                    self.k_pools, self.v_pools,
+                    self.k_scales, self.v_scales)
         finally:
             with self._lock:
                 self._in_dispatch = False
@@ -942,8 +1191,10 @@ class LlamaServingEngine:
             self._warmed_keys.add(key)
         self._flush_deferred()
         self.k_pools, self.v_pools = list(new_k), list(new_v)
-        out = np.asarray(nxt._data).reshape(-1)
-        if not cold and not any_prefill:
+        if self.kv_quant:
+            self.k_scales, self.v_scales = list(new_ks), list(new_vs)
+        out = np.asarray(nxt._data).reshape(-1)          # [t_cap]
+        if not cold and not needs_mixed:
             # a pure-decode dispatch is one token per live row: honest
             # per-token latency. Mixed dispatches carry prefill work
             # and would skew the histogram.
@@ -968,15 +1219,80 @@ class LlamaServingEngine:
         # at emit, and its prefix must still make it into the cache
         if finished and self.prefix is not None:
             self._prefix_insert(finished, fin_sids)
+        # speculative verification BEFORE any emission: out[t] is the
+        # argmax continuation after packed token t, so a verify row's
+        # window out[f .. f+n-1] holds the token the sequential engine
+        # would emit after the pending token and after each draft.
+        # Accept the longest prefix where draft i+1 equals output i;
+        # rejected drafts' pages roll back NOW, while the sequence is
+        # still live (an emission below may retire it and release
+        # everything — rollback after that would touch a freed table)
+        accepted: dict[int, int] = {}
+        if any(is_dec and n > 1 for *_, n, _, is_dec in rows):
+            with self._lock:
+                for i, (r, sid, start, n, toks, is_dec) \
+                        in enumerate(rows):
+                    if not is_dec or n <= 1:
+                        continue
+                    f = flat_start[i]
+                    acc = 0
+                    while acc < n - 1 \
+                            and int(toks[1 + acc]) == int(out[f + acc]):
+                        acc += 1
+                    accepted[i] = acc
+                    self._spec_proposed += n - 1
+                    self._spec_accepted += acc
+                    self._m["spec_proposed"].inc(n - 1)
+                    if acc:
+                        self._m["spec_accepted"].inc(acc)
+                    rejected = (n - 1) - acc
+                    if rejected and not r.done and r.seq_id == sid:
+                        # deadline/cancel/evict mid-speculation: a row
+                        # whose request turned terminal (or was
+                        # requeued under a fresh seq_id) mid-dispatch
+                        # skips rollback — release/re-admission owns
+                        # its pages wholesale
+                        self.alloc.rollback(sid, rejected)
+                if self._spec_proposed:
+                    self._m["spec_rate"].set(
+                        self._spec_accepted / self._spec_proposed)
         emitted = 0
+        dec_rows = dec_tokens = 0
+        # spec engines index `out` by flat token position ([T] argmax);
+        # plain engines by row ([R] last-position argmax)
+        by_pos = bool(self.spec_k)
         for i, (r, sid, start, n, toks, is_dec) in enumerate(rows):
             if r.done or r.seq_id != sid:
                 continue
-            if is_dec or (start + n) >= len(r.prompt_ids):
-                # decode rows and FINAL prompt chunks emit; a mid-
+            f = flat_start[i]
+            if is_dec:
+                # the guaranteed decode token plus every accepted draft
+                # (greedy-exact by construction); _emit retires at EOS
+                # or max_new_tokens, discarding the accepted tail
+                dec_rows += 1
+                for j in range(accepted.get(i, 0) + 1):
+                    if r.done:
+                        break
+                    self._emit(r, int(out[f + j] if by_pos else out[i]))
+                    emitted += 1
+                    dec_tokens += 1
+            elif (start + n) >= len(r.prompt_ids):
+                # FINAL prompt chunks emit their last position; a mid-
                 # prompt chunk's argmax is meaningless and discarded
-                self._emit(r, int(out[i]))
+                self._emit(r, int(out[f + n - 1] if by_pos else out[i]))
                 emitted += 1
+        if accepted and dec_rows:
+            self._m["spec_tpd"].set(dec_tokens / dec_rows)
+        if not cold and needs_mixed and dec_tokens \
+                and all(is_dec for *_, is_dec in rows):
+            # a pure decode+verify dispatch (no prefill rows): the
+            # per-token latency is the dispatch amortized over what it
+            # committed — same accounting as the decode scan — so tpot
+            # and _retry_after() stay live while speculation runs
+            per = dur / dec_tokens
+            self._token_times.append(per)
+            for _ in range(dec_tokens):
+                self._m["tpot"].observe(per)
         return emitted
 
     # ------------------------------------------------------------------
@@ -1032,7 +1348,15 @@ class LlamaServingEngine:
                  float(cfg.rope_theta), self.max_batch, self.page_size,
                  self.width, self.chunk_budget, self.chunk_block,
                  len(self.k_pools) and
-                 tuple(self.k_pools[0]._data.shape), dt)
+                 tuple(self.k_pools[0]._data.shape), dt,
+                 # the pool dtype shapes every serving program (int8
+                 # pages add scale-sidecar inputs) and speculation
+                 # changes the mixed program's lm-head ([T] vs [R]
+                 # argmax) AND which scan lengths get dispatched — two
+                 # engines that differ in either must not share
+                 # warm-up recipes
+                 str(self.k_pools[0]._data.dtype)
+                 if self.k_pools else dt, bool(self.spec_k))
         return "llama:" + hashlib.sha1(
             repr(parts).encode()).hexdigest()[:16]
 
@@ -1069,7 +1393,7 @@ class LlamaServingEngine:
             return False
         sf = self._ensure_mixed_compiled()
         with no_grad():
-            _, wk, wv = sf(
+            _, wk, wv, wks, wvs = sf(
                 Tensor(jnp.asarray(np.zeros((1, t_cap), np.int64))),
                 Tensor(jnp.asarray(np.zeros((1, t_cap), np.int32))),
                 Tensor(jnp.asarray(np.full((t_cap,), self.trash_page,
@@ -1083,8 +1407,11 @@ class LlamaServingEngine:
                 Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
                 Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
                 Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
-                self.k_pools, self.v_pools)
+                self.k_pools, self.v_pools,
+                self.k_scales, self.v_scales)
         self.k_pools, self.v_pools = list(wk), list(wv)
+        if self.kv_quant:
+            self.k_scales, self.v_scales = list(wks), list(wvs)
         self._warmed_keys.add(("mixed", t_cap))
         self._warm_dispatches += 1
         self._record_shape("mixed", t_cap)
@@ -1101,12 +1428,21 @@ class LlamaServingEngine:
                      Tensor(jnp.asarray(np.full(
                          (b, self.width), self.trash_page, np.int32))),
                      Tensor(jnp.asarray(np.ones((b,), np.int32))),
-                     self.k_pools, self.v_pools)
-        n_layers = len(self.k_pools)
-        self.k_pools = list(out[1:1 + n_layers])
-        self.v_pools = list(out[1 + n_layers:])
+                     self.k_pools, self.v_pools,
+                     self.k_scales, self.v_scales)
+        self._adopt_scan_pools(out)
         self._warmed_keys.add(("scan", int(n)))
         self._warm_dispatches += 1
+
+    def _adopt_scan_pools(self, out):
+        """Reassign the donated pool (and scale-sidecar) arrays a scan
+        dispatch returned after its token block."""
+        nl = len(self.k_pools)
+        self.k_pools = list(out[1:1 + nl])
+        self.v_pools = list(out[1 + nl:1 + 2 * nl])
+        if self.kv_quant:
+            self.k_scales = list(out[1 + 2 * nl:1 + 3 * nl])
+            self.v_scales = list(out[1 + 3 * nl:1 + 4 * nl])
 
     def prewarm(self, mixed=None, scans=None):
         """Compile this engine's serving programs BEFORE traffic
@@ -1303,6 +1639,7 @@ class LlamaServingEngine:
                 return
             if v.seq_id in self._live:
                 del self._live[v.seq_id]
+            self._spec_state.pop(v.seq_id, None)
             self._release_pages(v.seq_id)
             if v.retry_budget > 0:
                 v.retry_budget -= 1
@@ -1577,37 +1914,43 @@ class LlamaServingEngine:
 
         page = self.page_size
 
-        def fn(tokens, tables, lens, k_pools, v_pools):
+        def fn(tokens, tables, lens, k_pools, v_pools, k_scales,
+               v_scales):
             tab = tables._data
             b = tab.shape[0]
             kp = [x._data for x in k_pools]
             vp = [x._data for x in v_pools]
+            ksp = [x._data for x in k_scales]
+            vsp = [x._data for x in v_scales]
             rows = jnp.arange(b, dtype=jnp.int32)
             row_tok = rows.reshape(b, 1)
             ones = jnp.ones((b,), jnp.int32)
 
             def body(carry, _):
-                tok, lc, kc, vc = carry
+                tok, lc, kc, vc, ksc, vsc = carry
                 start = (lc - 1).astype(jnp.int32)
                 pids = tab[rows, jnp.clip(start // page, 0,
                                           tab.shape[1] - 1)]
                 offs = (start % page).astype(jnp.int32)
-                nxt, nk, nv = self._mixed_forward(
+                nxt, nk, nv, nks, nvs = self._mixed_forward(
                     Tensor(tok.reshape(1, b)),
                     Tensor(start.reshape(1, b)),
                     Tensor(pids), Tensor(offs), Tensor(row_tok),
                     Tensor(rows), Tensor(rows), Tensor(tab),
                     Tensor(lc.astype(jnp.int32)), Tensor(start),
                     Tensor(ones),
-                    [Tensor(a) for a in kc], [Tensor(a) for a in vc])
+                    [Tensor(a) for a in kc], [Tensor(a) for a in vc],
+                    [Tensor(a) for a in ksc], [Tensor(a) for a in vsc])
                 nxt_arr = nxt._data.reshape(tok.shape).astype(tok.dtype)
                 return ((nxt_arr, lc + 1,
-                         [x._data for x in nk], [x._data for x in nv]),
+                         [x._data for x in nk], [x._data for x in nv],
+                         [x._data for x in nks], [x._data for x in nvs]),
                         nxt_arr[:, 0])
 
-            (_, _, kf, vf), toks = jax.lax.scan(
-                body, (tokens._data, lens._data, kp, vp), None, length=n)
-            return (jnp.swapaxes(toks, 0, 1), *kf, *vf)
+            (_, _, kf, vf, ksf, vsf), toks = jax.lax.scan(
+                body, (tokens._data, lens._data, kp, vp, ksp, vsp),
+                None, length=n)
+            return (jnp.swapaxes(toks, 0, 1), *kf, *vf, *ksf, *vsf)
 
         return fn
 
@@ -1692,7 +2035,8 @@ class LlamaServingEngine:
                         Tensor(jnp.asarray(tokens)),
                         Tensor(jnp.asarray(tables)),
                         Tensor(jnp.asarray(lens)),
-                        self.k_pools, self.v_pools)
+                        self.k_pools, self.v_pools,
+                        self.k_scales, self.v_scales)
             finally:
                 with self._lock:
                     self._in_dispatch = False
@@ -1700,10 +2044,8 @@ class LlamaServingEngine:
                 self._disarm_watchdog(dur, cold=cold)
                 self._warmed_keys.add(key)
             self._flush_deferred()
-            n_layers = len(self.k_pools)
             toks = out[0]
-            self.k_pools = list(out[1:1 + n_layers])
-            self.v_pools = list(out[1 + n_layers:])
+            self._adopt_scan_pools(out)
             all_tokens = np.asarray(toks._data)          # one D2H
             # one scan tick serves every live row: per-token latency is
             # the dispatch wall time amortized over the n ticks
@@ -1771,11 +2113,37 @@ class LlamaServingEngine:
                     break
                 prefilling = any(r._prefilled < len(r.prompt_ids)
                                  for r in live)
+                spec_now = False
+                if self.spec_k and not prefilling and live:
+                    spec_now = self._spec_worth(live)
+                    # the probe result paces scan escalation below: a
+                    # drafter with nothing to say should not hold the
+                    # engine at short scans forever
+                    self._spec_idle = 0 if spec_now \
+                        else self._spec_idle + 1
                 if not live:
                     chunk = 1       # pump parked requests via a step
                 elif prefilling:
                     chunk = 1
-                elif n >= self.decode_ticks:
+                elif spec_now:
+                    # speculation rides the mixed step: one dispatch
+                    # verifies k+1 tokens per row, which is the scan's
+                    # amortization and more — the fixed-tick scan would
+                    # force every row back to one token per tick. When
+                    # the drafter has NOTHING (cold history, no
+                    # repetition), fall through to scans and re-probe
+                    # at their boundaries: speculation must never cost
+                    # more than not speculating.
+                    chunk = 1
+                elif n >= self.decode_ticks and (not self.spec_k
+                                                 or self._spec_idle >= 2):
+                    # a speculative engine starts with SHORT scans so a
+                    # repetition onset is caught within ticks/4 tokens,
+                    # but repeated empty probes escalate to full scans
+                    # — non-draftable traffic converges to the plain
+                    # engine's dispatch amortization (probes still run
+                    # at every scan boundary, so speculation resumes at
+                    # most one scan after the history turns repetitive)
                     chunk = self._scan_fits(live, self.decode_ticks)
                 elif n >= small or not exact:
                     chunk = self._scan_fits(live, small)

@@ -18,6 +18,16 @@ Shapes (R rows, each a prefill chunk or a decode step of one sequence):
   k_pages       [P, Hk, page, D]    global pool, head-major (same layout
                                     as `paged_attention`)
   v_pages       [P, Hk, page, D]
+  k_scale       [P, Hk, page, 1]    OPTIONAL f32 dequant sidecars for
+  v_scale       [P, Hk, page, 1]    int8 pools: per-head per-slot
+                                    symmetric scales written by
+                                    `quantize_kv_int8` — the kernel's
+                                    kv loop dequantizes
+                                    ``int8 * scale`` in f32 before the
+                                    softmax, so int8 pages halve (bf16)
+                                    or quarter (f32) HBM page bytes
+                                    with no change to the attention
+                                    math's accumulation order
   block_tables  [R, W] int32        page ids per ROW's sequence (tail
                                     entries clamped into [0, P))
   kv_lens       [R] int32           total context of the row's sequence
@@ -76,9 +86,17 @@ def _interpret():
 
 
 def supported(q, k_pages, v_pages, block_tables, kv_lens, q_starts,
-              q_lens):
+              q_lens, k_scale=None, v_scale=None):
     if not _HAS_PLTPU:
         return False
+    if (k_scale is None) != (v_scale is None):
+        return False
+    if k_scale is not None:
+        ks = getattr(k_scale, "_data", k_scale)
+        vs = getattr(v_scale, "_data", v_scale)
+        want = tuple(getattr(k_pages, "_data", k_pages).shape[:3]) + (1,)
+        if tuple(ks.shape) != want or tuple(vs.shape) != want:
+            return False
     qs = getattr(q, "_data", q).shape
     ks = getattr(k_pages, "_data", k_pages).shape
     bt = getattr(block_tables, "_data", block_tables).shape
@@ -155,6 +173,105 @@ def _ragged_kernel(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
         o_ref[0, 0] = jnp.where(l > 0.0, out, 0.0).astype(o_ref.dtype)
 
 
+def _ragged_kernel_q8(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
+                      q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                      acc_ref, m_ref, l_ref, *, page_size, group, scale):
+    """Int8-pool variant: identical online-softmax math to
+    `_ragged_kernel`, with the streamed K/V page dequantized in f32
+    (``int8 * per-slot scale``) before the dot products. Kept separate
+    so the float path's decode-bitwise contract stays untouched."""
+    r = pl.program_id(0)
+    p = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = kv_lens_ref[r]
+    page_start = p * page_size
+
+    @pl.when(page_start < ctx)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # [QB*G, D]
+        # dequantize the page in VMEM: [page, D] int8 * [page, 1] f32
+        k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]
+        v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kpos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        qrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+        qpos = q_starts_ref[r] + qrow
+        valid = (kpos <= qpos) & (kpos < ctx) & (qrow < q_lens_ref[r])
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        pexp = jnp.where(valid, pexp, 0.0)
+        l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(p == num_pages - 1)
+    def _finish():
+        l = l_ref[...]
+        out = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = jnp.where(l > 0.0, out, 0.0).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=32)
+def _make_ragged_q8(scale, page_size, qb, group, interpret):
+    def call(q4, k_pages, v_pages, k_scale, v_scale, tables, kv_lens,
+             q_starts, q_lens):
+        r, hk, qbg, d = q4.shape
+        max_pages = tables.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(r, hk, max_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, qbg, d),
+                             lambda ri, hi, pi, *refs: (ri, hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                # the scale sidecars stream with their page
+                pl.BlockSpec((1, 1, page_size, 1),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, 1),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, qbg, d),
+                lambda ri, hi, pi, *refs: (ri, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((qbg, d), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+            ],
+        )
+        return pl.pallas_call(
+            functools.partial(_ragged_kernel_q8, page_size=page_size,
+                              group=group, scale=scale),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((r, hk, qbg, d), q4.dtype),
+            interpret=interpret,
+        )(tables, kv_lens, q_starts, q_lens, q4, k_pages, v_pages,
+          k_scale, v_scale)
+
+    return call
+
+
 @functools.lru_cache(maxsize=32)
 def _make_ragged(scale, page_size, qb, group, interpret):
     def call(q4, k_pages, v_pages, tables, kv_lens, q_starts, q_lens):
@@ -194,6 +311,25 @@ def _make_ragged(scale, page_size, qb, group, interpret):
     return call
 
 
+def _ragged_impl_q8(q, k_pages, v_pages, k_scale, v_scale, block_tables,
+                    kv_lens, q_starts, q_lens, scale):
+    r, qb, h, d = q.shape
+    hk = k_pages.shape[1]
+    group = h // hk
+    page_size = k_pages.shape[2]
+    q4 = q.reshape(r, qb, hk, group, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(r, hk, qb * group, d)
+    call = _make_ragged_q8(scale, page_size, qb, group, _interpret())
+    tables = jnp.clip(block_tables.astype(jnp.int32), 0,
+                      k_pages.shape[0] - 1)
+    out = call(q4, k_pages, v_pages, k_scale.astype(jnp.float32),
+               v_scale.astype(jnp.float32), tables,
+               kv_lens.astype(jnp.int32), q_starts.astype(jnp.int32),
+               q_lens.astype(jnp.int32))
+    return out.reshape(r, hk, qb, group, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(r, qb, h, d)
+
+
 def _ragged_impl(q, k_pages, v_pages, block_tables, kv_lens, q_starts,
                  q_lens, scale):
     r, qb, h, d = q.shape
@@ -215,18 +351,31 @@ def _ragged_impl(q, k_pages, v_pages, block_tables, kv_lens, q_starts,
 
 
 def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
-                           q_starts, q_lens, scale=None):
+                           q_starts, q_lens, scale=None, k_scale=None,
+                           v_scale=None):
     """Mixed prefill+decode attention over the paged pool (see module
-    docstring). Tape-integrated but non-differentiable (serving path)."""
+    docstring). Tape-integrated but non-differentiable (serving path).
+    Pass ``k_scale``/``v_scale`` sidecars ([P, Hk, page, 1] f32) with
+    int8 pools — the kernel dequantizes inside its kv loop."""
     if not supported(q, k_pages, v_pages, block_tables, kv_lens,
-                     q_starts, q_lens):
+                     q_starts, q_lens, k_scale, v_scale):
         raise ValueError(
             "ragged_paged_attention preconditions not met: need q "
             "[R,QB,H,D], pages [P,Hk,page,D] (page % 8 == 0, D % 8 == 0, "
             "D <= 256, H % Hk == 0), tables [R,max_pages], kv_lens/"
-            "q_starts/q_lens [R]")
+            "q_starts/q_lens [R]; int8 pools need BOTH k_scale/v_scale "
+            "sidecars shaped [P,Hk,page,1]")
     d = getattr(q, "_data", q).shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    if k_scale is not None:
+        def fn_q8(q, kp, vp, ks, vs, bt, kl, qs, ql):
+            return _ragged_impl_q8(q, kp, vp, ks, vs, bt, kl, qs, ql, s)
+
+        return run_op("ragged_paged_attention_q8", fn_q8,
+                      (q, k_pages, v_pages, k_scale, v_scale,
+                       block_tables, kv_lens, q_starts, q_lens),
+                      differentiable=False)
 
     def fn(q, kp, vp, bt, kl, qs, ql):
         return _ragged_impl(q, kp, vp, bt, kl, qs, ql, s)
@@ -237,12 +386,14 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
 
 
 def ragged_paged_attention_xla(q, k_pages, v_pages, block_tables,
-                               kv_lens, q_starts, q_lens, scale=None):
+                               kv_lens, q_starts, q_lens, scale=None,
+                               k_scale=None, v_scale=None):
     """XLA reference path: gather every row's pages to a contiguous
     [R, S, Hk, D] window, apply the causal/ragged mask, softmax.
     Semantically identical to the kernel (zeros on padded query rows
-    and inactive rows); used for parity tests and as the fallback where
-    Pallas is unavailable."""
+    and inactive rows; int8 pools dequantized by the scale sidecars);
+    used for parity tests and as the fallback where Pallas is
+    unavailable."""
     q, k_pages, v_pages, block_tables, kv_lens, q_starts, q_lens = (
         getattr(a, "_data", a)
         for a in (q, k_pages, v_pages, block_tables, kv_lens, q_starts,
@@ -252,6 +403,11 @@ def ragged_paged_attention_xla(q, k_pages, v_pages, block_tables,
     group = h // hk
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     tables = jnp.clip(block_tables.astype(jnp.int32), 0, p - 1)
+    if k_scale is not None:
+        ks = getattr(k_scale, "_data", k_scale).astype(jnp.float32)
+        vs = getattr(v_scale, "_data", v_scale).astype(jnp.float32)
+        k_pages = k_pages.astype(jnp.float32) * ks
+        v_pages = v_pages.astype(jnp.float32) * vs
     # [R, W, Hk, page, D] -> [R, S, Hk, D]
     k = jnp.swapaxes(k_pages[tables], 2, 3).reshape(r, -1, hk, d)
     v = jnp.swapaxes(v_pages[tables], 2, 3).reshape(r, -1, hk, d)

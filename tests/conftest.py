@@ -34,13 +34,16 @@ def _seed():
 _WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
                         "test_cluster", "test_prefix_cache",
                         "test_subprocess_cluster",
-                        "test_chunked_scheduler"}
+                        "test_chunked_scheduler", "test_speculative"}
 
 # per-module budgets where the default is wrong: subprocess-cluster
 # tests legitimately wait out several worker-process startups (import +
 # model build + compile each) inside ONE test, so their wedge budget is
 # sized to the e2e's worst case, not the in-process default
-_WEDGE_BUDGETS = {"test_subprocess_cluster": 700.0}
+_WEDGE_BUDGETS = {"test_subprocess_cluster": 700.0,
+                  # many engines per test (spec/int8 variants of the
+                  # mixed program compile per geometry)
+                  "test_speculative": 600.0}
 
 
 @pytest.fixture(autouse=True)

@@ -80,6 +80,47 @@ class TestRouting:
         finally:
             cluster.stop()
 
+    def test_prefix_affinity_routes_to_cache_holder(self, model,
+                                                    tmp_path):
+        """ROADMAP item 2b: requests sharing a page-aligned hot prefix
+        chase the replica whose cache holds it (chain-hash overlap with
+        the advertised hot-prefix set piggybacked on the load gauge);
+        unrelated prompts fall back to load-only routing."""
+        from paddle_tpu.observability import metrics as om
+
+        cluster = ServingCluster(_factory(model), num_replicas=2,
+                                 store_path=str(tmp_path / "m"),
+                                 ttl=30.0).start()
+        try:
+            rng = np.random.RandomState(11)
+            v = model.config.vocab_size
+            prefix = rng.randint(0, v, (16,)).tolist()  # 2 full pages
+
+            def go(p):
+                c = cluster.submit(p, max_new_tokens=2)
+                c.result(timeout=240)
+                return c
+
+            first = go(prefix + rng.randint(0, v, (3,)).tolist())
+            home = first.replica_id
+            followers = [go(prefix + rng.randint(0, v, (3,)).tolist())
+                         for _ in range(3)]
+            assert all(c.replica_id == home for c in followers)
+            eng = cluster.replicas()[home].engine
+            assert eng.prefix.stats()["hits"] >= 3
+            if om.enabled():
+                assert om.counter(
+                    "serving_prefix_affinity_hits_total").value >= 3
+            # outputs stay exact through affinity routing
+            p = prefix + rng.randint(0, v, (3,)).tolist()
+            assert go(p).output_ids \
+                == _reference_continuation(model, p, 2)
+            # a prompt with no cached prefix still routes somewhere
+            assert go(rng.randint(0, v, (5,)).tolist()).status \
+                == "completed"
+        finally:
+            cluster.stop()
+
     def test_backpressure_is_typed_not_dropped(self, model, tmp_path):
         """When no replica accepts, submit() raises AdmissionError —
         typed backpressure a frontend can turn into Retry-After."""
