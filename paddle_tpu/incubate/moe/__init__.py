@@ -19,10 +19,19 @@ same traffic pattern as the reference's global_scatter, but emitted by
 the compiler and fused with the surrounding matmuls. Capacity is a static
 shape (XLA needs it); overflow tokens are dropped exactly like the
 reference's capacity limiting (`moe/utils.py:59`).
+
+The scalable path (``dispatch_mode="ragged"``) replaces the dense
+one-hot dispatch with sort-based routing plus the Pallas grouped-GEMM
+megakernel (:mod:`paddle_tpu.ops.grouped_gemm`, the *MPK*/*Neptune*
+operator-fusion direction): gather tokens once into expert-contiguous
+rows, run grouped-GEMM(w1) + gelu + grouped-GEMM(w2) over the ragged
+row blocks, gather back — dense-path parity preserved bit-for-bit,
+capacity drops included.
 """
 
 from __future__ import annotations
 
+import collections
 import math
 
 import jax
@@ -31,6 +40,9 @@ import jax.numpy as jnp
 from ... import nn
 from ...framework.tensor import Parameter, Tensor, run_op
 from ...framework import random as frandom
+from ...observability import compile_watch as _cw
+from ...observability import metrics as _om
+from ...ops.grouped_gemm import _grouped as _grouped_gemm
 
 __all__ = ["MoELayer", "top_k_gating", "top_k_routing", "NaiveGate",
            "GShardGate", "SwitchGate"]
@@ -119,6 +131,24 @@ def top_k_routing(logits, k, capacity, normalize=True):
     return slot_token, topi, pos_of, keep, topv, aux
 
 
+def _watched_fn_cache(cache, n_tokens, build, name, limit):
+    """Bounded-LRU lookup of a compile-watched per-token-count forward
+    — the one mechanism behind ``MoELayer.build_fn`` and
+    ``LlamaMoEMLP.build_fn``: each new token count builds + wraps with
+    :func:`~paddle_tpu.observability.compile_watch.watched_jit` (so
+    recompiles are counted under ``name``), and the oldest entries are
+    evicted past ``limit``."""
+    fn = cache.get(n_tokens)
+    if fn is None:
+        fn = _cw.watched_jit(build(n_tokens), name=name)
+        cache[n_tokens] = fn
+        while len(cache) > limit:
+            cache.popitem(last=False)
+    else:
+        cache.move_to_end(n_tokens)
+    return fn
+
+
 class _Gate:
     top_k = 2
     normalize = True
@@ -156,7 +186,20 @@ class MoELayer(nn.Layer):
     all-to-all. The load-balancing loss of the last forward is in
     ``self.l_aux`` — add ``moe.l_aux * coeff`` to the training loss, as
     the reference's examples do.
+
+    ``dispatch_mode="ragged"`` (the default) is the grouped-GEMM path:
+    routing sorts token-choices by expert, ONE gather lays tokens out
+    expert-contiguous, and two Pallas grouped GEMMs
+    (:mod:`paddle_tpu.ops.grouped_gemm`) walk the ragged per-expert row
+    blocks — empty experts skipped, tails masked — before one gather
+    combines back. ``"dense"`` keeps the one-hot capacity-mask einsum
+    formulation (the GShard reference bar both paths must match).
     """
+
+    #: bound on the per-token-count compiled-forward cache (LRU):
+    #: ragged serving token counts must not grow the jit cache (and
+    #: its executables) without bound
+    FN_CACHE_SIZE = 8
 
     def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
                  top_k=None, capacity_factor=1.25, mesh=None, ep_axis="ep",
@@ -200,7 +243,11 @@ class MoELayer(nn.Layer):
                 setattr(self, attr,
                         shard_tensor(getattr(self, attr), mesh, place))
         self.l_aux = None
-        self._fns = {}
+        # token-count -> watched-jit forward; bounded LRU — serving
+        # traffic with ragged token counts must not grow this (and its
+        # compiled executables) without bound
+        self._fns: "collections.OrderedDict[int, object]" = \
+            collections.OrderedDict()
 
     def _expert_sharding(self, ndim):
         from jax.sharding import NamedSharding, PartitionSpec
@@ -216,6 +263,9 @@ class MoELayer(nn.Layer):
         constrain = self.mesh is not None
         if constrain:
             disp_sharding = self._expert_sharding(3)
+            # [E*cap, D] rows are expert-major, so sharding dim 0 over
+            # ``ep`` splits whole expert row-blocks across the mesh
+            row_sharding = self._expert_sharding(2)
         ragged = self.dispatch_mode == "ragged"
 
         def expert_ffn(dispatched, w1, b1, w2, b2):
@@ -227,6 +277,7 @@ class MoELayer(nn.Layer):
             return eo
 
         def fn_dense(x2d, wg, w1, b1, w2, b2):
+            n = x2d.shape[0]
             logits = jnp.matmul(x2d.astype(jnp.float32), wg)
             dispatch, combine, aux = top_k_gating(logits, k, cap, normalize)
             dispatch = dispatch.astype(x2d.dtype)
@@ -238,30 +289,65 @@ class MoELayer(nn.Layer):
                     dispatched, disp_sharding)
             eo = expert_ffn(dispatched, w1, b1, w2, b2)
             out = jnp.einsum("nec,ecd->nd", combine, eo)
-            return out, aux
+            dropped = jnp.round(n * k - jnp.sum(dispatch
+                                                .astype(jnp.float32))) \
+                .astype(jnp.int32)
+            return out, aux, dropped
 
         def fn_ragged(x2d, wg, w1, b1, w2, b2):
+            n = x2d.shape[0]
             logits = jnp.matmul(x2d.astype(jnp.float32), wg)
             slot_token, expert_of, pos_of, keep, weights, aux = \
                 top_k_routing(logits, k, cap, normalize)
-            # dispatch = one gather: slot (e, c) reads its token's row
-            # (empty slots read row 0, zeroed by the mask)
-            slots = slot_token.reshape(e, cap)
-            dispatched = x2d[jnp.maximum(slots, 0)] \
-                * (slots >= 0)[..., None].astype(x2d.dtype)
+            # grouped-GEMM dispatch (ROADMAP item 4): ONE gather lays
+            # tokens out expert-contiguous (expert e owns rows
+            # [e*cap, e*cap + gs[e])); the two grouped GEMMs walk those
+            # ragged row blocks in one kernel each — no [E, C, D]
+            # zero-padded dispatch einsum, no per-expert loop. Rows
+            # past gs[e] (empty slots) are masked inside the kernel,
+            # so the gather needs no zeroing multiply.
+            gs = jnp.zeros((e,), jnp.int32).at[expert_of.reshape(-1)] \
+                .add(keep.reshape(-1).astype(jnp.int32))
+            gathered = x2d[jnp.maximum(slot_token, 0)]      # [E*cap, D]
             if constrain:
-                dispatched = jax.lax.with_sharding_constraint(
-                    dispatched, disp_sharding)
-            eo = expert_ffn(dispatched, w1, b1, w2, b2)
+                gathered = jax.lax.with_sharding_constraint(
+                    gathered, row_sharding)
+            # under SPMD the XLA formulation is forced: GSPMD partitions
+            # the batched dot and emits the dispatch collectives; a
+            # Pallas custom call would pin everything to one replica
+            uk = False if constrain else None
+            y1 = _grouped_gemm(gathered, w1, gs, use_kernel=uk)
+            h = jax.nn.gelu(y1.reshape(e, cap, -1) + b1[:, None, :]) \
+                .reshape(e * cap, -1)
+            eo = _grouped_gemm(h, w2, gs, use_kernel=uk) \
+                .reshape(e, cap, -1) + b2[:, None, :]
+            if constrain:
+                eo = jax.lax.with_sharding_constraint(eo, disp_sharding)
             # combine = one gather back: token n reads its k slots
             flat_eo = eo.reshape(e * cap, -1)
             idx = expert_of * cap + jnp.clip(pos_of, 0, cap - 1)  # [N, k]
             picked = flat_eo[idx]                                 # [N,k,D]
             w = (weights * keep).astype(x2d.dtype)
             out = jnp.einsum("nk,nkd->nd", w, picked)
-            return out, aux
+            dropped = (n * k
+                       - jnp.sum(keep.astype(jnp.int32))).astype(jnp.int32)
+            return out, aux, dropped
 
         return fn_ragged if ragged else fn_dense
+
+    def build_fn(self, n_tokens):
+        """The compiled-forward function for ``n_tokens`` (public:
+        bench and serving integrations call it instead of reaching into
+        the private cache). Signature
+        ``fn(x2d, gate_weight, w1, b1, w2, b2) -> (out, aux, dropped)``
+        on raw arrays; compiled through the PR-2 compile watcher under
+        the ``moe_layer`` name, so per-token-count recompiles are
+        visible in ``paddle_tpu_xla_compile_total`` and the
+        recompile-storm detector. The cache keeps the most recent
+        :attr:`FN_CACHE_SIZE` token counts (LRU)."""
+        return _watched_fn_cache(self._fns, int(n_tokens),
+                                 self._build_fn, "moe_layer",
+                                 self.FN_CACHE_SIZE)
 
     def forward(self, x):
         shape = x.shape
@@ -270,13 +356,25 @@ class MoELayer(nn.Layer):
         for s in shape[:-1]:
             n *= s
         x2d = x.reshape([n, d])
-        fn = self._fns.get(n)
-        if fn is None:
-            fn = self._fns[n] = self._build_fn(n)
-        out, aux = run_op("moe_layer", fn,
-                          (x2d, self.gate_weight, self.w1, self.b1,
-                           self.w2, self.b2))
+        fn = self.build_fn(n)
+        out, aux, dropped = run_op(
+            "moe_layer", fn, (x2d, self.gate_weight, self.w1, self.b1,
+                              self.w2, self.b2))
         self.l_aux = aux
+        # capacity-overflow observability: tokens top_k_routing /
+        # top_k_gating silently dropped past capacity this forward.
+        # Metrics-off (or inside an outer trace, where the count is
+        # abstract) this is zero-cost — no D2H sync.
+        if _om.enabled() and not isinstance(dropped._data,
+                                            jax.core.Tracer):
+            nd = int(dropped._data)
+            _om.counter("moe_dropped_tokens_total",
+                        "token-choice slots dropped past expert "
+                        "capacity").inc(nd)
+            _om.gauge("moe_drop_fraction",
+                      "dropped fraction of token-choice slots in the "
+                      "last MoE forward").set(nd / float(n
+                                                         * self.gate.top_k))
         return out.reshape(shape)
 
     def capacity(self, n_tokens):

@@ -1345,6 +1345,13 @@ class LlamaServingEngine:
         parts = (cfg.vocab_size, cfg.hidden_size, cfg.intermediate_size,
                  cfg.num_hidden_layers, cfg.num_attention_heads,
                  cfg.num_key_value_heads, cfg.head_dim,
+                 # MoE dims shape the FFN programs (router + stacked
+                 # expert weights + grouped-GEMM grids): an MoE engine
+                 # and a dense engine of otherwise equal geometry must
+                 # not share prewarm recipes
+                 getattr(cfg, "moe_num_experts", 0),
+                 getattr(cfg, "moe_top_k", 0),
+                 getattr(cfg, "moe_intermediate_size", None) or 0,
                  float(cfg.rope_theta), self.max_batch, self.page_size,
                  self.width, self.chunk_budget, self.chunk_block,
                  len(self.k_pools) and

@@ -7,7 +7,7 @@ attention.
 """
 
 from .llama import (  # noqa: F401
-    LlamaConfig, LlamaMLP, LlamaAttention, LlamaDecoderLayer, LlamaModel,
+    LlamaConfig, LlamaMLP, LlamaMoEMLP, LlamaAttention, LlamaDecoderLayer, LlamaModel,
     LlamaForCausalLM, shard_llama, llama3_8b_config, tiny_llama_config,
 )
 from .llama_pipe import LlamaForCausalLMPipe  # noqa: F401
@@ -18,7 +18,7 @@ from .bert import (  # noqa: F401
 )
 
 __all__ = [
-    "LlamaConfig", "LlamaMLP", "LlamaAttention", "LlamaDecoderLayer",
+    "LlamaConfig", "LlamaMLP", "LlamaMoEMLP", "LlamaAttention", "LlamaDecoderLayer",
     "LlamaModel", "LlamaForCausalLM", "shard_llama", "llama3_8b_config",
     "tiny_llama_config", "LlamaForCausalLMPipe",
     "BertConfig", "BertModel", "BertForSequenceClassification",

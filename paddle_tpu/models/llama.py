@@ -33,9 +33,9 @@ from ..framework.tensor import Tensor
 from ..incubate.nn import functional as FI
 from ..nn.initializer import Normal
 
-__all__ = ["LlamaConfig", "LlamaMLP", "LlamaAttention", "LlamaDecoderLayer",
-           "LlamaModel", "LlamaForCausalLM", "shard_llama",
-           "llama3_8b_config", "tiny_llama_config"]
+__all__ = ["LlamaConfig", "LlamaMLP", "LlamaMoEMLP", "LlamaAttention",
+           "LlamaDecoderLayer", "LlamaModel", "LlamaForCausalLM",
+           "shard_llama", "llama3_8b_config", "tiny_llama_config"]
 
 
 @dataclasses.dataclass
@@ -55,6 +55,14 @@ class LlamaConfig:
     #: recompute in the backward sweep, trading ~1 extra forward for
     #: O(L) -> O(1) layer-activation memory (bigger batch/seq fits)
     recompute: bool = False
+    #: > 0 selects the mixture-of-experts FFN (:class:`LlamaMoEMLP`,
+    #: Mixtral-style) in every decoder layer: stacked ``[E, ...]``
+    #: expert weights, dropless top-``moe_top_k`` routing through the
+    #: grouped-GEMM kernel. 0 keeps the dense SwiGLU :class:`LlamaMLP`.
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    #: per-expert FFN width; None reuses ``intermediate_size``
+    moe_intermediate_size: int | None = None
 
     @property
     def head_dim(self):
@@ -144,6 +152,124 @@ class LlamaMLP(nn.Layer):
         return self.down_proj(FI.swiglu(self.gate_proj(x), self.up_proj(x)))
 
 
+class LlamaMoEMLP(nn.Layer):
+    """Mixture-of-experts SwiGLU FFN (Mixtral-style), selected by
+    ``config.moe_num_experts > 0``.
+
+    Per token: softmax router over ``E`` experts, top-``k`` selection
+    with renormalized weights, each expert a bias-free SwiGLU MLP with
+    stacked ``[E, ...]`` weights. Routing is **dropless** (capacity =
+    the token count, which an expert's load can never exceed), so the
+    output of every token is a pure function of that token's hidden
+    state — independent of how a batch is packed. That invariance is
+    what lets the serving engine's token-packed mixed program emit
+    greedy tokens EXACTLY equal to the plain ``LlamaForCausalLM``
+    forward: pad/trash tokens route somewhere, but never into another
+    token's output.
+
+    Compute rides the grouped-GEMM megakernel
+    (:mod:`paddle_tpu.ops.grouped_gemm`): one gather lays token-choices
+    out expert-contiguous, three grouped GEMMs (gate/up/down) walk the
+    ragged per-expert row blocks, one gather combines back. The
+    per-token-count forward compiles through the ``moe_mlp`` compile
+    watch (bounded LRU, same contract as ``MoELayer``).
+    """
+
+    FN_CACHE_SIZE = 8
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        import collections
+
+        from ..framework import random as frandom
+        from ..framework.tensor import Parameter
+
+        e = int(config.moe_num_experts)
+        if e <= 0:
+            raise ValueError("LlamaMoEMLP needs config.moe_num_experts "
+                             f"> 0, got {e}")
+        self.num_experts = e
+        self.top_k = max(1, min(int(config.moe_top_k), e))
+        self.d_model = config.hidden_size
+        self.d_ff = config.moe_intermediate_size \
+            or config.intermediate_size
+        std = config.initializer_range
+
+        def init(shape):
+            return Parameter(jax.random.normal(
+                frandom.next_key(), shape, jnp.float32) * std)
+
+        self.gate = init((self.d_model, e))
+        self.gate_proj = init((e, self.d_model, self.d_ff))
+        self.up_proj = init((e, self.d_model, self.d_ff))
+        self.down_proj = init((e, self.d_ff, self.d_model))
+        self.l_aux = None
+        #: set by shard_llama: sharded expert weights must take the
+        #: GSPMD-partitionable XLA formulation (a Pallas custom call
+        #: would pin execution to one replica)
+        self.sharded = False
+        self._fns: "dict[int, object]" = collections.OrderedDict()
+
+    def _build_fn(self, n):
+        from ..incubate.moe import top_k_routing
+        from ..ops.grouped_gemm import _grouped
+
+        e, k = self.num_experts, self.top_k
+        uk = False if self.sharded else None
+
+        def fn(x2d, gate, wg, wu, wd):
+            logits = jnp.matmul(x2d.astype(jnp.float32), gate)
+            # dropless: capacity = n (an expert appears at most once in
+            # any token's top-k, so its load never exceeds the token
+            # count) — keep is all-True, nothing is ever dropped. The
+            # price of that exactness is the strided [E*n, ...] buffer
+            # (only n*k rows real; the kernel skips the rest's MXU
+            # work): fine at serving chunk budgets, and the lever to
+            # revisit if E*chunk_budget ever dominates HBM.
+            slot_token, expert_of, pos_of, keep, weights, aux = \
+                top_k_routing(logits, k, n, normalize=True)
+            gs = jnp.zeros((e,), jnp.int32).at[expert_of.reshape(-1)] \
+                .add(keep.reshape(-1).astype(jnp.int32))
+            gathered = x2d[jnp.maximum(slot_token, 0)]      # [E*n, D]
+            g = _grouped(gathered, wg, gs, use_kernel=uk)
+            u = _grouped(gathered, wu, gs, use_kernel=uk)
+            h = jax.nn.silu(g) * u                          # swiglu
+            y = _grouped(h, wd, gs, use_kernel=uk)
+            idx = expert_of * n + jnp.clip(pos_of, 0, n - 1)
+            picked = y[idx]                                 # [n, k, D]
+            wk = (weights * keep).astype(x2d.dtype)
+            return jnp.einsum("nk,nkd->nd", wk, picked), aux
+
+        return fn
+
+    def build_fn(self, n_tokens):
+        """Public access to the per-token-count compiled forward
+        (``fn(x2d, gate, gate_proj, up_proj, down_proj) -> (out,
+        aux)`` on raw arrays), compile-watched as ``moe_mlp`` with a
+        bounded LRU cache."""
+        from ..incubate.moe import _watched_fn_cache
+
+        return _watched_fn_cache(self._fns, int(n_tokens),
+                                 self._build_fn, "moe_mlp",
+                                 self.FN_CACHE_SIZE)
+
+    def forward(self, x):
+        from ..framework.tensor import run_op
+
+        shape = x.shape
+        d = shape[-1]
+        n = 1
+        for s in shape[:-1]:
+            n *= s
+        x2d = x.reshape([n, d])
+        out, aux = run_op(
+            "moe_mlp", self.build_fn(n),
+            (x2d, self.gate, self.gate_proj, self.up_proj,
+             self.down_proj))
+        self.l_aux = aux
+        return out.reshape(shape)
+
+
 class LlamaAttention(nn.Layer):
     """GQA attention with rotary embeddings; [B, S, H, D] layout throughout
     so the Pallas flash kernel path needs no relayout."""
@@ -213,7 +339,11 @@ class LlamaDecoderLayer(nn.Layer):
         self.self_attn = LlamaAttention(config)
         self.post_attention_layernorm = nn.RMSNorm(
             config.hidden_size, epsilon=config.rms_norm_eps)
-        self.mlp = LlamaMLP(config)
+        # config-selected FFN: the serving engine's mixed program and
+        # the plain forward both call self.mlp, so an MoE checkpoint
+        # serves with zero scheduler changes
+        self.mlp = LlamaMoEMLP(config) if config.moe_num_experts \
+            else LlamaMLP(config)
 
     def forward(self, x, position_ids=None, cache=None, cache_len=None,
                 attn_mask=None):
@@ -486,9 +616,23 @@ def shard_llama(model: LlamaForCausalLM, mesh, tp_axis="mp",
         a.k_proj.weight = place(a.k_proj.weight, 1, 0)
         a.v_proj.weight = place(a.v_proj.weight, 1, 0)
         a.o_proj.weight = place(a.o_proj.weight, 0, 1)
-        mlp.gate_proj.weight = place(mlp.gate_proj.weight, 1, 0)
-        mlp.up_proj.weight = place(mlp.up_proj.weight, 1, 0)
-        mlp.down_proj.weight = place(mlp.down_proj.weight, 0, 1)
+        if isinstance(mlp, LlamaMoEMLP):
+            # stacked [E, in, out] expert weights: tp splits the FFN
+            # width exactly like the dense column/row layout; the
+            # router stays replicated on tp (every rank routes every
+            # token) and fsdp shards the other matrix dim
+            mlp.gate = place(mlp.gate, None, 0)
+            mlp.gate_proj = place(mlp.gate_proj, 2, 1)
+            mlp.up_proj = place(mlp.up_proj, 2, 1)
+            mlp.down_proj = place(mlp.down_proj, 1, 2)
+            # sharded experts: GSPMD needs the XLA grouped formulation
+            # (drop any kernel-path programs built before sharding)
+            mlp.sharded = True
+            mlp._fns.clear()
+        else:
+            mlp.gate_proj.weight = place(mlp.gate_proj.weight, 1, 0)
+            mlp.up_proj.weight = place(mlp.up_proj.weight, 1, 0)
+            mlp.down_proj.weight = place(mlp.down_proj.weight, 0, 1)
         layer.input_layernorm.weight = place(
             layer.input_layernorm.weight, None, 0)
         layer.post_attention_layernorm.weight = place(

@@ -7,3 +7,4 @@ seam.
 """
 
 from . import flash_attention  # noqa: F401
+from . import grouped_gemm  # noqa: F401

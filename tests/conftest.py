@@ -34,7 +34,8 @@ def _seed():
 _WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
                         "test_cluster", "test_prefix_cache",
                         "test_subprocess_cluster",
-                        "test_chunked_scheduler", "test_speculative"}
+                        "test_chunked_scheduler", "test_speculative",
+                        "test_moe_serving"}
 
 # per-module budgets where the default is wrong: subprocess-cluster
 # tests legitimately wait out several worker-process startups (import +

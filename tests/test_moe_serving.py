@@ -1,0 +1,128 @@
+"""MoE checkpoints through the chunked serving scheduler.
+
+Bar (ROADMAP item 4): ``LlamaServingEngine`` serves an MoE-config
+Llama end-to-end with ZERO scheduler changes — the config-selected
+:class:`LlamaMoEMLP` rides the same mixed program — and greedy outputs
+are token-exact vs the plain ``LlamaForCausalLM`` forward. Dropless
+routing makes the MoE FFN a pure per-token function, so the engine's
+token packing (prefill chunks + decode rows + trash padding in one
+dispatch) cannot perturb any token's output; these tests pin that.
+The PR-7 warm-restart surface carries over: the engine's shape key
+covers the MoE dims, so prewarm recipes never cross between MoE and
+dense engines of otherwise equal geometry.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (LlamaForCausalLM, LlamaMLP, LlamaMoEMLP,
+                               tiny_llama_config)
+from paddle_tpu.inference.serving import LlamaServingEngine, Request
+
+
+@pytest.fixture(scope="module")
+def moe_model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(tiny_llama_config(moe_num_experts=4,
+                                           moe_top_k=2))
+    m.eval()
+    return m
+
+
+def _reference_continuation(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], np.int64))
+    out = model.generate(ids, max_new_tokens=n)
+    return np.asarray(out._data)[0, len(prompt):].tolist()
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("chunk_block", 8)
+    kw.setdefault("chunk_budget", 16)
+    return LlamaServingEngine(model, **kw)
+
+
+def test_config_selects_moe_mlp(moe_model):
+    layer = moe_model.model.layers[0]
+    assert isinstance(layer.mlp, LlamaMoEMLP)
+    assert layer.mlp.gate_proj.shape[0] == 4          # stacked [E, ...]
+    dense = LlamaForCausalLM(tiny_llama_config())
+    assert isinstance(dense.model.layers[0].mlp, LlamaMLP)
+
+
+def test_moe_engine_greedy_token_exact(moe_model):
+    """An MoE checkpoint serves through the chunked scheduler with
+    greedy outputs exactly equal to the non-serving forward — prompt
+    chunking, decode scans and trash-token padding included."""
+    rng = np.random.RandomState(0)
+    v = moe_model.config.vocab_size
+    p = rng.randint(0, v, (13,)).tolist()
+    want = _reference_continuation(moe_model, p, 6)
+    engine = _engine(moe_model)
+    got = engine.generate([p], max_new_tokens=6)[0]
+    assert got == want
+    assert not engine._live
+    engine.close()
+
+
+def test_moe_shape_key_covers_moe_dims(moe_model):
+    """Prewarm recipes must never cross between MoE and dense engines
+    (or between different expert counts): the shape key includes the
+    MoE dims, and dispatched shapes are recorded under it."""
+    e1 = _engine(moe_model)
+    paddle.seed(0)
+    dense = LlamaForCausalLM(tiny_llama_config())
+    e2 = _engine(dense)
+    assert e1._shape_key != e2._shape_key
+    paddle.seed(0)
+    moe8 = LlamaForCausalLM(tiny_llama_config(moe_num_experts=8,
+                                              moe_top_k=2))
+    e3 = _engine(moe8)
+    assert e3._shape_key not in (e1._shape_key, e2._shape_key)
+    # a dispatch records its mixed-shape bucket for the next prewarm
+    if e1._cache_dir is not None:
+        r = Request(np.arange(1, 5), max_new_tokens=1)
+        e1.add_request(r)
+        while not r.done:
+            e1.step()
+        assert ("mixed", e1.chunk_budget) in e1._recorded_shapes
+    for e in (e1, e2, e3):
+        e.close()
+
+
+def test_moe_mlp_packing_invariance(moe_model):
+    """The property the serving contract rests on: a token's MoE-FFN
+    output does not depend on what else is packed into the batch."""
+    mlp = moe_model.model.layers[0].mlp
+    rng = np.random.RandomState(3)
+    h = moe_model.config.hidden_size
+    x = rng.randn(5, h).astype(np.float32)
+    alone = mlp(paddle.to_tensor(x[:1])).numpy()
+    packed = mlp(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(alone[0], packed[0], atol=1e-5)
+    # and the fn cache compiled through the moe_mlp watch, bounded
+    assert all(getattr(f, "_watch_name", None) == "moe_mlp"
+               for f in mlp._fns.values())
+    assert len(mlp._fns) <= LlamaMoEMLP.FN_CACHE_SIZE
+
+
+@pytest.mark.slow
+def test_moe_mixed_workload_e2e_token_exact(moe_model):
+    """Heavy variant: concurrent MoE requests with ragged lengths —
+    long prompts chunking across steps while short ones decode, scan
+    ticks included — all token-exact vs the reference forward."""
+    rng = np.random.RandomState(1)
+    v = moe_model.config.vocab_size
+    prompts = [rng.randint(0, v, (ln,)).tolist()
+               for ln in (21, 5, 12, 3)]
+    want = [_reference_continuation(moe_model, p, 8) for p in prompts]
+    engine = _engine(moe_model, num_pages=96)
+    got = engine.generate(prompts, max_new_tokens=8)
+    assert got == want
+    # every page is either free or pinned by the shared-prefix cache
+    assert engine.alloc.free_pages + engine.prefix.pages \
+        == engine.alloc.num_pages
+    engine.close()
