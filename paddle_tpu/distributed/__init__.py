@@ -37,7 +37,9 @@ from .recompute import recompute  # noqa: F401
 from . import fleet  # noqa: F401
 from .parallel import DataParallel, shard_dataloader, ShardDataloader  # noqa: F401
 from . import auto_tuner  # noqa: F401
-from .watchdog import StepWatchdog, ElasticManager, FileStore  # noqa: F401
+from .watchdog import (  # noqa: F401
+    StepWatchdog, ElasticManager, FileStore, StaleEpochError,
+)
 from .pipeline import pipeline_spmd  # noqa: F401
 from . import collective  # noqa: F401
 from ..native import TCPStore  # noqa: F401  (C++ rendezvous store)

@@ -5,21 +5,83 @@ rpc_sync / rpc_async / shutdown between named workers).
 TPU-native transport: the native C++ TCPStore (the control plane's
 rendezvous store) instead of brpc — each worker runs a dispatcher
 thread that serves requests addressed to its name; calls are pickled
-``(fn, args, kwargs)`` like the reference. The data plane never touches
-this path (collectives ride ICI/DCN inside compiled programs); RPC is
-for control messages, metrics, and orchestration — latency budgets
-where a KV-store transport is fine.
+``(caller, call_id, fn, args, kwargs)`` like the reference (plus the
+dedup identity). The data plane never touches this path (collectives
+ride ICI/DCN inside compiled programs); RPC is for control messages,
+metrics, and orchestration — latency budgets where a KV-store
+transport is fine.
+
+Partition tolerance (ISSUE 11): the network between caller and callee
+is assumed to drop, delay, and duplicate. Delivery is therefore
+AT-LEAST-ONCE — a call that times out is retried (bounded, exponential
+backoff + jitter) under the SAME ``(caller, call_id)`` identity — and
+the dispatcher makes redelivery exactly-once-EFFECTIVE: it remembers
+the replies of recently served calls in a bounded cache keyed by that
+identity, so a redelivered request republishes the cached reply
+instead of executing the handler again (``rpc_duplicate_deliveries_
+total`` counts the hits; ``rpc_retries_total`` counts resends).
+Deterministic chaos rides :func:`paddle_tpu.testing.faults
+.fire_network` at the ``rpc.send`` / ``rpc.reply`` message points.
 """
 
 from __future__ import annotations
 
+import collections
+import itertools
+import os
 import pickle
+import random
 import threading
+import time
+
+from ..testing import faults as _faults
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_current_worker_info", "get_worker_info",
            "get_all_worker_infos", "WorkerInfo", "RpcTimeoutError",
-           "RpcEndpoint"]
+           "RpcEndpoint", "DEFAULT_TIMEOUT_ENV"]
+
+#: env var capping a ``wait(timeout=None)`` on a call that was itself
+#: made with ``timeout=None`` — the docstring's "never an indefinite
+#: block on a dead peer" holds even when nobody passed a budget
+DEFAULT_TIMEOUT_ENV = "PADDLE_TPU_RPC_DEFAULT_TIMEOUT"
+_DEFAULT_TIMEOUT = 120.0
+
+#: env var for the default retry budget of rpc_sync / RpcEndpoint.call
+#: (attempts = retries + 1); dedup makes retried calls exactly-once-
+#: effective, so retrying is safe by default
+RETRIES_ENV = "PADDLE_TPU_RPC_RETRIES"
+_DEFAULT_RETRIES = 2
+
+#: env var bounding the dispatcher's reply cache (dedup window)
+REPLY_CACHE_ENV = "PADDLE_TPU_RPC_REPLY_CACHE"
+_DEFAULT_REPLY_CACHE = 512
+
+
+def _env_float(name, default):
+    raw = os.environ.get(name)
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _default_rpc_timeout():
+    return _env_float(DEFAULT_TIMEOUT_ENV, _DEFAULT_TIMEOUT)
+
+
+def _default_retries():
+    return max(0, int(_env_float(RETRIES_ENV, _DEFAULT_RETRIES)))
+
+
+def _metrics():
+    from ..observability import metrics as _om
+
+    return (_om.counter("rpc_retries_total",
+                        "rpc attempts re-sent after a typed timeout"),
+            _om.counter("rpc_duplicate_deliveries_total",
+                        "redelivered requests answered from the "
+                        "dispatcher's reply cache (handler NOT re-run)"))
 
 
 class RpcTimeoutError(TimeoutError):
@@ -68,10 +130,14 @@ class _FutureReply:
 
     def wait(self, timeout=None):
         """Block for the reply. ``timeout=None`` falls back to the
-        call's own timeout; expiry raises :class:`RpcTimeoutError`
-        (typed — never an indefinite block on a dead peer)."""
+        call's own (total, retries-included) timeout; if THAT is also
+        None, a default cap (``PADDLE_TPU_RPC_DEFAULT_TIMEOUT``,
+        120 s) applies — expiry raises :class:`RpcTimeoutError` (typed
+        — never an indefinite block on a dead peer)."""
         if timeout is None:
             timeout = self._timeout
+        if timeout is None:
+            timeout = _default_rpc_timeout()
         if not self._event.wait(timeout):
             raise RpcTimeoutError(self._to, self._seq, timeout)
         if self._error is not None:
@@ -88,6 +154,21 @@ class _RpcAgent:
         self._stop = threading.Event()
         self._req_seq = 0
         self._serve_from = 0
+        # at-least-once identity: every logical call gets one id; a
+        # retry reuses it, so the dispatcher can dedup redelivery. The
+        # per-agent nonce makes the identity unique ACROSS incarnations
+        # of one caller name — a replacement caller's counter restarts
+        # at 0, and without the nonce its first calls would hit the
+        # dead predecessor's cached replies
+        self._incarnation = os.urandom(6).hex()
+        self._call_ids = itertools.count()
+        # (caller, call_id) -> [reply bytes, last published seq or
+        # None]; bounded FIFO — the dedup window
+        self._reply_cache: collections.OrderedDict = \
+            collections.OrderedDict()
+        self._reply_cache_cap = max(
+            8, int(_env_float(REPLY_CACHE_ENV, _DEFAULT_REPLY_CACHE)))
+        self._m_retries, self._m_dups = _metrics()
         if dynamic:
             # a REPLACEMENT incarnation of this name must resume the
             # mailbox where the store's seq counter stands — starting at
@@ -134,18 +215,44 @@ class _RpcAgent:
             except TimeoutError:
                 continue
             st.delete_key(key)
-            reply_key = f"rpc/reply/{self.name}/{seq}"
+            reply = None
+            call_key = None
+            caller = None
             try:
-                fn, args, kwargs = pickle.loads(payload)
-                reply = b"ok:" + pickle.dumps(fn(*args, **kwargs))
+                msg = pickle.loads(payload)
+                if len(msg) == 5:
+                    # dedup envelope: a redelivered request (network
+                    # duplicate, or a retry whose original executed
+                    # but whose reply was lost) must NOT run the
+                    # handler again — republish the cached reply
+                    caller, cid, fn, args, kwargs = msg
+                    call_key = (caller, cid)
+                    cached = self._reply_cache.get(call_key)
+                    if cached is not None:
+                        self._m_dups.inc()
+                        reply = cached[0]
+                else:
+                    fn, args, kwargs = msg      # legacy envelope
+                if reply is None:
+                    reply = b"ok:" + pickle.dumps(fn(*args, **kwargs))
             except Exception as e:
                 reply = b"er:" + pickle.dumps(e)
+            if call_key is not None:
+                # cache BEFORE the tombstone check: even when a timed-
+                # out caller suppressed this publication, its retry
+                # must find the result here (exactly-once-effective)
+                for stale in self._cache_reply(call_key, reply, seq):
+                    st.delete_key(f"rpc/reply/{self.name}/{stale}")
+            # (rpc.reply faults fire on the WAITER side — the receiving
+            # end of the reply path — where a simulated loss can be
+            # cleaned up without leaking tombstones)
             # Tombstone protocol: a timed-out caller plants
             # rpc/dead/{name}/{seq}; consuming it means "don't publish,
             # nobody is waiting" — otherwise a late reply would leak in
             # the master store forever. Re-check after publishing to
             # close the set-between-check-and-publish race (the waiter
             # symmetrically deletes the reply if it was already out).
+            reply_key = f"rpc/reply/{self.name}/{seq}"
             tomb_key = f"rpc/dead/{self.name}/{seq}"
             if not st.delete_key(tomb_key):
                 st.set(reply_key, reply)
@@ -154,47 +261,153 @@ class _RpcAgent:
             seq += 1
             self._served = seq
 
-    def call(self, to, fn, args, kwargs, timeout):
-        seq = self.store.add(f"rpc/seq/{to}", 1) - 1
-        self.store.set(f"rpc/to/{to}/{seq}",
-                       pickle.dumps((fn, args or (), kwargs or {})))
-        fut = _FutureReply(to=to, seq=seq, timeout=timeout)
+    def _cache_reply(self, call_key, reply, seq):
+        """Remember a served call's reply for the dedup window and the
+        seqs it was published under; returns seqs whose publications
+        are now STALE and safe to reap. A publication is never reaped
+        right after a newer one lands (the primary's waiter may still
+        be mid-read) — only with generations of slack, plus whole
+        entries the bounded cache evicts. Dispatcher thread only."""
+        stale = []
+        entry = self._reply_cache.get(call_key)
+        if entry is None:
+            self._reply_cache[call_key] = [reply, [seq]]
+        else:
+            entry[1].append(seq)
+            if len(entry[1]) > 4:
+                stale.append(entry[1].pop(0))
+        self._reply_cache.move_to_end(call_key)
+        if len(self._reply_cache) > self._reply_cache_cap:
+            _, (_, seqs) = self._reply_cache.popitem(last=False)
+            stale.extend(seqs)
+        return stale
 
-        def waiter():
-            # per-call connection: the blocking reply-get must not pin
-            # the shared client (see _dispatch_store note)
-            conn = None
+    def call(self, to, fn, args, kwargs, timeout, retries=None,
+             backoff=0.05, backoff_max=2.0):
+        """At-least-once call: up to ``retries`` resends (exponential
+        backoff + jitter) of the SAME ``(caller, call_id)`` envelope on
+        :class:`RpcTimeoutError`; the peer's dedup cache makes the
+        retried call exactly-once-effective. ``timeout`` is the
+        PER-ATTEMPT reply budget; the returned future's own timeout is
+        the total across attempts. Handler exceptions are terminal —
+        only transport timeouts retry."""
+        if retries is None:
+            retries = _default_retries()
+        attempts = max(1, int(retries) + 1)
+        if timeout is None:
+            # defaulting here (not per attempt) keeps the retry
+            # contract intact for timeout=None calls: the future's
+            # total below covers every attempt, so a sync wait(None)
+            # outlives the retries instead of expiring at one
+            # attempt's default budget
+            timeout = _default_rpc_timeout()
+        cid = (self._incarnation, next(self._call_ids))
+        payload = pickle.dumps(
+            (self.name, cid, fn, args or (), kwargs or {}))
+        # per-attempt budget + worst-case backoff + slack: the driver
+        # thread decides the typed error, wait() is a backstop
+        total = attempts * timeout + sum(
+            min(backoff_max, backoff * (2 ** i))
+            for i in range(attempts - 1)) + 5.0
+        fut = _FutureReply(to=to, seq=None, timeout=total)
+
+        def driver():
+            delay = backoff
+            last_err = None
             try:
-                conn = self._connect()
-                rsp = conn.get(f"rpc/reply/{to}/{seq}", timeout=timeout)
-                conn.delete_key(f"rpc/reply/{to}/{seq}")
-                if rsp[:3] == b"er:":
-                    fut._set(None, pickle.loads(rsp[3:]))
-                else:
-                    fut._set(pickle.loads(rsp[3:]), None)
-            except Exception as e:
-                if isinstance(e, TimeoutError) \
-                        and not isinstance(e, RpcTimeoutError):
-                    # the store's bare TimeoutError means no reply
-                    # appeared within budget: surface it typed
-                    e = RpcTimeoutError(to, seq, timeout)
-                fut._set(None, e)
-                # Plant a tombstone so the (probably still running)
-                # handler skips publishing its reply; if the reply beat
-                # the tombstone, reap both keys ourselves.
-                if conn is not None:
-                    try:
-                        conn.set(f"rpc/dead/{to}/{seq}", b"1")
-                        if conn.delete_key(f"rpc/reply/{to}/{seq}"):
-                            conn.delete_key(f"rpc/dead/{to}/{seq}")
-                    except Exception:
-                        pass
-            finally:
-                if conn is not None:
-                    conn.close()
+                for attempt in range(attempts):
+                    if attempt:
+                        self._m_retries.inc()
+                        time.sleep(
+                            delay * (1.0 + 0.25 * random.random()))
+                        delay = min(backoff_max, delay * 2.0)
+                    err = self._attempt(to, payload, timeout, fut)
+                    if err is None:
+                        return          # fut already resolved
+                    last_err = err
+                    if not isinstance(err, RpcTimeoutError):
+                        break           # transport broke, not a loss
+            except Exception as e:      # noqa: BLE001 — a dying driver
+                last_err = e            # must resolve, never strand
+            fut._set(None, last_err)
 
-        threading.Thread(target=waiter, daemon=True).start()
+        threading.Thread(target=driver, daemon=True).start()
         return fut
+
+    def _attempt(self, to, payload, timeout, fut):
+        """One send + reply wait. Resolves ``fut`` and returns None on
+        a reply (ok or handler error); returns the transport error
+        (``RpcTimeoutError`` = retryable loss) otherwise. Runs on the
+        call's driver thread."""
+        verdict = _faults.fire_network("rpc.send", src=self.name,
+                                       dst=to)
+        if timeout is None:
+            timeout = _default_rpc_timeout()
+        if verdict.drop:
+            # the envelope never left this process: no seq claimed, no
+            # keys to clean — the loss surfaces as a typed timeout
+            return RpcTimeoutError(to, None, timeout)
+        deadline = time.monotonic() + timeout
+        # per-attempt connection: the blocking reply-get must not pin
+        # the shared client (see _dispatch_store note)
+        conn = None
+        seq = None
+        try:
+            if verdict.delay:
+                time.sleep(verdict.delay)   # in-flight latency: sleep,
+                # then claim the mailbox slot (no hole in the mailbox)
+            seq = self.store.add(f"rpc/seq/{to}", 1) - 1
+            fut._seq = seq
+            if verdict.hold:
+                # reorder: the slot is claimed but the payload lands
+                # late — later messages already queue behind this seq
+                time.sleep(verdict.hold)
+            self.store.set(f"rpc/to/{to}/{seq}", payload)
+            for _ in range(verdict.copies):
+                # duplicate delivery: same envelope, its own mailbox
+                # slot; the peer's dedup cache suppresses re-execution
+                dup = self.store.add(f"rpc/seq/{to}", 1) - 1
+                self.store.set(f"rpc/to/{to}/{dup}", payload)
+            conn = self._connect()
+            remaining = max(0.05, deadline - time.monotonic())
+            rsp = conn.get(f"rpc/reply/{to}/{seq}", timeout=remaining)
+            conn.delete_key(f"rpc/reply/{to}/{seq}")
+            rv = _faults.fire_network("rpc.reply", src=to,
+                                      dst=self.name)
+            if rv.delay or rv.hold:
+                time.sleep(rv.delay + rv.hold)
+            if rv.drop:
+                # the reply was lost in the network: the handler ran
+                # (and cached its reply), we never saw it — retry will
+                # hit the peer's dedup cache
+                return RpcTimeoutError(to, seq, timeout)
+            if rsp[:3] == b"er:":
+                fut._set(None, pickle.loads(rsp[3:]))
+            else:
+                fut._set(pickle.loads(rsp[3:]), None)
+            return None
+        except Exception as e:
+            if isinstance(e, TimeoutError) \
+                    and not isinstance(e, RpcTimeoutError):
+                # the store's bare TimeoutError means no reply
+                # appeared within budget: surface it typed
+                e = RpcTimeoutError(to, seq, timeout)
+            # Plant a tombstone so the (probably still running)
+            # handler skips publishing its reply; if the reply beat
+            # the tombstone, reap both keys ourselves. Nothing to
+            # plant when the claim itself failed (seq None: no message
+            # ever entered the mailbox).
+            if conn is not None and seq is not None:
+                try:
+                    conn.set(f"rpc/dead/{to}/{seq}", b"1")
+                    if conn.delete_key(f"rpc/reply/{to}/{seq}"):
+                        conn.delete_key(f"rpc/dead/{to}/{seq}")
+                except Exception:
+                    pass
+            return e
+        finally:
+            if conn is not None:
+                conn.close()
 
     def stop(self):
         self._stop.set()
@@ -229,6 +442,12 @@ class _RpcAgent:
                 # the orphaned request payload for an unserved seq is
                 # the bigger leak (arbitrary pickled args vs 1 byte)
                 conn.delete_key(f"rpc/to/{self.name}/{seq}")
+            # reap unconsumed publications the dedup cache still
+            # tracks: a duplicate-delivery republish whose waiter was
+            # long gone would otherwise leak its reply forever
+            for _, pseqs in list(self._reply_cache.values()):
+                for pseq in pseqs:
+                    conn.delete_key(f"rpc/reply/{self.name}/{pseq}")
         except Exception:
             pass    # best-effort: the store may already be gone
         finally:
@@ -272,14 +491,26 @@ class RpcEndpoint:
                                 store=store, dynamic=True)
         self._closed = False
 
-    def call(self, to, fn, args=None, kwargs=None, timeout=30.0):
+    def call(self, to, fn, args=None, kwargs=None, timeout=30.0,
+             retries=None):
         """Async call of ``fn(*args, **kwargs)`` on endpoint ``to``;
         returns a future whose ``wait()`` raises the peer's pickled
-        exception or a typed :class:`RpcTimeoutError`."""
-        return self._agent.call(to, fn, args, kwargs, timeout)
+        exception or a typed :class:`RpcTimeoutError`. ``timeout`` is
+        the per-attempt reply budget; a lost request or reply is
+        re-sent up to ``retries`` times (default
+        ``PADDLE_TPU_RPC_RETRIES``, 2) with exponential backoff +
+        jitter — the peer dedups redelivery, so the call stays
+        exactly-once-effective."""
+        return self._agent.call(to, fn, args, kwargs, timeout,
+                                retries=retries)
 
-    def call_sync(self, to, fn, args=None, kwargs=None, timeout=30.0):
-        return self.call(to, fn, args, kwargs, timeout).wait(timeout)
+    def call_sync(self, to, fn, args=None, kwargs=None, timeout=30.0,
+                  retries=None):
+        # wait(None): the future's own timeout is the retry-inclusive
+        # total — bounding the wait by one attempt's budget would kill
+        # the call before its retries ran
+        return self.call(to, fn, args, kwargs, timeout,
+                         retries=retries).wait(None)
 
     def stop(self):
         """Stop serving and sweep this endpoint's own tombstones.
@@ -320,21 +551,28 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     return _agent.store.port
 
 
-def rpc_sync(to, fn, args=None, kwargs=None, timeout=30.0):
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=30.0,
+             retries=None):
     """Blocking call of ``fn(*args, **kwargs)`` on worker ``to``.
 
-    ``timeout`` (seconds) bounds the synchronous wait: a dead peer or a
-    stuck handler raises :class:`RpcTimeoutError` (a
-    :class:`TimeoutError` subclass naming peer/seq/budget) instead of
-    blocking forever."""
-    return rpc_async(to, fn, args, kwargs, timeout).wait(timeout)
+    ``timeout`` (seconds) bounds each delivery attempt; a lost request
+    or reply is re-sent up to ``retries`` times (default
+    ``PADDLE_TPU_RPC_RETRIES``, 2) with exponential backoff + jitter —
+    redelivery is deduped by the peer, so the call stays exactly-once-
+    effective. A peer that never answers raises
+    :class:`RpcTimeoutError` (a :class:`TimeoutError` subclass naming
+    peer/seq/budget) after the bounded total instead of blocking
+    forever."""
+    return rpc_async(to, fn, args, kwargs, timeout,
+                     retries=retries).wait(None)
 
 
-def rpc_async(to, fn, args=None, kwargs=None, timeout=30.0):
+def rpc_async(to, fn, args=None, kwargs=None, timeout=30.0,
+              retries=None):
     """Returns a future with ``.wait()`` (reference returns FutureWrapper)."""
     if _agent is None:
         raise RuntimeError("call init_rpc first")
-    return _agent.call(to, fn, args, kwargs, timeout)
+    return _agent.call(to, fn, args, kwargs, timeout, retries=retries)
 
 
 def get_current_worker_info():

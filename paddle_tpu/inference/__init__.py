@@ -115,6 +115,7 @@ _LAZY = {
     "AdmissionError": "serving", "DeadlineExceeded": "serving",
     "ServingCluster": "cluster", "EngineReplica": "cluster",
     "SubprocessReplica": "cluster", "ReplicaLostError": "cluster",
+    "StaleEpochError": "cluster",
     "ClusterRequest": "cluster", "PrefixCache": "prefix_cache",
     "PageAllocator": "paged_cache", "replica_main": "replica_worker",
     "NGramDrafter": "speculative",

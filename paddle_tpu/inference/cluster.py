@@ -42,7 +42,18 @@ shared filesystem).
 Fault points: ``router.route`` fires per routing decision and
 ``replica.dead`` fires per worker-loop tick, so a ``PADDLE_TPU_FAULTS``
 plan can inject routing errors or kill replica N at tick K
-deterministically in CI.
+deterministically in CI. Network rules at ``store.heartbeat`` /
+``rpc.send`` / ``rpc.reply`` drop, delay, duplicate, or partition the
+control-plane messages themselves.
+
+Partition tolerance (ISSUE 11): every replica incarnation registers
+under a fresh monotonic EPOCH from the store; heartbeats and request
+submissions stamped with a fenced-out epoch raise a typed
+:class:`~paddle_tpu.distributed.watchdog.StaleEpochError`, so a
+partitioned-but-alive old incarnation can never race its supervisor-
+spawned replacement — and a request that completes on both emits
+exactly once (first terminal report wins, token-exact;
+``cluster_duplicate_completions_suppressed_total``).
 """
 
 from __future__ import annotations
@@ -57,7 +68,8 @@ import time
 
 import numpy as np
 
-from ..distributed.watchdog import ElasticManager, FileStore
+from ..distributed.watchdog import (ElasticManager, FileStore,
+                                    StaleEpochError)
 from ..observability import metrics as _om
 from ..observability.trace import span as _span
 from ..testing import faults as _faults
@@ -65,7 +77,22 @@ from .serving import (AdmissionError, DeadlineExceeded,
                       LlamaServingEngine, Request)
 
 __all__ = ["ClusterRequest", "EngineReplica", "SubprocessReplica",
-           "ServingCluster", "ReplicaLostError"]
+           "ServingCluster", "ReplicaLostError", "StaleEpochError"]
+
+
+def _m_stale():
+    return _om.counter(
+        "cluster_stale_epoch_rejections_total",
+        "membership/submission actions rejected because their epoch "
+        "was fenced out by a newer incarnation")
+
+
+def _m_dup_completions():
+    return _om.counter(
+        "cluster_duplicate_completions_suppressed_total",
+        "terminal reports for an already-finished cluster request "
+        "(split-brain / failover double completion) suppressed — the "
+        "first terminal state won, token-exact")
 
 
 class ReplicaLostError(RuntimeError):
@@ -232,21 +259,30 @@ class ClusterRequest:
                 "retry_budget": self.retry_budget}
 
     def _finish_from(self, req):
-        """Adopt an engine request's terminal state."""
+        """Adopt an engine request's terminal state. Exactly-once: a
+        second terminal report (the request completed on BOTH an
+        orphaned incarnation and its failover target) is suppressed —
+        the first emission won, token-exact — and counted. Returns
+        whether the report was adopted."""
         with self._lock:
             if self._finished.is_set():
-                return
+                _m_dup_completions().inc()
+                return False
             self.output_ids = list(req.output_ids)
             self._finish_locked(req.status, req.error)
+            return True
 
     def _finish_remote(self, status, output_ids, error):
         """Adopt a terminal state reported by a subprocess replica over
-        rpc (the error arrives pickled — typed, fields intact)."""
+        rpc (the error arrives pickled — typed, fields intact). Same
+        exactly-once contract as :meth:`_finish_from`."""
         with self._lock:
             if self._finished.is_set():
-                return
+                _m_dup_completions().inc()
+                return False
             self.output_ids = list(output_ids or [])
             self._finish_locked(status, error)
+            return True
 
     def _fail(self, status, error):
         with self._lock:
@@ -306,11 +342,15 @@ class EngineReplica:
         self._hb_thread = None
         self._draining = False
         self._dead = False
+        self._fenced = False
         self._death_reason = None
         self._last_beat = 0.0
         self._ticks = 0
         self._beats = 0
         self._spawns = 0
+        #: membership fencing token of the CURRENT incarnation (bumped
+        #: by every start/restart through the store's epoch counter)
+        self.epoch = 0
         self._m_dead = _om.counter(
             "replica_deaths_total",
             "replica worker loops that died uncleanly")
@@ -320,15 +360,21 @@ class EngineReplica:
         with self._lock:
             if self._thread is not None and self._thread.is_alive():
                 return self
-        # retire the previous incarnation's threads BEFORE clearing the
-        # stop event: clearing first can resurrect a heartbeat sidecar
-        # still parked in its wait() — two sidecars then stamp one id,
-        # and a DEAD incarnation's survivor would keep a ghost fresh in
-        # membership past its real death
+        # retire the previous incarnation's threads: each incarnation
+        # owns its stop event + epoch (closure args), so a straggler
+        # that outlives the bounded join below — a sidecar stuck in a
+        # slow/faulted heartbeat — is HARMLESS: its next stamp attempt
+        # carries the old epoch and the store fences it out with a
+        # typed StaleEpochError instead of resurrecting a ghost. The
+        # join is hygiene, not correctness, so it must not block a
+        # replacement behind a wedged old thread for long.
         self._stop.set()
-        for t in (self._thread, self._hb_thread):
-            if t is not None and t is not threading.current_thread():
-                t.join(timeout=5.0)
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        t = self._hb_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=1.0)
         # deterministic spawn failure for chaos plans: a raise rule at
         # serve.spawn (path = replica id, step = spawn ordinal) fails
         # this start/restart the way a full host or a bad image fails a
@@ -341,17 +387,22 @@ class EngineReplica:
             _faults.fire("serve.spawn", step=spawn,
                          path=self.replica_id)
         with self._lock:
-            self._stop.clear()
+            # fresh per-incarnation stop event: a straggler thread of
+            # the old incarnation keeps ITS event (closure arg) and can
+            # never be resurrected by this clear
+            stop = self._stop = threading.Event()
             self._draining = False
             self._dead = False
+            self._fenced = False
             self._death_reason = None
         if self.engine is None:
             self.engine = self._factory()
         if self.max_backlog is None:
             self.max_backlog = self.engine.max_batch * 4
         self._register()
+        epoch = self.epoch
         self._thread = threading.Thread(
-            target=self._run, daemon=True,
+            target=self._run, args=(stop,), daemon=True,
             name=f"replica-{self.replica_id}")
         self._thread.start()
         if self.store is not None:
@@ -360,18 +411,23 @@ class EngineReplica:
             # a DEAD worker stops the sidecar, so death still surfaces
             # as TTL expiry
             self._hb_thread = threading.Thread(
-                target=self._hb_loop, daemon=True,
+                target=self._hb_loop, args=(stop, epoch), daemon=True,
                 name=f"replica-{self.replica_id}-hb")
             self._hb_thread.start()
         return self
 
     def _register(self):
         if self.store is not None:
-            self.store.register(self.replica_id)
+            # registration carries a FRESH epoch from the store: the
+            # supervisor's kill-and-replace and rolling_restart() both
+            # come through here, so every replacement incarnation
+            # fences out its predecessor by construction
+            self.epoch = self.store.next_epoch(self.replica_id)
+            self.store.register(self.replica_id, epoch=self.epoch)
             self._last_beat = time.monotonic()
 
-    def _hb_loop(self):
-        while not self._stop.wait(self._hb_interval):
+    def _hb_loop(self, stop, epoch):
+        while not stop.wait(self._hb_interval):
             if self._dead or not self.alive():
                 return      # a crashed host never says goodbye
             # chaos hook: a hang/sleep rule at replica.heartbeat (path =
@@ -382,7 +438,15 @@ class EngineReplica:
                          path=self.replica_id)
             self._beats += 1
             try:
-                self.store.heartbeat(self.replica_id)
+                self.store.heartbeat(self.replica_id, epoch=epoch)
+            except StaleEpochError:
+                # fenced out: a replacement incarnation owns this name
+                # now. If WE are still the current incarnation (an
+                # external same-named replica replaced us), stop
+                # serving; an old straggler sidecar just exits.
+                if self.epoch == epoch:
+                    self._fenced = True
+                return
             except OSError:
                 pass
 
@@ -406,6 +470,7 @@ class EngineReplica:
 
     def ready(self):
         return (self.alive() and not self._draining
+                and not self._fenced
                 and self.engine is not None and self.engine.is_ready())
 
     def load(self):
@@ -444,11 +509,19 @@ class EngineReplica:
             out["page_size"] = e.page_size
         return out
 
-    def submit(self, creq):
+    def submit(self, creq, epoch=None):
         """Queue a request for this replica's worker. Raises a typed
         :class:`AdmissionError` (with the engine's ``retry_after``
         estimate) when the replica is not accepting or its backlog is
-        full — the router's cue to pick another replica."""
+        full — the router's cue to pick another replica. A submission
+        stamped with an ``epoch`` other than this incarnation's is
+        rejected with a typed :class:`StaleEpochError`: neither a
+        stale router view nor a fenced-out old incarnation may accept
+        work addressed to its successor."""
+        if epoch is not None and int(epoch) != self.epoch:
+            _m_stale().inc()
+            raise StaleEpochError(self.replica_id, int(epoch),
+                                  self.epoch)
         e = self.engine
         with self._lock:
             if self._dead or self._draining or e is None:
@@ -470,9 +543,9 @@ class EngineReplica:
             self._backlog.append(creq)
 
     # -- worker loop ----------------------------------------------------
-    def _run(self):
+    def _run(self, stop):
         try:
-            while not self._stop.is_set():
+            while not stop.is_set():
                 # deterministic kill switch for CI plans: a rule at
                 # replica.dead (action raise/hang) takes this worker
                 # down as a crash, not a drain
@@ -692,6 +765,10 @@ class SubprocessReplica:
         self._load = None             # last load dict seen by the poller
         self._remote_ready = False
         self._registered_seen = False
+        #: the worker's membership epoch, mirrored from its poll reply;
+        #: stamped onto submissions so a fenced-out old incarnation
+        #: sharing the rpc mailbox name can never accept them
+        self.epoch = None
         self._spawn_t = None
         self._draining = False
         self._dead = False
@@ -809,10 +886,12 @@ class SubprocessReplica:
             try:
                 rsp = self.endpoint.call_sync(
                     self.replica_id, _rw._worker_poll, (ids,),
-                    timeout=2.0)
+                    timeout=2.0, retries=1)
             except Exception:
                 continue    # starting or wedged: proc + TTL judge that
             self._remote_ready = bool(rsp.get("ready"))
+            if rsp.get("epoch") is not None:
+                self.epoch = rsp["epoch"]
             # NOTE: rpc reachability is NOT membership — the worker's
             # dispatcher is up before it registers, and latching
             # _registered_seen here would turn "still starting" into
@@ -900,12 +979,25 @@ class SubprocessReplica:
         spec = creq._attempt_spec(self.replica_id)
         if spec is None:
             return          # finished typed (cluster deadline) already
+        # fence the submission with the epoch this router observed: if
+        # the call lands in a partitioned OLD incarnation's dispatcher
+        # (both incarnations share the name-keyed mailbox), that
+        # incarnation rejects it typed instead of serving as a ghost
+        spec["epoch"] = self.epoch
         try:
             req_id = self.endpoint.call_sync(
                 self.replica_id, _rw._worker_submit, (spec,),
                 timeout=self.submit_timeout)
         except AdmissionError:
             raise           # typed backpressure, fields intact (pickled)
+        except StaleEpochError as e:
+            # OUR view of the epoch is stale (the worker restarted
+            # under a newer one): not accepting right now — the poller
+            # refreshes the epoch and the router retries a peer
+            raise AdmissionError(
+                f"replica {self.replica_id} rejected a stale-epoch "
+                f"submission ({e})", live=0, max_batch=0, free_pages=0,
+                num_pages=0, retries=0) from e
         except Exception as e:
             # transport failure == not accepting: the router's cue to
             # try a peer; liveness is the supervisor's job, not submit's
@@ -926,7 +1018,7 @@ class SubprocessReplica:
             return
         try:
             self.endpoint.call_sync(self.replica_id, _rw._worker_cancel,
-                                    (req_id,), timeout=5.0)
+                                    (req_id,), timeout=5.0, retries=1)
         except Exception:
             pass            # dead replica: the monitor reaps it anyway
 
@@ -939,7 +1031,7 @@ class SubprocessReplica:
         try:
             self.endpoint.call_sync(self.replica_id,
                                     _rw._worker_begin_drain, (),
-                                    timeout=5.0)
+                                    timeout=5.0, retries=1)
         except Exception:
             pass
 
@@ -951,7 +1043,7 @@ class SubprocessReplica:
         try:
             ids = self.endpoint.call_sync(
                 self.replica_id, _rw._worker_take_backlog, (),
-                timeout=5.0)
+                timeout=5.0, retries=1)
         except Exception:
             return []
         out = []
@@ -985,9 +1077,14 @@ class SubprocessReplica:
         from . import replica_worker as _rw
 
         try:
+            # retries=0: the per-attempt budget already covers a full
+            # worker-side drain (grace + slack), so a timeout means a
+            # dead/partitioned worker — retrying would stall a rolling
+            # restart by another grace+30 for a benign fallback (the
+            # reap + failover paths own the requests either way)
             stats = self.endpoint.call_sync(
                 self.replica_id, _rw._worker_drain, (grace,),
-                timeout=grace + 30.0)
+                timeout=grace + 30.0, retries=0)
         except Exception:
             stats = {"seconds": 0.0, "completed": 0, "expired": 0}
         # mirror the drained requests' terminal states NOW (the
@@ -1009,7 +1106,8 @@ class SubprocessReplica:
             return
         try:
             rsp = self.endpoint.call_sync(
-                self.replica_id, _rw._worker_poll, (ids,), timeout=10.0)
+                self.replica_id, _rw._worker_poll, (ids,), timeout=10.0,
+                retries=1)
         except Exception:
             return          # dead/unreachable: failover owns these
         for req_id, state in (rsp.get("requests") or {}).items():
@@ -1049,9 +1147,12 @@ class SubprocessReplica:
         if p.poll() is None:
             for _ in range(2):      # a lost first ask is retried once
                 try:
+                    # retries=0: this loop IS the retry policy — the
+                    # rpc layer doubling it would block stop() for up
+                    # to 6 attempts against an already-exiting worker
                     self.endpoint.call_sync(self.replica_id,
                                             _rw._worker_exit, (),
-                                            timeout=timeout)
+                                            timeout=timeout, retries=0)
                     break
                 except Exception:
                     continue
@@ -1264,10 +1365,30 @@ class ServingCluster:
         ``start_http_server(ready=cluster.ready)`` for ``/readyz``)."""
         return any(r.ready() for r in self.replicas().values())
 
+    def membership_info(self):
+        """Per-replica membership view for /healthz: current epoch,
+        last-heartbeat age (fs-server clock), and liveness — what an
+        operator reads to spot a fenced-out stale incarnation without
+        grepping logs."""
+        out = {}
+        quarantined = self.quarantined()
+        for rid, rep in self.replicas().items():
+            out[rid] = {
+                "epoch": getattr(rep, "epoch", None),
+                "heartbeat_age_seconds": self.store.heartbeat_age(rid),
+                "alive": rep.alive(),
+                "ready": rep.ready(),
+                "quarantined": rid in quarantined,
+            }
+        return {"membership": out}
+
     def start_http_server(self, port=0, addr="127.0.0.1"):
-        """Metrics + /healthz + /readyz endpoint for the whole tier."""
+        """Metrics + /healthz + /readyz endpoint for the whole tier.
+        /healthz carries :meth:`membership_info` (epochs + heartbeat
+        ages)."""
         from ..observability.export import start_http_server
-        return start_http_server(port=port, addr=addr, ready=self.ready)
+        return start_http_server(port=port, addr=addr, ready=self.ready,
+                                 health_info=self.membership_info)
 
     # -- routing --------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=16, eos_token_id=None,

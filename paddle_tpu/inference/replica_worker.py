@@ -122,7 +122,13 @@ def _worker_submit(spec):
     """Admit one request spec into the replica's backlog. Returns a
     request id the router polls; raises a typed (picklable)
     AdmissionError when the replica is draining or its backlog is
-    full — the rpc error reply carries it back intact."""
+    full — the rpc error reply carries it back intact. A spec stamped
+    with an ``epoch`` other than this incarnation's membership epoch
+    is rejected with a typed StaleEpochError: a submission addressed
+    to the replacement must never be served by a partitioned old
+    incarnation consuming the same name-keyed mailbox (and vice
+    versa). Retried submits (at-least-once rpc) are deduped by the
+    dispatcher's reply cache, so admission stays exactly-once."""
     from .cluster import ClusterRequest
 
     w = _require()
@@ -132,7 +138,7 @@ def _worker_submit(spec):
         spec.get("token_budget"), spec.get("priority", 0),
         spec.get("retry_budget", 1))
     creq._t_submit = time.perf_counter()
-    w.rep.submit(creq)
+    w.rep.submit(creq, epoch=spec.get("epoch"))
     req_id = f"{w.replica_id}:{next(w._seq)}"
     with w._lock:
         w._reqs[req_id] = creq
@@ -170,6 +176,7 @@ def _worker_poll(req_ids):
     # still in flight
     return {"ready": w.rep.ready() and w.restart_ttft is not None,
             "load": w.rep.load(), "restart_ttft": w.restart_ttft,
+            "epoch": w.rep.epoch,
             "cache": _cw.persistent_cache_stats(), "requests": reqs}
 
 
@@ -326,7 +333,17 @@ def replica_main():
     if health_port:
         from ..observability.export import start_http_server
 
-        srv = start_http_server(port=int(health_port), ready=rep.ready)
+        def _health_info():
+            # /healthz names the membership epoch + heartbeat age so
+            # an operator can spot a fenced-out stale incarnation from
+            # the probe alone (ISSUE 11 satellite)
+            return {"replica_id": replica_id, "epoch": rep.epoch,
+                    "fenced": rep._fenced,
+                    "membership_heartbeat_age_seconds":
+                        store.heartbeat_age(replica_id)}
+
+        srv = start_http_server(port=int(health_port), ready=rep.ready,
+                                health_info=_health_info)
         # port=0 picks a free port; publish it next to the membership
         # stamps (dot-prefixed: hosts() ignores it)
         with open(os.path.join(store_path, f".http.{replica_id}"),
@@ -343,6 +360,13 @@ def replica_main():
                 # WITHOUT deregistering: a crashed host never says
                 # goodbye; membership TTL is the detector.
                 os._exit(17)
+            if rep._fenced:
+                # fenced out by a replacement incarnation (stale-epoch
+                # heartbeat rejection): stop serving immediately and —
+                # critically — do NOT deregister: the stamp belongs to
+                # the replacement now, and removing it would knock the
+                # HEALTHY successor out of membership
+                os._exit(19)
     finally:
         # clean exit: give the dispatcher a beat to flush the
         # _worker_exit reply, then say goodbye properly

@@ -135,7 +135,7 @@ class ScrapeServer:
 
 
 def start_http_server(port=0, addr="127.0.0.1", registry=None,
-                      ready=None):
+                      ready=None, health_info=None):
     """Serve ``/metrics`` (Prometheus text; HEAD supported for cheap
     reachability checks), ``/metrics.json``, ``/healthz`` (200 +
     uptime/pid JSON — the liveness probe serving deployments point at
@@ -149,7 +149,13 @@ def start_http_server(port=0, addr="127.0.0.1", registry=None,
     admission-paused serving replica is in, so load balancers stop
     routing BEFORE ``drain()`` finishes. ``/healthz`` stays 200 the
     whole time (the process is healthy; restarting it would be wrong).
-    With ``ready=None``, ``/readyz`` mirrors ``/healthz``."""
+    With ``ready=None``, ``/readyz`` mirrors ``/healthz``.
+
+    ``health_info`` is an optional zero-arg callable whose dict is
+    merged into the ``/healthz`` document per probe (e.g. membership
+    epoch + last-heartbeat age, so an operator can spot a fenced-out
+    stale incarnation from the probe alone); a raising callable
+    degrades to the base document rather than failing liveness."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry if registry is not None else default_registry()
@@ -168,6 +174,11 @@ def start_http_server(port=0, addr="127.0.0.1", registry=None,
                 doc = {"status": "ok", "pid": os.getpid(),
                        "uptime_seconds": round(
                            time.monotonic() - t_start, 3)}
+                if health_info is not None:
+                    try:
+                        doc.update(health_info() or {})
+                    except Exception:
+                        pass    # liveness must not fail on extras
                 return 200, json.dumps(doc).encode(), "application/json"
             if self.path == "/readyz":
                 ok = True

@@ -61,6 +61,38 @@ Rule fields (all optional except ``point`` and ``action``):
   unlimited).
 - ``seconds``: duration for ``sleep`` / ``hang`` (defaults 0.1 / 3600).
 - ``exit_code``: for ``crash`` (default 23).
+
+Network rules (ISSUE 11): a rule whose ``action`` is one of ``drop`` /
+``delay`` / ``duplicate`` / ``reorder`` / ``partition`` is a
+:class:`NetworkRule` — it fires at MESSAGE points (``rpc.send``,
+``rpc.reply``, ``store.heartbeat``) via :func:`fire_network`, matched
+by the ``(src, dst)`` name pair (fnmatch globs), and returns a
+:class:`NetworkVerdict` the transport interprets instead of performing
+a process action:
+
+- ``drop``: the message is lost — the caller sees a timeout and (with
+  at-least-once rpc) retries.
+- ``delay``: the message is held ``seconds`` before it is handed to
+  the transport (in-flight latency).
+- ``duplicate``: the message is delivered ``copies`` extra times — the
+  receiver's dedup cache must make redelivery exactly-once-effective.
+- ``reorder``: the message's mailbox slot is claimed, then held for a
+  seeded-random fraction of ``seconds`` before the payload lands — in
+  a sequential mailbox transport true reorder degenerates to
+  head-of-line delay, which is what this injects.
+- ``partition``: every matching message is dropped for a wall-clock
+  window of ``seconds`` (default 1.0) measured from the rule's first
+  match — a full network partition between the matched pair.
+
+Extra network-rule fields: ``src`` / ``dst`` (fnmatch globs on the
+endpoint names), ``p`` (per-message fire probability, drawn from a
+rule-local ``random.Random(seed)`` so a seeded chaos schedule replays
+identically), ``seed``, ``copies``.
+
+Plans are VALIDATED at parse time: an unknown rule key, an unknown
+action, or a point name that no instrumented call site registers
+raises a clear ``ValueError`` — a typo'd chaos plan fails loudly
+instead of silently never firing.
 """
 
 from __future__ import annotations
@@ -68,17 +100,41 @@ from __future__ import annotations
 import fnmatch
 import json
 import os
+import random
 import signal
+import threading
 import time
 
-__all__ = ["PLAN_ENV", "FaultRule", "FaultPlan", "plan", "reset",
-           "active", "fire", "rename", "bitflip"]
+__all__ = ["PLAN_ENV", "FaultRule", "NetworkRule", "NetworkVerdict",
+           "FaultPlan", "plan", "reset", "active", "fire",
+           "fire_network", "rename", "bitflip", "PROCESS_POINTS",
+           "NETWORK_POINTS"]
 
 #: environment variable holding the JSON fault plan
 PLAN_ENV = "PADDLE_TPU_FAULTS"
 
 _ACTIONS = ("crash", "sigkill", "sigterm", "hang", "sleep", "raise",
             "bitflip")
+
+_NET_ACTIONS = ("drop", "delay", "duplicate", "reorder", "partition")
+
+#: instrumented process points — :func:`fire` call sites. A plan naming
+#: any other point is a typo and fails at parse time.
+PROCESS_POINTS = frozenset({
+    "ckpt.write", "ckpt.before_marker", "ckpt.save_begin",
+    "ckpt.committed", "rename", "train.step", "serve.admit",
+    "serve.decode", "serve.drain", "serve.spawn", "replica.dead",
+    "replica.heartbeat", "router.route",
+})
+
+#: instrumented message points — :func:`fire_network` call sites
+NETWORK_POINTS = frozenset({"rpc.send", "rpc.reply", "store.heartbeat"})
+
+_RULE_KEYS = frozenset({"point", "action", "step", "path", "env",
+                        "count", "seconds", "exit_code", "exc"})
+_NET_RULE_KEYS = frozenset({"point", "action", "src", "dst", "p",
+                            "seed", "count", "step", "seconds",
+                            "copies", "env"})
 
 #: injectable exception types for ``raise`` rules — a closed set, so a
 #: plan can't name arbitrary symbols
@@ -91,7 +147,18 @@ class FaultRule:
     action (and may not return)."""
 
     def __init__(self, spec):
+        unknown = set(spec) - _RULE_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown fault rule key(s) {sorted(unknown)}; expected "
+                f"a subset of {sorted(_RULE_KEYS)}")
         self.point = spec["point"]
+        if self.point not in PROCESS_POINTS:
+            raise ValueError(
+                f"unregistered fault point {self.point!r}; instrumented "
+                f"points are {sorted(PROCESS_POINTS)} (network points "
+                f"{sorted(NETWORK_POINTS)} take network actions "
+                f"{_NET_ACTIONS})")
         self.action = spec["action"]
         if self.action not in _ACTIONS:
             raise ValueError(
@@ -153,15 +220,151 @@ class FaultRule:
             bitflip(path)
 
 
+class NetworkVerdict:
+    """What the matching network rules decided for ONE message. The
+    transport interprets it: ``drop`` — never send (the caller times
+    out); ``delay`` — sleep this long before handing the message to the
+    transport; ``hold`` — claim the mailbox slot first, THEN sleep this
+    long before the payload lands (reorder's head-of-line shape);
+    ``copies`` — deliver this many extra copies."""
+
+    __slots__ = ("drop", "delay", "hold", "copies")
+
+    def __init__(self):
+        self.drop = False
+        self.delay = 0.0
+        self.hold = 0.0
+        self.copies = 0
+
+    def __bool__(self):
+        return self.drop or self.delay > 0 or self.hold > 0 \
+            or self.copies > 0
+
+    def __repr__(self):
+        return (f"NetworkVerdict(drop={self.drop}, delay={self.delay}, "
+                f"hold={self.hold}, copies={self.copies})")
+
+
+#: shared falsy verdict returned when no rule matched (never mutated)
+_NO_VERDICT = NetworkVerdict()
+
+
+class NetworkRule:
+    """One parsed network-plan entry. Matching is pure except for the
+    rule-local seeded RNG draw (``p``) and the partition window clock;
+    the verdict is applied by the transport, not here."""
+
+    def __init__(self, spec):
+        unknown = set(spec) - _NET_RULE_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown network fault rule key(s) {sorted(unknown)}; "
+                f"expected a subset of {sorted(_NET_RULE_KEYS)}")
+        self.point = spec["point"]
+        if self.point not in NETWORK_POINTS:
+            raise ValueError(
+                f"unregistered network fault point {self.point!r}; "
+                f"instrumented message points are "
+                f"{sorted(NETWORK_POINTS)}")
+        self.action = spec["action"]
+        if self.action not in _NET_ACTIONS:
+            raise ValueError(
+                f"unknown network fault action {self.action!r}; "
+                f"expected one of {_NET_ACTIONS}")
+        self.src = spec.get("src")
+        self.dst = spec.get("dst")
+        self.p = float(spec.get("p", 1.0))
+        if not 0.0 <= self.p <= 1.0:
+            raise ValueError(f"network rule p={self.p} outside [0, 1]")
+        self.seed = int(spec.get("seed", 0))
+        self.count = spec.get("count")
+        self.step = spec.get("step")
+        self.seconds = spec.get("seconds")
+        self.copies = int(spec.get("copies", 1))
+        self.env = spec.get("env") or {}
+        self._rng = random.Random(self.seed)
+        self._window_start = None       # partition: first-match stamp
+        self.fired = 0
+
+    def _endpoint_match(self, pattern, name):
+        if pattern is None:
+            return True
+        if name is None:
+            return False
+        return fnmatch.fnmatch(str(name), pattern)
+
+    def matches(self, point, src, dst, step):
+        if point != self.point:
+            return False
+        if not (self._endpoint_match(self.src, src)
+                and self._endpoint_match(self.dst, dst)):
+            return False
+        if self.step is not None and step != self.step:
+            return False
+        for k, v in self.env.items():
+            if os.environ.get(k) != str(v):
+                return False
+        if self.action == "partition":
+            # window semantics: active for `seconds` of wall clock from
+            # the FIRST match; p/count do not apply — a partition drops
+            # everything it sees while it lasts
+            now = time.monotonic()
+            if self._window_start is None:
+                self._window_start = now
+            return now - self._window_start \
+                < (self.seconds if self.seconds is not None else 1.0)
+        if self.count is not None and self.fired >= self.count:
+            return False
+        if self.p < 1.0 and self._rng.random() >= self.p:
+            return False
+        return True
+
+    def apply(self, verdict):
+        self.fired += 1
+        if self.action in ("drop", "partition"):
+            verdict.drop = True
+        elif self.action == "delay":
+            verdict.delay += self.seconds if self.seconds is not None \
+                else 0.05
+        elif self.action == "duplicate":
+            verdict.copies += self.copies
+        elif self.action == "reorder":
+            verdict.hold += self._rng.uniform(
+                0.0, self.seconds if self.seconds is not None else 0.2)
+        return verdict
+
+
 class FaultPlan:
     def __init__(self, rules):
-        self.rules = [r if isinstance(r, FaultRule) else FaultRule(r)
-                      for r in rules]
+        self.rules = []
+        self.net_rules = []
+        # network matching mutates rule state (count, seeded rng,
+        # partition window) and is called from concurrent rpc driver
+        # threads and heartbeat sidecars: serialize it, or a count=1
+        # rule fires twice and seeded replays stop being deterministic
+        self._net_lock = threading.Lock()
+        for r in rules:
+            if isinstance(r, (FaultRule, NetworkRule)):
+                rule = r
+            elif r.get("action") in _NET_ACTIONS:
+                rule = NetworkRule(r)
+            else:
+                rule = FaultRule(r)
+            (self.net_rules if isinstance(rule, NetworkRule)
+             else self.rules).append(rule)
 
     def fire(self, point, step=None, path=None):
         for rule in self.rules:
             if rule.matches(point, step, path):
                 rule.perform(point, step, path)
+
+    def fire_network(self, point, src=None, dst=None, step=None):
+        verdict = None
+        with self._net_lock:
+            for rule in self.net_rules:
+                if rule.matches(point, src, dst, step):
+                    verdict = rule.apply(verdict or NetworkVerdict())
+        return verdict if verdict is not None else _NO_VERDICT
 
 
 _plan: "FaultPlan | None" = None
@@ -198,6 +401,17 @@ def fire(point, step=None, path=None):
     p = plan()
     if p is not None:
         p.fire(point, step=step, path=path)
+
+
+def fire_network(point, src=None, dst=None, step=None):
+    """Message-point hook: returns the merged :class:`NetworkVerdict`
+    of every matching network rule (a shared falsy verdict without a
+    plan — one cached-None check on the hot path). The TRANSPORT
+    applies the verdict; this function never sleeps or raises."""
+    p = plan()
+    if p is None:
+        return _NO_VERDICT
+    return p.fire_network(point, src=src, dst=dst, step=step)
 
 
 def rename(src, dst, step=None):

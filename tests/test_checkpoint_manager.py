@@ -219,12 +219,12 @@ class TestAsyncSave:
 class TestFaultHarness:
     def test_rule_count_limits_fires(self, tmp_path, monkeypatch):
         monkeypatch.setenv(faults.PLAN_ENV, json.dumps(
-            [{"point": "x", "action": "raise", "count": 2}]))
+            [{"point": "train.step", "action": "raise", "count": 2}]))
         faults.reset()
         for _ in range(2):
             with pytest.raises(OSError):
-                faults.fire("x")
-        faults.fire("x")                        # count exhausted: no-op
+                faults.fire("train.step")
+        faults.fire("train.step")               # count exhausted: no-op
 
     def test_step_and_point_filters(self, monkeypatch):
         monkeypatch.setenv(faults.PLAN_ENV, json.dumps(
@@ -237,14 +237,14 @@ class TestFaultHarness:
 
     def test_env_condition_gates_rule(self, monkeypatch):
         monkeypatch.setenv(faults.PLAN_ENV, json.dumps(
-            [{"point": "x", "action": "raise",
+            [{"point": "train.step", "action": "raise",
               "env": {"PADDLE_RESTART_COUNT": "0"}}]))
         faults.reset()
         monkeypatch.delenv("PADDLE_RESTART_COUNT", raising=False)
-        faults.fire("x")                        # env mismatch: inactive
+        faults.fire("train.step")               # env mismatch: inactive
         monkeypatch.setenv("PADDLE_RESTART_COUNT", "0")
         with pytest.raises(OSError):
-            faults.fire("x")
+            faults.fire("train.step")
 
     def test_path_glob_matches_basename(self, monkeypatch):
         monkeypatch.setenv(faults.PLAN_ENV, json.dumps(

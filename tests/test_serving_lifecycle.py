@@ -493,13 +493,14 @@ class TestFaultExc:
 
     def test_unknown_exc_rejected_at_parse(self):
         with pytest.raises(ValueError, match="unknown exc"):
-            faults.FaultRule({"point": "x", "action": "raise",
+            faults.FaultRule({"point": "serve.admit", "action": "raise",
                               "exc": "SystemExit"})
 
     def test_default_exc_is_oserror(self):
-        rule = faults.FaultRule({"point": "x", "action": "raise"})
+        rule = faults.FaultRule({"point": "serve.admit",
+                                 "action": "raise"})
         with pytest.raises(OSError):
-            rule.perform("x", None, None)
+            rule.perform("serve.admit", None, None)
 
 
 # ---------------------------------------------------------------------
