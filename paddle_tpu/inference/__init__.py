@@ -119,6 +119,9 @@ _LAZY = {
     "ClusterRequest": "cluster", "PrefixCache": "prefix_cache",
     "PageAllocator": "paged_cache", "replica_main": "replica_worker",
     "NGramDrafter": "speculative",
+    # the real-traffic front door (ROADMAP item 4)
+    "SamplingParams": "sampling", "ServingFrontend": "frontend",
+    "ByteTokenizer": "frontend", "QosGate": "qos", "Tenant": "qos",
 }
 
 

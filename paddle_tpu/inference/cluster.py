@@ -73,6 +73,7 @@ from ..distributed.watchdog import (ElasticManager, FileStore,
 from ..observability import metrics as _om
 from ..observability.trace import span as _span
 from ..testing import faults as _faults
+from .sampling import SamplingParams
 from .serving import (AdmissionError, DeadlineExceeded,
                       LlamaServingEngine, Request)
 
@@ -163,7 +164,8 @@ class ClusterRequest:
 
     def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                  deadline=None, token_budget=None, priority=0,
-                 retry_budget=1, failover_budget=3):
+                 retry_budget=1, failover_budget=3, sampling=None,
+                 stop=(), on_token=None):
         self.prompt_ids = np.asarray(prompt_ids, np.int64).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
@@ -172,19 +174,40 @@ class ClusterRequest:
         self.priority = int(priority)
         self.retry_budget = int(retry_budget)
         self.failover_budget = int(failover_budget)
+        if sampling is not None and sampling.seed is None \
+                and not sampling.is_greedy:
+            # pin the auto-seed at the CLUSTER request level: engine
+            # auto-seeds are per-attempt, so a failover's fresh engine
+            # Request would otherwise resample a DIFFERENT sequence —
+            # a streaming client could receive a spliced output the
+            # stream's shrink check cannot detect
+            sampling = SamplingParams(
+                temperature=sampling.temperature,
+                top_p=sampling.top_p, top_k=sampling.top_k,
+                seed=int.from_bytes(os.urandom(4), "little") % (2**31),
+                stop=sampling.stop, logit_bias=sampling.logit_bias,
+                constraint=sampling.constraint)
+        self.sampling = sampling
+        self.stop = tuple(int(t) for t in (stop or ()))
+        #: optional streaming hook ``fn(token)`` — fired per appended
+        #: token by an IN-PROCESS engine attempt (subprocess replicas
+        #: surface partials through :meth:`partial_output` instead)
+        self.on_token = on_token
         self.failovers = 0
         self.request: Request | None = None   # current engine attempt
         self.replica_id = None
         self.status = "pending"
         self.error = None
         self.output_ids: list[int] = []
+        self._partial: list[int] = []   # poller-mirrored live output
         self._t_submit = None
         self._finished = threading.Event()
         self._lock = threading.Lock()
         # constructing the engine request up front validates the args
         # at submit() time, not on a replica's worker thread
         Request(self.prompt_ids, self.max_new_tokens, eos_token_id,
-                deadline, token_budget, priority, retry_budget)
+                deadline, token_budget, priority, retry_budget,
+                sampling=sampling, stop=self.stop)
 
     # ------------------------------------------------------------------
     @property
@@ -232,7 +255,9 @@ class ClusterRequest:
                 return None
             r = Request(self.prompt_ids, self.max_new_tokens,
                         self.eos_token_id, ttl, self.token_budget,
-                        self.priority, self.retry_budget)
+                        self.priority, self.retry_budget,
+                        sampling=self.sampling, stop=self.stop,
+                        on_token=self._attempt_token)
             self.request = r
             self.replica_id = replica_id
             self.status = "live"
@@ -256,7 +281,47 @@ class ClusterRequest:
                 "deadline": req.deadline,
                 "token_budget": self.token_budget,
                 "priority": self.priority,
-                "retry_budget": self.retry_budget}
+                "retry_budget": self.retry_budget,
+                "sampling": None if self.sampling is None
+                else self.sampling.to_spec(),
+                "stop": list(self.stop)}
+
+    # -- streaming hooks -----------------------------------------------
+    def _attempt_token(self, req, token):
+        """Engine-side per-token hook of the CURRENT in-process
+        attempt; forwards to the caller's ``on_token``."""
+        cb = self.on_token
+        if cb is not None:
+            try:
+                cb(int(token))
+            except Exception:
+                pass        # streaming hooks must never kill a dispatch
+
+    def _mirror_partial(self, output_ids):
+        """Adopt a subprocess replica's non-terminal output snapshot
+        (poller thread). Terminal adoption still goes through
+        :meth:`_finish_remote` exactly once."""
+        with self._lock:
+            if not self._finished.is_set():
+                self._partial = list(output_ids or [])
+
+    def partial_output(self):
+        """Best-effort live output snapshot for streaming: the current
+        in-process attempt's tokens, the poller's last mirror for a
+        subprocess attempt, or the terminal output once finished. May
+        SHRINK across a failover (the replacement attempt restarts
+        generation) — streaming frontends treat a shrink as a stream
+        error."""
+        with self._lock:
+            if self._finished.is_set():
+                return list(self.output_ids)
+            r = self.request
+            partial = list(self._partial)
+        if r is not None and r.status != "pending" \
+                and len(r.output_ids) >= len(partial):
+            # in-process live attempt: the engine request IS the truth
+            return list(r.output_ids)
+        return partial
 
     def _finish_from(self, req):
         """Adopt an engine request's terminal state. Exactly-once: a
@@ -926,6 +991,11 @@ class SubprocessReplica:
                     creq._finish_remote(state.get("status"),
                                         state.get("output_ids"),
                                         state.get("error"))
+                else:
+                    # live request: mirror the partial output so a
+                    # streaming frontend can push tokens while the
+                    # request is still decoding on the worker
+                    creq._mirror_partial(state.get("output_ids"))
 
     def _untrack(self, creq):
         with self._lock:
@@ -1393,16 +1463,20 @@ class ServingCluster:
     # -- routing --------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                deadline=None, token_budget=None, priority=0,
-               retry_budget=1, failover_budget=None):
+               retry_budget=1, failover_budget=None, sampling=None,
+               stop=(), on_token=None):
         """Route one request to the least-loaded ready replica.
         Returns a :class:`ClusterRequest`; raises a typed
         :class:`AdmissionError` carrying the smallest ``retry_after``
-        across replicas when the whole tier is at capacity."""
+        across replicas when the whole tier is at capacity.
+        ``sampling``/``stop``/``on_token`` ride the request to the
+        engine (see :class:`ClusterRequest`)."""
         creq = ClusterRequest(
             prompt_ids, max_new_tokens, eos_token_id, deadline,
             token_budget, priority, retry_budget,
             self.failover_budget if failover_budget is None
-            else failover_budget)
+            else failover_budget, sampling=sampling, stop=stop,
+            on_token=on_token)
         creq._t_submit = time.perf_counter()
         self._route(creq)
         return creq

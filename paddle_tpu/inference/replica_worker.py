@@ -130,13 +130,16 @@ def _worker_submit(spec):
     versa). Retried submits (at-least-once rpc) are deduped by the
     dispatcher's reply cache, so admission stays exactly-once."""
     from .cluster import ClusterRequest
+    from .sampling import SamplingParams
 
     w = _require()
     creq = ClusterRequest(
         spec["prompt_ids"], spec["max_new_tokens"],
         spec.get("eos_token_id"), spec.get("deadline"),
         spec.get("token_budget"), spec.get("priority", 0),
-        spec.get("retry_budget", 1))
+        spec.get("retry_budget", 1),
+        sampling=SamplingParams.from_spec(spec.get("sampling")),
+        stop=spec.get("stop") or ())
     creq._t_submit = time.perf_counter()
     w.rep.submit(creq, epoch=spec.get("epoch"))
     req_id = f"{w.replica_id}:{next(w._seq)}"

@@ -136,6 +136,7 @@ from ..observability.trace import span as _span
 from ..ops.ragged_paged_attention import ragged_paged_attention
 from ..testing import faults as _faults
 from .paged_cache import PageAllocator, quantize_kv_int8
+from .sampling import SamplingParams, sampled_next_tokens
 from .speculative import NGramDrafter
 
 __all__ = ["LlamaServingEngine", "Request", "AdmissionError",
@@ -304,6 +305,18 @@ def _serving_metrics():
             "kv_page_bytes_per_token",
             "HBM bytes one cached token costs across all layers (K+V "
             "data plus any int8 scale sidecars)"),
+        "stop_hits": _om.counter(
+            "serving_stop_token_hits_total",
+            "requests retired by a per-request stop token (the stop "
+            "token itself is excluded from the output)"),
+        "constraint_truncated": _om.counter(
+            "serving_constraint_truncated_total",
+            "constraint-hook allowed sets truncated to the engine's "
+            "sample_slots width"),
+        "constraint_errors": _om.counter(
+            "serving_constraint_errors_total",
+            "constraint hooks that raised (the step proceeds "
+            "unconstrained)"),
     }
 
 
@@ -396,11 +409,21 @@ class Request:
             ladder only trims/evicts strictly lower-priority requests.
         retry_budget: how many times the request may be evicted and
             re-queued before it fails permanently (status ``evicted``).
+        sampling: :class:`~paddle_tpu.inference.sampling.SamplingParams`
+            (None = greedy, bitwise-identical to the pre-sampling
+            engine). The params' ``stop`` list merges with ``stop``.
+        stop: iterable of token ids checked at the emit boundary —
+            generation retires as ``completed`` right before any of
+            them would be appended (the stop token is excluded).
+        on_token: optional ``fn(request, token)`` fired after each
+            appended token (the streaming hook). Runs on the engine's
+            dispatch thread — must be fast and must not raise (raises
+            are swallowed).
     """
 
     def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                  deadline=None, token_budget=None, priority=0,
-                 retry_budget=1):
+                 retry_budget=1, sampling=None, stop=(), on_token=None):
         self.prompt_ids = np.asarray(prompt_ids, np.int64).reshape(-1)
         if self.prompt_ids.size == 0:
             raise ValueError(
@@ -425,6 +448,16 @@ class Request:
             else float(token_budget)
         self.priority = int(priority)
         self.retry_budget = int(retry_budget)
+        if sampling is not None and not isinstance(sampling,
+                                                  SamplingParams):
+            raise ValueError(
+                f"sampling must be a SamplingParams, got "
+                f"{type(sampling).__name__}")
+        self.sampling = sampling
+        self.stop_set = frozenset(int(t) for t in (stop or ())) \
+            | frozenset(sampling.stop if sampling else ())
+        self.on_token = on_token
+        self._seed = None             # resolved at first admission
         self.output_ids: list[int] = []
         self.seq_id = None
         self.done = False
@@ -449,7 +482,8 @@ class LlamaServingEngine:
                  admit_retries=0, admit_backoff=0.005, stuck_factor=8.0,
                  stuck_min_timeout=30.0, prefix_cache=True,
                  prefix_cache_pages=None, prewarm=None, kv_dtype=None,
-                 spec_k=None, spec_ngram=3, drafter_factory=None):
+                 spec_k=None, spec_ngram=3, drafter_factory=None,
+                 sampling=None, sample_slots=8):
         if num_pages is None:
             num_pages = max_batch * 24 + 8
         self.model = model
@@ -556,6 +590,27 @@ class LlamaServingEngine:
         if spec_k is None:
             spec_k = int(os.environ.get("PADDLE_TPU_SPEC_K", "0") or 0)
         self.spec_k = max(0, min(int(spec_k), self.chunk_block - 1))
+        # per-request sampling (ROADMAP item 4): the mixed program
+        # grows a vectorized per-row sample step next to the argmax —
+        # every sampler knob is runtime data ([R]-shaped arrays), so
+        # compiled shapes never fork per request config and greedy
+        # rows stay bitwise-exact. sampling=False restores the exact
+        # pre-sampling program (no vocab sort on the hot path) for
+        # greedy-only deployments; PADDLE_TPU_SAMPLING=0 is the fleet
+        # knob.
+        if sampling is None:
+            sampling = os.environ.get(
+                "PADDLE_TPU_SAMPLING", "1").lower() \
+                not in ("0", "false", "off")
+        self.sample_enabled = bool(sampling)
+        # static width of the per-row logit-bias / constraint slots —
+        # part of the compiled signature, hence an ENGINE knob, never a
+        # request one
+        self.sample_slots = max(1, int(sample_slots))
+        # auto-seed LCG for sampled requests that didn't pin a seed
+        # (recorded on the request so the draw stays reproducible)
+        self._auto_seed = int.from_bytes(os.urandom(4), "little") \
+            % (2 ** 31)
         self._drafter_factory = drafter_factory or \
             (lambda: NGramDrafter(n=spec_ngram))
         self._spec_state: dict[int, object] = {}   # seq_id -> drafter
@@ -807,7 +862,9 @@ class LlamaServingEngine:
     # ------------------------------------------------------------------
     def _mixed_forward(self, tokens, pos, page_ids, offs, row_tok,
                        flat_idx, last_idx, tables, kv_lens, q_starts,
-                       q_lens, k_pools, v_pools, k_scales, v_scales):
+                       q_lens, temps, top_ps, top_ks, seeds, slot_ids,
+                       slot_vals, cmodes, k_pools, v_pools, k_scales,
+                       v_scales):
         """ONE token-packed model step: embed [1, T] real tokens (a mix
         of prefill-chunk tokens, speculative verify tokens and decode
         tokens, back to back with no inter-row padding), scatter every
@@ -827,8 +884,18 @@ class LlamaServingEngine:
         shape (T == max_batch, QB == 1) and the chunk-budget shape
         share this function.
 
+        With ``sample_enabled`` the argmax generalizes to the
+        per-row sample step (:func:`sampled_next_tokens`): temperature
+        / top-p / top-k / seed / bias-constraint slots ride as
+        ``[R]``-shaped runtime arrays, greedy rows (temperature 0)
+        still take the bitwise argmax of the same logits, and the
+        threefry key folds the request seed with the token's absolute
+        position — so the draw at a position never depends on how it
+        was dispatched (step, scan tick, or speculative verify row).
+
         tokens/pos [1, T]; page_ids/offs/flat_idx [T]; row_tok [R, QB];
-        last_idx/kv_lens/q_starts/q_lens [R]; tables [R, W];
+        last_idx/kv_lens/q_starts/q_lens/temps/top_ps/top_ks/seeds/
+        cmodes [R]; slot_ids/slot_vals [R, B]; tables [R, W];
         k/v_scales are empty lists for float pools.
         Returns (next token ids — 1-D [T] when speculative, 1-D [R]
         otherwise — new k_pools, new v_pools, new k_scales,
@@ -892,15 +959,51 @@ class LlamaServingEngine:
         # these shapes always get a fresh buffer.
         if self.spec_k:
             logits = self.model._logits(x)               # [1, T, V]
-            nxt = search.argmax(logits, axis=-1).astype("int64") \
-                .reshape([t])
+            if self.sample_enabled:
+                # sample at EVERY packed position: row params gather
+                # token-wise through flat_idx (token t belongs to row
+                # flat_idx[t] // qb), the fold position is the sampled
+                # token's absolute position (input pos + 1)
+                def fn(lg, tp, pp, kp_, sd, ps, sid, sva, cm, fi):
+                    vv = lg.shape[-1]
+                    row = jnp.clip(fi.astype(jnp.int32) // qb, 0,
+                                   tp.shape[0] - 1)
+                    return sampled_next_tokens(
+                        lg.reshape(t, vv), tp[row], pp[row], kp_[row],
+                        sd[row],
+                        ps.reshape(t).astype(jnp.int32) + 1,
+                        sid[row], sva[row], cm[row])
+
+                nxt = run_op("serving_sample", fn,
+                             (logits, temps, top_ps, top_ks, seeds,
+                              pos, slot_ids, slot_vals, cmodes,
+                              flat_idx), differentiable=False) \
+                    .reshape([t])
+            else:
+                nxt = search.argmax(logits, axis=-1).astype("int64") \
+                    .reshape([t])
         else:
             h_last = _token_gather(x.reshape([t, x.shape[-1]]),
                                    last_idx)
             logits = self.model._logits(
                 h_last.reshape([r_rows, 1, h_last.shape[-1]]))
-            nxt = search.argmax(logits, axis=-1).astype("int64") \
-                .reshape([r_rows])
+            if self.sample_enabled:
+                def fn(lg, tp, pp, kp_, sd, ps, sid, sva, cm, li):
+                    vv = lg.shape[-1]
+                    p = ps.reshape(-1)[li.astype(jnp.int32)] \
+                        .astype(jnp.int32) + 1
+                    return sampled_next_tokens(
+                        lg.reshape(r_rows, vv), tp, pp, kp_, sd, p,
+                        sid, sva, cm)
+
+                nxt = run_op("serving_sample", fn,
+                             (logits, temps, top_ps, top_ks, seeds,
+                              pos, slot_ids, slot_vals, cmodes,
+                              last_idx), differentiable=False) \
+                    .reshape([r_rows])
+            else:
+                nxt = search.argmax(logits, axis=-1).astype("int64") \
+                    .reshape([r_rows])
         return nxt, new_k, new_v, new_ks, new_vs
 
     def _ensure_mixed_compiled(self):
@@ -953,7 +1056,11 @@ class LlamaServingEngine:
         from its per-sequence drafter (created lazily; synced to the
         committed prompt + output only — never to rejected drafts).
         Out-of-vocab proposals from a custom drafter are dropped at the
-        first offender."""
+        first offender. Constrained requests never draft: the
+        constraint hook is host code evaluated once per scheduled
+        position, so mid-dispatch draft positions can't consult it."""
+        if r.sampling is not None and r.sampling.constraint is not None:
+            return ()
         st = self._spec_state.get(r.seq_id)
         if st is None:
             st = self._spec_state[r.seq_id] = self._drafter_factory()
@@ -1087,6 +1194,63 @@ class LlamaServingEngine:
                 budget -= n
         return rows, cow
 
+    def _sample_arrays(self, reqs, r_cap):
+        """Host-built per-row sampler metadata for one dispatch:
+        ``reqs`` is a <= r_cap list of requests (None entries and the
+        padding tail stay inert greedy rows). Constraint hooks run
+        HERE, once per scheduled dispatch — a raising hook degrades to
+        unconstrained (counted), an oversized allowed set truncates to
+        the engine's static ``sample_slots`` width (counted)."""
+        b = self.sample_slots
+        temps = np.zeros((r_cap,), np.float32)
+        top_ps = np.ones((r_cap,), np.float32)
+        top_ks = np.zeros((r_cap,), np.int32)
+        seeds = np.zeros((r_cap,), np.int32)
+        slot_ids = np.full((r_cap, b), -1, np.int32)
+        slot_vals = np.zeros((r_cap, b), np.float32)
+        cmodes = np.zeros((r_cap,), np.int32)
+        if not self.sample_enabled:
+            return (temps, top_ps, top_ks, seeds, slot_ids, slot_vals,
+                    cmodes)
+        for i, r in enumerate(reqs):
+            sp = r.sampling if r is not None else None
+            if sp is None:
+                continue
+            temps[i] = sp.temperature
+            top_ps[i] = sp.top_p
+            top_ks[i] = sp.top_k
+            seeds[i] = r._seed or 0
+            bias = sp.logit_bias or {}
+            allowed = None
+            if sp.constraint is not None:
+                try:
+                    allowed = sp.constraint(r.prompt_ids,
+                                            tuple(r.output_ids))
+                except Exception:
+                    self._m["constraint_errors"].inc()
+                    allowed = None
+            if allowed is not None:
+                ids = [int(tk) for tk in allowed]
+                if not ids:
+                    # an empty allowed set has no valid continuation;
+                    # degrade to unconstrained rather than emit the
+                    # arbitrary all-masked argmax
+                    self._m["constraint_errors"].inc()
+                elif len(ids) > b:
+                    self._m["constraint_truncated"].inc()
+                    ids = ids[:b]
+                if ids:
+                    cmodes[i] = 1
+                    for j, tk in enumerate(ids):
+                        slot_ids[i, j] = tk
+                        slot_vals[i, j] = bias.get(tk, 0.0)
+                    continue
+            if bias:
+                for j, (tk, v) in enumerate(list(bias.items())[:b]):
+                    slot_ids[i, j] = int(tk)
+                    slot_vals[i, j] = v
+        return temps, top_ps, top_ks, seeds, slot_ids, slot_vals, cmodes
+
     def _dispatch_rows(self, rows, cow):
         """Dispatch ONE mixed program over an already-scheduled row
         list (caller holds the dispatch locks) and apply the results:
@@ -1160,6 +1324,8 @@ class LlamaServingEngine:
             flat_start.append(t)
             t += n
             last_idx[i] = t - 1
+        (temps, top_ps, top_ks, seeds, slot_ids, slot_vals,
+         cmodes) = self._sample_arrays([row[0] for row in rows], r_cap)
         self._record_shape("mixed", t_cap)
         sf = self._ensure_mixed_compiled()
         self._arm_watchdog(cold)
@@ -1181,6 +1347,13 @@ class LlamaServingEngine:
                     Tensor(jnp.asarray(kv_lens)),
                     Tensor(jnp.asarray(q_starts)),
                     Tensor(jnp.asarray(q_lens)),
+                    Tensor(jnp.asarray(temps)),
+                    Tensor(jnp.asarray(top_ps)),
+                    Tensor(jnp.asarray(top_ks)),
+                    Tensor(jnp.asarray(seeds)),
+                    Tensor(jnp.asarray(slot_ids)),
+                    Tensor(jnp.asarray(slot_vals)),
+                    Tensor(jnp.asarray(cmodes)),
                     self.k_pools, self.v_pools,
                     self.k_scales, self.v_scales)
         finally:
@@ -1220,10 +1393,15 @@ class LlamaServingEngine:
         if finished and self.prefix is not None:
             self._prefix_insert(finished, fin_sids)
         # speculative verification BEFORE any emission: out[t] is the
-        # argmax continuation after packed token t, so a verify row's
-        # window out[f .. f+n-1] holds the token the sequential engine
-        # would emit after the pending token and after each draft.
-        # Accept the longest prefix where draft i+1 equals output i;
+        # target continuation after packed token t — argmax for greedy
+        # rows, the position-keyed SAMPLE for sampled rows — so a
+        # verify row's window out[f .. f+n-1] holds exactly the token
+        # the sequential engine would emit after the pending token and
+        # after each draft. Accepting the longest matching prefix IS
+        # rejection sampling for our point-mass drafter (accept w.p.
+        # p(draft), reject resamples the residual — see sampling.py),
+        # and keeps sampled outputs seed-stable with speculation on or
+        # off. Accept the longest prefix where draft i+1 equals out i;
         # rejected drafts' pages roll back NOW, while the sequence is
         # still live (an emission below may retire it and release
         # everything — rollback after that would touch a freed table)
@@ -1363,7 +1541,11 @@ class LlamaServingEngine:
                  # engines that differ in either must not share
                  # warm-up recipes
                  str(self.k_pools[0]._data.dtype)
-                 if self.k_pools else dt, bool(self.spec_k))
+                 if self.k_pools else dt, bool(self.spec_k),
+                 # the sample step adds inputs + a vocab sort to every
+                 # serving program, and the slot width shapes the bias
+                 # arrays — both fork the compiled surface
+                 bool(self.sample_enabled), self.sample_slots)
         return "llama:" + hashlib.sha1(
             repr(parts).encode()).hexdigest()[:16]
 
@@ -1399,6 +1581,7 @@ class LlamaServingEngine:
         else:
             return False
         sf = self._ensure_mixed_compiled()
+        samp = self._sample_arrays([], r_cap)
         with no_grad():
             _, wk, wv, wks, wvs = sf(
                 Tensor(jnp.asarray(np.zeros((1, t_cap), np.int64))),
@@ -1414,6 +1597,7 @@ class LlamaServingEngine:
                 Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
                 Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
                 Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
+                *[Tensor(jnp.asarray(a)) for a in samp],
                 self.k_pools, self.v_pools,
                 self.k_scales, self.v_scales)
         self.k_pools, self.v_pools = list(wk), list(wv)
@@ -1430,11 +1614,13 @@ class LlamaServingEngine:
         reassign from the outputs."""
         b = self.max_batch
         sf = self._ensure_scan_compiled(int(n))
+        samp = self._sample_arrays([], b)
         with no_grad():
             out = sf(Tensor(jnp.asarray(np.zeros((b, 1), np.int64))),
                      Tensor(jnp.asarray(np.full(
                          (b, self.width), self.trash_page, np.int32))),
                      Tensor(jnp.asarray(np.ones((b,), np.int32))),
+                     *[Tensor(jnp.asarray(a)) for a in samp],
                      self.k_pools, self.v_pools,
                      self.k_scales, self.v_scales)
         self._adopt_scan_pools(out)
@@ -1522,6 +1708,18 @@ class LlamaServingEngine:
                 f"of {max_prompt} tokens ({cap_pages} pages x "
                 f"{self.page_size} slots); split the prompt or size the "
                 f"pool up (num_pages/max_pages_per_seq)")
+        sp = req.sampling
+        if sp is not None:
+            if not sp.is_greedy and not self.sample_enabled:
+                raise ValueError(
+                    "request asks for sampled decoding but this engine "
+                    "was built with sampling=False; rebuild with "
+                    "sampling=True (or unset PADDLE_TPU_SAMPLING=0)")
+            if sp.logit_bias and len(sp.logit_bias) > self.sample_slots:
+                raise ValueError(
+                    f"logit_bias has {len(sp.logit_bias)} entries but "
+                    f"this engine packs sample_slots={self.sample_slots}"
+                    f" per row; raise sample_slots or trim the bias")
 
     def _retry_after(self):
         """Seconds until capacity plausibly frees: the live set's
@@ -1779,6 +1977,18 @@ class LlamaServingEngine:
             if req.seq_id is None:
                 req.seq_id = self._next_id
                 self._next_id += 1
+            if req._seed is None:
+                sp = req.sampling
+                if sp is not None and sp.seed is not None:
+                    req._seed = sp.seed
+                else:
+                    # auto-seed once per request (stable across ladder
+                    # evictions/re-admissions so a regenerated request
+                    # redraws the same sequence) and record it for
+                    # after-the-fact reproducibility
+                    self._auto_seed = (self._auto_seed * 1103515245
+                                       + 12345) % (2 ** 31)
+                    req._seed = self._auto_seed
         attempt = 0
         trim_tried: set[int] = set()
         while True:
@@ -1853,10 +2063,25 @@ class LlamaServingEngine:
 
     def _emit(self, req, token):
         first = not req.output_ids
-        req.output_ids.append(token)
         if first and req._t_admit is not None:
             self._m["ttft"].observe(time.perf_counter() - req._t_admit)
+        # stop tokens are checked BEFORE the append: the request
+        # retires ``completed`` with the stop token excluded from its
+        # output (the chat-endpoint contract; eos keeps its legacy
+        # include-then-stop behavior)
+        if req.stop_set and token in req.stop_set:
+            if self._retire(req, "completed"):
+                self._m["completed"].inc()
+                self._m["stop_hits"].inc()
+            return
+        req.output_ids.append(token)
         self._m["generated"].inc()
+        cb = req.on_token
+        if cb is not None:
+            try:
+                cb(req, token)
+            except Exception:
+                pass        # streaming hooks must never kill a dispatch
         if (req.eos_token_id is not None and token == req.eos_token_id) \
                 or len(req.output_ids) >= req.max_new_tokens:
             if self._retire(req, "completed"):
@@ -1921,7 +2146,8 @@ class LlamaServingEngine:
 
         page = self.page_size
 
-        def fn(tokens, tables, lens, k_pools, v_pools, k_scales,
+        def fn(tokens, tables, lens, temps, top_ps, top_ks, seeds,
+               slot_ids, slot_vals, cmodes, k_pools, v_pools, k_scales,
                v_scales):
             tab = tables._data
             b = tab.shape[0]
@@ -1932,6 +2158,11 @@ class LlamaServingEngine:
             rows = jnp.arange(b, dtype=jnp.int32)
             row_tok = rows.reshape(b, 1)
             ones = jnp.ones((b,), jnp.int32)
+            # sampler params are scan-invariant per row; the fold
+            # position advances with the length carry, so tick i of a
+            # scan draws the SAME randomness the per-step path would
+            samp = (temps, top_ps, top_ks, seeds, slot_ids, slot_vals,
+                    cmodes)
 
             def body(carry, _):
                 tok, lc, kc, vc, ksc, vsc = carry
@@ -1945,7 +2176,7 @@ class LlamaServingEngine:
                     Tensor(pids), Tensor(offs), Tensor(row_tok),
                     Tensor(rows), Tensor(rows), Tensor(tab),
                     Tensor(lc.astype(jnp.int32)), Tensor(start),
-                    Tensor(ones),
+                    Tensor(ones), *samp,
                     [Tensor(a) for a in kc], [Tensor(a) for a in vc],
                     [Tensor(a) for a in ksc], [Tensor(a) for a in vsc])
                 nxt_arr = nxt._data.reshape(tok.shape).astype(tok.dtype)
@@ -2031,6 +2262,8 @@ class LlamaServingEngine:
                 tables[i, :len(t)] = t
                 lens[i] = start_lens[sid] + 1       # first new token incl.
                 tokens[i, 0] = last_tok[i]
+            (temps, top_ps, top_ks, seeds, slot_ids, slot_vals,
+             cmodes) = self._sample_arrays(live, b)
             sf = self._ensure_scan_compiled(n)
             self._arm_watchdog(cold)
             with self._lock:
@@ -2042,6 +2275,13 @@ class LlamaServingEngine:
                         Tensor(jnp.asarray(tokens)),
                         Tensor(jnp.asarray(tables)),
                         Tensor(jnp.asarray(lens)),
+                        Tensor(jnp.asarray(temps)),
+                        Tensor(jnp.asarray(top_ps)),
+                        Tensor(jnp.asarray(top_ks)),
+                        Tensor(jnp.asarray(seeds)),
+                        Tensor(jnp.asarray(slot_ids)),
+                        Tensor(jnp.asarray(slot_vals)),
+                        Tensor(jnp.asarray(cmodes)),
                         self.k_pools, self.v_pools,
                         self.k_scales, self.v_scales)
             finally:
@@ -2120,6 +2360,13 @@ class LlamaServingEngine:
                     break
                 prefilling = any(r._prefilled < len(r.prompt_ids)
                                  for r in live)
+                # constraint hooks are per-step host work: a scan's n
+                # on-device ticks can't re-consult them, so constrained
+                # traffic pins the engine to single mixed steps (static
+                # logit_bias is scan-invariant and scans fine)
+                constrained = any(
+                    r.sampling is not None
+                    and r.sampling.constraint is not None for r in live)
                 spec_now = False
                 if self.spec_k and not prefilling and live:
                     spec_now = self._spec_worth(live)
@@ -2130,7 +2377,7 @@ class LlamaServingEngine:
                         else self._spec_idle + 1
                 if not live:
                     chunk = 1       # pump parked requests via a step
-                elif prefilling:
+                elif prefilling or constrained:
                     chunk = 1
                 elif spec_now:
                     # speculation rides the mixed step: one dispatch

@@ -1,15 +1,24 @@
-"""Exporters: Prometheus text format, JSON snapshots, HTTP scrape.
+"""Exporters: Prometheus text format, JSON snapshots, HTTP serving.
 
 - :func:`json_snapshot` — a pure-data (JSON-serializable) dump of a
   registry; :func:`snapshot_to_prometheus` renders such a snapshot to
   Prometheus text, and :func:`prometheus_text` composes the two — so
   text output round-trips exactly through the JSON snapshot layer
   (serialize, ship, re-render identically on another host).
-- :func:`start_http_server` — an optional stdlib ``http.server`` scrape
-  endpoint (``/metrics`` text + HEAD, ``/metrics.json`` snapshot,
-  ``/healthz`` liveness probe, ``/readyz`` readiness probe that turns
-  503 while the local engine drains) for the serving engine; returns a
-  handle with ``.port`` / ``.url`` / ``.stop``.
+- :class:`HttpService` — the ONE stdlib ``http.server`` wrapper every
+  in-process endpoint builds on (the metrics scrape port, the replica
+  worker's health port, the cluster's tier endpoint, and the
+  OpenAI-compatible serving frontend): a route table over a threaded
+  daemon server, request context helpers (JSON bodies/replies, SSE
+  streaming with typed client-disconnect), ``.port`` / ``.url`` /
+  ``.stop``.
+- :func:`add_probe_routes` — installs the standard observability
+  routes (``/metrics`` text + HEAD, ``/metrics.json`` snapshot,
+  ``/healthz`` liveness with ``health_info`` merge, ``/readyz``
+  readiness that turns 503 while the local engine drains) on any
+  :class:`HttpService`.
+- :func:`start_http_server` — the classic scrape endpoint: an
+  :class:`HttpService` with just the probe routes.
 """
 
 from __future__ import annotations
@@ -23,7 +32,8 @@ import time
 from .metrics import default_registry
 
 __all__ = ["json_snapshot", "snapshot_to_prometheus", "prometheus_text",
-           "start_http_server", "ScrapeServer"]
+           "start_http_server", "ScrapeServer", "HttpService",
+           "HttpContext", "ClientDisconnected", "add_probe_routes"]
 
 
 def _fmt_value(v):
@@ -119,29 +129,200 @@ def prometheus_text(registry=None):
     return snapshot_to_prometheus(json_snapshot(registry))
 
 
-class ScrapeServer:
-    """Handle for a running scrape endpoint."""
+class ClientDisconnected(ConnectionError):
+    """The HTTP client went away mid-response (broken pipe / reset) —
+    the typed signal a streaming handler uses to cancel server-side
+    work (the frontend maps it to a 499 tally + ``engine.cancel``)."""
 
-    def __init__(self, httpd, thread):
-        self._httpd = httpd
-        self._thread = thread
-        self.port = httpd.server_address[1]
-        self.url = f"http://{httpd.server_address[0]}:{self.port}/metrics"
+
+class HttpContext:
+    """Per-request view handed to :class:`HttpService` route handlers:
+    request line/headers/body access plus reply helpers. A handler
+    either calls ``send``/``send_json`` once, or ``stream(...)`` and
+    writes chunks; returning without replying is a 500."""
+
+    def __init__(self, handler, head_only=False):
+        self._h = handler
+        self._head_only = head_only
+        self.method = "HEAD" if head_only else handler.command
+        self.path = handler.path.split("?", 1)[0]
+        self.query = handler.path.partition("?")[2]
+        self.headers = handler.headers
+        self.replied = False
+
+    def body(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        return self._h.rfile.read(n) if n else b""
+
+    def json(self):
+        """Parsed JSON body; raises ValueError on malformed input (the
+        service maps it to a 400)."""
+        raw = self.body()
+        if not raw:
+            raise ValueError("empty request body (expected JSON)")
+        try:
+            return json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ValueError(f"malformed JSON body: {e}") from None
+
+    def send(self, status, body, ctype="application/json",
+             headers=None):
+        self.replied = True
+        h = self._h
+        h.send_response(int(status))
+        h.send_header("Content-Type", ctype)
+        h.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            h.send_header(k, str(v))
+        h.end_headers()
+        if not self._head_only:
+            h.wfile.write(body)
+
+    def send_json(self, status, obj, headers=None):
+        self.send(status, json.dumps(obj).encode(), "application/json",
+                  headers)
+
+    def stream(self, status=200, ctype="text/event-stream",
+               headers=None):
+        """Open an unframed streaming response (Connection: close
+        delimits the body — SSE-friendly and proxy-simple). Returns a
+        writer with ``.write(bytes)`` / ``.flush()``; a vanished client
+        surfaces as :class:`ClientDisconnected` from the next write."""
+        self.replied = True
+        h = self._h
+        h.send_response(int(status))
+        h.send_header("Content-Type", ctype)
+        h.send_header("Cache-Control", "no-cache")
+        h.send_header("Connection", "close")
+        for k, v in (headers or {}).items():
+            h.send_header(k, str(v))
+        h.end_headers()
+        ctx = self
+
+        class _Writer:
+            def write(self, data):
+                if ctx._head_only:
+                    return
+                try:
+                    h.wfile.write(data)
+                    h.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError,
+                        OSError) as e:
+                    raise ClientDisconnected(str(e)) from e
+
+        return _Writer()
+
+
+class HttpService:
+    """Threaded stdlib HTTP server behind a route table — the shared
+    implementation under the metrics scrape endpoint, the replica
+    worker's health port, the cluster tier endpoint and the serving
+    frontend (each used to re-wrap ``http.server`` ad hoc).
+
+    ``route(path, handler, methods)`` registers ``handler(ctx)`` for
+    exact-path matches; HEAD auto-maps to the GET handler with the
+    body suppressed (Content-Length still reflects the full render).
+    Handlers that raise reply 500 (ValueError: 400); unknown paths
+    404. ``start()`` binds and serves on a daemon thread; ``stop()``
+    shuts down and joins."""
+
+    def __init__(self, addr="127.0.0.1", port=0, name="http"):
+        self._addr = addr
+        self._want_port = port
+        self.name = name
+        self._routes = {}
+        self._httpd = None
+        self._thread = None
+        self.port = None
+        self.url = None
+
+    def route(self, path, handler, methods=("GET",)):
+        for m in methods:
+            self._routes[(m, path)] = handler
+        return self
+
+    def start(self):
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        if self._httpd is not None:
+            return self
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _dispatch(self, head_only=False):
+                ctx = HttpContext(self, head_only=head_only)
+                fn = svc._routes.get((ctx.method, ctx.path))
+                if fn is None and head_only:
+                    fn = svc._routes.get(("GET", ctx.path))
+                if fn is None:
+                    self.send_error(404)
+                    return
+                try:
+                    fn(ctx)
+                    if not ctx.replied:
+                        ctx.send_json(500, {"error": {
+                            "message": "handler produced no response",
+                            "type": "server_error"}})
+                except ClientDisconnected:
+                    pass        # the handler already cleaned up
+                except ValueError as e:
+                    if not ctx.replied:
+                        ctx.send_json(400, {"error": {
+                            "message": str(e),
+                            "type": "invalid_request_error"}})
+                except (BrokenPipeError, ConnectionResetError):
+                    pass        # client gone mid-plain-reply
+                except Exception as e:
+                    if not ctx.replied:
+                        ctx.send_json(500, {"error": {
+                            "message": f"{type(e).__name__}: {e}",
+                            "type": "server_error"}})
+
+            def do_GET(self):
+                self._dispatch()
+
+            def do_POST(self):
+                self._dispatch()
+
+            def do_HEAD(self):
+                # probes use HEAD to skip the body; the full text is
+                # still rendered so Content-Length matches a GET
+                self._dispatch(head_only=True)
+
+            def log_message(self, *args):   # no stderr spam per scrape
+                pass
+
+        self._httpd = ThreadingHTTPServer((self._addr, self._want_port),
+                                          Handler)
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://{self._addr}:{self.port}/metrics"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"{self.name}-server")
+        self._thread.start()
+        return self
 
     def stop(self):
+        if self._httpd is None:
+            return
         self._httpd.shutdown()
         self._httpd.server_close()
-        self._thread.join(timeout=5)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None
 
 
-def start_http_server(port=0, addr="127.0.0.1", registry=None,
-                      ready=None, health_info=None):
-    """Serve ``/metrics`` (Prometheus text; HEAD supported for cheap
-    reachability checks), ``/metrics.json``, ``/healthz`` (200 +
-    uptime/pid JSON — the liveness probe serving deployments point at
-    the same port), and ``/readyz`` (readiness, see below) on a daemon
-    thread; ``port=0`` picks a free port. Returns
-    :class:`ScrapeServer`.
+#: Back-compat alias: callers that type-checked the old handle class
+#: keep working — the service IS the handle now.
+ScrapeServer = HttpService
+
+
+def add_probe_routes(svc, registry=None, ready=None, health_info=None):
+    """Install the standard probe routes on an :class:`HttpService`:
+    ``/metrics`` (+ ``/``), ``/metrics.json``, ``/healthz``,
+    ``/readyz``.
 
     ``ready`` is an optional zero-arg callable consulted per
     ``/readyz`` probe: truthy -> 200, falsy (or raising) -> 503 — 503
@@ -156,68 +337,51 @@ def start_http_server(port=0, addr="127.0.0.1", registry=None,
     epoch + last-heartbeat age, so an operator can spot a fenced-out
     stale incarnation from the probe alone); a raising callable
     degrades to the base document rather than failing liveness."""
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
     reg = registry if registry is not None else default_registry()
     t_start = time.monotonic()
 
-    class Handler(BaseHTTPRequestHandler):
-        def _payload(self):
-            """(status, body, content-type) for the path, or None."""
-            if self.path in ("/", "/metrics"):
-                return (200, prometheus_text(reg).encode(),
-                        "text/plain; version=0.0.4; charset=utf-8")
-            if self.path == "/metrics.json":
-                return (200, json.dumps(json_snapshot(reg)).encode(),
-                        "application/json")
-            if self.path == "/healthz":
-                doc = {"status": "ok", "pid": os.getpid(),
-                       "uptime_seconds": round(
-                           time.monotonic() - t_start, 3)}
-                if health_info is not None:
-                    try:
-                        doc.update(health_info() or {})
-                    except Exception:
-                        pass    # liveness must not fail on extras
-                return 200, json.dumps(doc).encode(), "application/json"
-            if self.path == "/readyz":
-                ok = True
-                if ready is not None:
-                    try:
-                        ok = bool(ready())
-                    except Exception:
-                        ok = False
-                doc = {"status": "ready" if ok else "not_ready",
-                       "pid": os.getpid()}
-                return (200 if ok else 503,
-                        json.dumps(doc).encode(), "application/json")
-            return None
+    def metrics(ctx):
+        ctx.send(200, prometheus_text(reg).encode(),
+                 "text/plain; version=0.0.4; charset=utf-8")
 
-        def _respond(self, head_only):
-            payload = self._payload()
-            if payload is None:
-                self.send_error(404)
-                return
-            status, body, ctype = payload
-            self.send_response(status)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            if not head_only:
-                self.wfile.write(body)
+    def metrics_json(ctx):
+        ctx.send_json(200, json_snapshot(reg))
 
-        def do_GET(self):
-            self._respond(head_only=False)
+    def healthz(ctx):
+        doc = {"status": "ok", "pid": os.getpid(),
+               "uptime_seconds": round(time.monotonic() - t_start, 3)}
+        if health_info is not None:
+            try:
+                doc.update(health_info() or {})
+            except Exception:
+                pass    # liveness must not fail on extras
+        ctx.send_json(200, doc)
 
-        def do_HEAD(self):
-            # probes use HEAD to skip the body; the full text is still
-            # rendered so Content-Length matches a subsequent GET
-            self._respond(head_only=True)
+    def readyz(ctx):
+        ok = True
+        if ready is not None:
+            try:
+                ok = bool(ready())
+            except Exception:
+                ok = False
+        ctx.send_json(200 if ok else 503,
+                      {"status": "ready" if ok else "not_ready",
+                       "pid": os.getpid()})
 
-        def log_message(self, *args):  # scrapes must not spam stderr
-            pass
+    svc.route("/", metrics)
+    svc.route("/metrics", metrics)
+    svc.route("/metrics.json", metrics_json)
+    svc.route("/healthz", healthz)
+    svc.route("/readyz", readyz)
+    return svc
 
-    httpd = ThreadingHTTPServer((addr, port), Handler)
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-    thread.start()
-    return ScrapeServer(httpd, thread)
+
+def start_http_server(port=0, addr="127.0.0.1", registry=None,
+                      ready=None, health_info=None):
+    """Serve the probe routes (see :func:`add_probe_routes`) on a
+    daemon thread; ``port=0`` picks a free port. Returns the running
+    :class:`HttpService` (``.port`` / ``.url`` / ``.stop``)."""
+    svc = HttpService(addr=addr, port=port, name="metrics")
+    add_probe_routes(svc, registry=registry, ready=ready,
+                     health_info=health_info)
+    return svc.start()

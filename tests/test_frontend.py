@@ -1,0 +1,469 @@
+"""HTTP front-door tests: the shared HttpService plumbing, the
+OpenAI-compatible endpoints (streaming and non-streaming), typed-error
+-> HTTP-code mapping, client-disconnect cancellation, and the
+multi-tenant QoS e2e (flood tenant shed, premium tenant served).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.frontend import ByteTokenizer, ServingFrontend
+from paddle_tpu.inference.qos import QosGate, Tenant
+from paddle_tpu.inference.serving import LlamaServingEngine
+from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.observability.export import (HttpService,
+                                             add_probe_routes,
+                                             start_http_server)
+
+
+# ---------------------------------------------------------------------------
+# HttpService — the shared server every endpoint builds on
+# ---------------------------------------------------------------------------
+def _get(url, method="GET", data=None, headers=None):
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def test_http_service_routes_and_errors():
+    svc = HttpService()
+    svc.route("/hello", lambda ctx: ctx.send_json(200, {"hi": True}))
+
+    def echo(ctx):
+        ctx.send_json(200, {"got": ctx.json()})
+
+    def boom(ctx):
+        raise RuntimeError("kaput")
+
+    svc.route("/echo", echo, methods=("POST",))
+    svc.route("/boom", boom)
+    svc.start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        st, _, body = _get(base + "/hello")
+        assert st == 200 and json.loads(body) == {"hi": True}
+        st, _, body = _get(base + "/echo", "POST", b'{"a": 1}')
+        assert json.loads(body) == {"got": {"a": 1}}
+        # malformed JSON -> 400 invalid_request_error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/echo", "POST", b'{nope')
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["error"]["type"] \
+            == "invalid_request_error"
+        # handler raise -> 500
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/boom")
+        assert ei.value.code == 500
+        # unknown path -> 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/nope")
+        assert ei.value.code == 404
+        # HEAD maps to the GET handler, body suppressed
+        st, hdrs, body = _get(base + "/hello", method="HEAD")
+        assert st == 200 and body == b"" \
+            and int(hdrs["Content-Length"]) > 0
+    finally:
+        svc.stop()
+
+
+def test_healthz_health_info_merge_regression():
+    """The satellite's regression gate: ``health_info=`` extras merge
+    into the /healthz doc on the classic ``start_http_server`` API,
+    and a raising callable degrades to the base doc (liveness never
+    fails on extras)."""
+    srv = start_http_server(health_info=lambda: {"epoch": 7,
+                                                 "custom": "x"})
+    try:
+        st, _, body = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        doc = json.loads(body)
+        assert st == 200 and doc["status"] == "ok"
+        assert doc["epoch"] == 7 and doc["custom"] == "x"
+        assert "uptime_seconds" in doc and "pid" in doc
+    finally:
+        srv.stop()
+
+    def bad():
+        raise RuntimeError("no extras today")
+
+    srv = start_http_server(health_info=bad)
+    try:
+        st, _, body = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert st == 200 and json.loads(body)["status"] == "ok"
+    finally:
+        srv.stop()
+
+
+def test_readyz_degrades_to_503():
+    ready = {"ok": True}
+    svc = HttpService()
+    add_probe_routes(svc, ready=lambda: ready["ok"])
+    svc.start()
+    try:
+        st, _, _ = _get(f"http://127.0.0.1:{svc.port}/readyz")
+        assert st == 200
+        ready["ok"] = False
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"http://127.0.0.1:{svc.port}/readyz")
+        assert ei.value.code == 503
+    finally:
+        svc.stop()
+
+
+def test_metrics_routes_still_served():
+    srv = start_http_server()
+    try:
+        st, hdrs, body = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        assert st == 200 and hdrs["Content-Type"].startswith("text/plain")
+        st, _, body = _get(f"http://127.0.0.1:{srv.port}/metrics.json")
+        assert isinstance(json.loads(body), (list, dict))
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the OpenAI-compatible frontend over a real engine
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(tiny_llama_config())
+    m.eval()
+    return m
+
+
+@pytest.fixture()
+def stack(model):
+    """(frontend, engine, gate) over a fresh engine; stopped after."""
+    engine = LlamaServingEngine(model, max_batch=4, page_size=8,
+                                num_pages=64, prefix_cache=False)
+    gate = QosGate([
+        Tenant("prem", tier="premium", ttft_slo=30.0),
+        Tenant("flood", tier="batch", rate=40, burst=40),
+    ])
+    fe = ServingFrontend(
+        engine=engine, qos=gate,
+        tokenizer=ByteTokenizer(vocab_size=model.config.vocab_size))
+    fe.start(port=0)
+    try:
+        yield fe, engine, gate
+    finally:
+        fe.stop()
+
+
+def _post(fe, path, body, headers=None):
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{fe.port}{path}", data=data,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+def test_models_endpoint(stack):
+    fe, _, _ = stack
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{fe.port}/v1/models", timeout=10) as r:
+        doc = json.load(r)
+    assert doc["data"][0]["id"] == fe.model_id
+
+
+def test_completions_token_ids_roundtrip(stack, model):
+    fe, engine, _ = stack
+    prompt = [5, 6, 7, 8]
+    want = LlamaServingEngine(
+        model, max_batch=2, page_size=8, num_pages=32,
+        prefix_cache=False).generate([prompt], max_new_tokens=6)[0]
+    st, _, doc = _post(fe, "/v1/completions",
+                       {"prompt": prompt, "max_tokens": 6,
+                        "temperature": 0})
+    assert st == 200 and doc["object"] == "text_completion"
+    assert doc["choices"][0]["token_ids"] == want
+    assert doc["usage"] == {"prompt_tokens": 4, "completion_tokens": 6,
+                            "total_tokens": 10}
+    assert doc["choices"][0]["finish_reason"] == "length"
+
+
+def test_completions_seeded_sampling_reproducible(stack):
+    fe, _, _ = stack
+    body = {"prompt": [3, 4, 5], "max_tokens": 6, "temperature": 0.9,
+            "top_p": 0.95, "seed": 77}
+    _, _, a = _post(fe, "/v1/completions", body)
+    _, _, b = _post(fe, "/v1/completions", body)
+    assert a["choices"][0]["token_ids"] == b["choices"][0]["token_ids"]
+
+
+def test_chat_completions_text(stack):
+    fe, _, _ = stack
+    st, _, doc = _post(fe, "/v1/chat/completions",
+                       {"messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 4, "temperature": 0})
+    assert st == 200 and doc["object"] == "chat.completion"
+    msg = doc["choices"][0]["message"]
+    assert msg["role"] == "assistant" and isinstance(msg["content"], str)
+
+
+def test_validation_errors_are_400(stack):
+    fe, _, _ = stack
+    for body in (
+        {"prompt": 12},                                    # bad type
+        {"prompt": [1, 2], "max_tokens": 0},               # bad range
+        {"prompt": [1, 2], "stop": "ab"},                  # 2-token stop
+        {"messages": []},
+    ):
+        path = "/v1/chat/completions" if "messages" in body \
+            else "/v1/completions"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(fe, path, body)
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["error"]["type"] \
+            == "invalid_request_error"
+
+
+def test_qos_shed_maps_to_429_with_retry_after(stack):
+    fe, _, gate = stack
+    # drive the flood tenant's bucket negative, then hit the door
+    gate.settle(gate.admit("flood"), completed_tokens=10 ** 4)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(fe, "/v1/completions",
+              {"prompt": [1, 2], "max_tokens": 2},
+              headers={"X-Tenant": "flood"})
+    assert ei.value.code == 429
+    assert int(ei.value.headers["Retry-After"]) >= 1
+    assert json.loads(ei.value.read())["error"]["type"] \
+        == "rate_limit_exceeded"
+
+
+def _open_stream(port, path, body):
+    """Raw-socket POST returning (sock, buffered reader) so the test
+    can observe SSE chunks as they arrive (and hang up mid-stream)."""
+    payload = json.dumps(body).encode()
+    sock = socket.create_connection(("127.0.0.1", port), timeout=60)
+    sock.sendall(
+        f"POST {path} HTTP/1.1\r\nHost: x\r\n"
+        f"Content-Type: application/json\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload)
+    return sock, sock.makefile("rb")
+
+
+def _read_headers(rf):
+    status = int(rf.readline().split()[1])
+    while rf.readline().strip():
+        pass
+    return status
+
+
+def test_streaming_sse_first_token_before_completion(stack):
+    fe, engine, _ = stack
+    sock, rf = _open_stream(fe.port, "/v1/completions",
+                            {"prompt": [9, 8, 7], "max_tokens": 24,
+                             "stream": True})
+    try:
+        assert _read_headers(rf) == 200
+        events = []
+        first_live = None
+        while True:
+            line = rf.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            if first_live is None:
+                # the acceptance gate: the first streamed token is
+                # observable while the request is still decoding
+                first_live = bool(engine._live)
+            if line == b"data: [DONE]":
+                events.append("DONE")
+                break
+            events.append(json.loads(line[len(b"data: "):]))
+        assert events[-1] == "DONE"
+        chunks = [e for e in events if e != "DONE"]
+        toks = [t for c in chunks for t in c["choices"][0]["token_ids"]]
+        assert len(toks) == 24
+        assert first_live, "first SSE chunk arrived after completion"
+        assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    finally:
+        sock.close()
+
+
+def test_streaming_chat_role_then_deltas(stack):
+    fe, _, _ = stack
+    sock, rf = _open_stream(
+        fe.port, "/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "ok"}],
+         "max_tokens": 4, "stream": True})
+    try:
+        assert _read_headers(rf) == 200
+        lines = [ln.strip() for ln in rf if ln.strip()]
+        datas = [json.loads(ln[len(b"data: "):]) for ln in lines
+                 if ln.startswith(b"data: ") and ln != b"data: [DONE]"]
+        assert datas[0]["choices"][0]["delta"].get("role") == "assistant"
+        assert datas[0]["object"] == "chat.completion.chunk"
+        assert lines[-1] == b"data: [DONE]"
+    finally:
+        sock.close()
+
+
+def test_client_disconnect_cancels_and_restores_pages(stack):
+    fe, engine, _ = stack
+    free0 = engine.alloc.free_pages
+    base = fe._m["disconnects"]._value
+    sock, rf = _open_stream(fe.port, "/v1/completions",
+                            {"prompt": [4, 5, 6], "max_tokens": 512,
+                             "stream": True})
+    assert _read_headers(rf) == 200
+    # wait for at least one token chunk, then vanish mid-stream
+    while True:
+        line = rf.readline().strip()
+        if line.startswith(b"data: "):
+            break
+    # hard close: shutdown THEN close both handles — makefile() holds
+    # a reference, so close() alone never tears the connection down
+    sock.shutdown(socket.SHUT_RDWR)
+    rf.close()
+    sock.close()
+    # the next write hits the broken pipe -> ClientDisconnected ->
+    # frontend cancels -> the engine retires the request and the
+    # allocator gets its pages back
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if not engine._live and engine.alloc.free_pages == free0 \
+                and fe._m["disconnects"]._value == base + 1:
+            break
+        time.sleep(0.05)
+    assert not engine._live
+    assert engine.alloc.free_pages == free0
+    assert fe._m["disconnects"]._value == base + 1
+
+
+def test_multi_tenant_flood_e2e(stack):
+    """The issue's e2e: a batch-class tenant floods the door while a
+    premium tenant trickles. The flood is shed/degraded (429s, batch
+    priority) — the premium tenant is the one that completes."""
+    fe, engine, gate = stack
+    results = {"prem": [], "flood_ok": 0, "flood_shed": 0}
+    lock = threading.Lock()
+    # metric objects dedup by name in the default registry, so counts
+    # survive across tests — assert deltas, not absolutes
+    shed0 = gate._m["shed"].labels("flood")._value
+    adm0 = gate._m["admitted"].labels("prem")._value
+
+    def flood():
+        for _ in range(6):
+            try:
+                _post(fe, "/v1/completions",
+                      {"prompt": [1, 2, 3], "max_tokens": 12},
+                      headers={"X-Tenant": "flood"})
+                with lock:
+                    results["flood_ok"] += 1
+            except urllib.error.HTTPError as e:
+                assert e.code in (429, 503)
+                with lock:
+                    results["flood_shed"] += 1
+
+    def trickle():
+        for i in range(3):
+            t0 = time.perf_counter()
+            st, _, doc = _post(fe, "/v1/completions",
+                               {"prompt": [7, 8, 9, i], "max_tokens": 8},
+                               headers={"X-Tenant": "prem"})
+            with lock:
+                results["prem"].append(
+                    (st, time.perf_counter() - t0,
+                     len(doc["choices"][0]["token_ids"])))
+
+    threads = [threading.Thread(target=flood) for _ in range(3)] \
+        + [threading.Thread(target=trickle)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    # the victim tenant: every premium request completed in full
+    assert len(results["prem"]) == 3
+    assert all(st == 200 and n == 8 for st, _, n in results["prem"])
+    # the flood paid: its tiny token-rate share sheds most of 18
+    # requests x 12 tokens against a 40 tok/s bucket
+    assert results["flood_shed"] > 0
+    snap = gate.snapshot()
+    assert snap["prem"]["priority"] > snap["flood"]["priority"]
+    # per-tenant accounting exported
+    assert gate._m["shed"].labels("flood")._value - shed0 \
+        == results["flood_shed"]
+    assert gate._m["admitted"].labels("prem")._value - adm0 >= 3
+
+
+def test_cluster_request_pins_auto_seed():
+    """A seed-less SAMPLED request gets its auto-seed pinned at the
+    cluster level, so a failover's fresh engine attempt redraws the
+    SAME sequence (engine auto-seeds are per-attempt)."""
+    from paddle_tpu.inference.cluster import ClusterRequest
+    from paddle_tpu.inference.sampling import SamplingParams
+
+    creq = ClusterRequest([1, 2], sampling=SamplingParams(
+        temperature=1.0))
+    assert creq.sampling.seed is not None
+    # greedy requests stay seed-less (the draw is deterministic)
+    greedy = ClusterRequest([1, 2], sampling=SamplingParams())
+    assert greedy.sampling.seed is None
+    # an explicit seed is preserved verbatim
+    pinned = ClusterRequest([1, 2], sampling=SamplingParams(
+        temperature=1.0, seed=11))
+    assert pinned.sampling.seed == 11
+
+
+def test_qos_grant_settles_on_unexpected_submit_failure(stack,
+                                                        monkeypatch):
+    """ANY submit failure settles the grant — a replica rpc blow-up
+    must not leak the tenant's inflight slot (it would pin
+    max_inflight tenants shed forever)."""
+    fe, _, gate = stack
+
+    def explode(*a, **kw):
+        raise RuntimeError("rpc lost")
+
+    monkeypatch.setattr(fe, "_submit", explode)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(fe, "/v1/completions",
+              {"prompt": [1, 2], "max_tokens": 2},
+              headers={"X-Tenant": "prem"})
+    assert ei.value.code == 500
+    assert gate.snapshot()["prem"]["inflight"] == 0
+
+
+def test_cluster_frontend_roundtrip(model, tmp_path):
+    """The door fronts a ServingCluster the same way it fronts an
+    engine (in-process replicas; request fields ride ClusterRequest)."""
+    from paddle_tpu.inference.cluster import ServingCluster
+
+    cluster = ServingCluster(
+        lambda: LlamaServingEngine(model, max_batch=2, page_size=8,
+                                   num_pages=32, prefix_cache=False),
+        num_replicas=2, store_path=str(tmp_path / "store"))
+    cluster.start()
+    fe = ServingFrontend(
+        cluster=cluster,
+        tokenizer=ByteTokenizer(vocab_size=model.config.vocab_size))
+    fe.start(port=0)
+    try:
+        st, _, doc = _post(fe, "/v1/completions",
+                           {"prompt": [5, 6, 7], "max_tokens": 5,
+                            "temperature": 0})
+        assert st == 200
+        assert len(doc["choices"][0]["token_ids"]) == 5
+        # /healthz carries the cluster membership view
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fe.port}/healthz", timeout=10) as r:
+            doc = json.load(r)
+        assert doc["backend"] == "cluster" and "membership" in doc
+    finally:
+        fe.stop()
+        cluster.stop()

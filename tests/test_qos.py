@@ -1,0 +1,139 @@
+"""Multi-tenant QoS gate tests: token buckets, shedding with typed
+retry-after, settle-time debiting, priority-class mapping, and SLO
+breach accounting — all against a fake clock so refill math is exact.
+"""
+
+import pytest
+
+from paddle_tpu.inference.qos import CLASS_PRIORITY, QosGate, Tenant
+from paddle_tpu.inference.serving import AdmissionError
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture()
+def clock():
+    return FakeClock()
+
+
+def test_tenant_validation():
+    with pytest.raises(ValueError, match="unknown tier"):
+        Tenant("x", tier="gold")
+    with pytest.raises(ValueError, match="rate"):
+        Tenant("x", rate=0)
+    t = Tenant("x", tier="premium")
+    assert t.priority == CLASS_PRIORITY["premium"]
+    assert Tenant("y", priority=7).priority == 7
+    # burst defaults to 4 seconds of rate
+    assert Tenant("z", rate=100).burst == 400.0
+
+
+def test_class_ordering():
+    """Premium outranks standard outranks batch on the engine ladder
+    (the ladder only trims/evicts strictly lower priorities)."""
+    assert CLASS_PRIORITY["premium"] > CLASS_PRIORITY["standard"] \
+        > CLASS_PRIORITY["batch"]
+
+
+def test_admit_and_settle_debits_bucket(clock):
+    gate = QosGate([Tenant("a", rate=100, burst=200)], clock=clock)
+    g = gate.admit("a", max_tokens=50)
+    assert g.priority == CLASS_PRIORITY["standard"]
+    gate.settle(g, completed_tokens=150)
+    snap = gate.snapshot()["a"]
+    assert snap["bucket"] == 50.0        # 200 burst - 150 completed
+    assert snap["inflight"] == 0
+
+
+def test_settle_idempotent(clock):
+    gate = QosGate([Tenant("a", rate=100, burst=200)], clock=clock)
+    g = gate.admit("a")
+    gate.settle(g, completed_tokens=50)
+    gate.settle(g, completed_tokens=50)   # second settle is a no-op
+    assert gate.snapshot()["a"]["bucket"] == 150.0
+
+
+def test_shed_when_bucket_empty_with_retry_after(clock):
+    gate = QosGate([Tenant("a", rate=10, burst=40)], clock=clock)
+    g = gate.admit("a")
+    gate.settle(g, completed_tokens=100)  # bucket driven to -60
+    with pytest.raises(AdmissionError) as ei:
+        gate.admit("a")
+    # typed 429 payload: retry_after estimates the refill catching up
+    # past zero (+1 token of headroom): (60 + 1) / 10
+    assert ei.value.retry_after == pytest.approx(6.1)
+    # refill pays the debt back: 7 seconds later we're above zero
+    clock.advance(7.0)
+    assert gate.admit("a") is not None
+
+
+def test_flood_pays_for_itself_only(clock):
+    """One tenant's exhaustion never gates another's admission."""
+    gate = QosGate([Tenant("flood", rate=10, burst=10),
+                    Tenant("prem", tier="premium", rate=1000)],
+                   clock=clock)
+    gate.settle(gate.admit("flood"), completed_tokens=500)
+    with pytest.raises(AdmissionError):
+        gate.admit("flood")
+    g = gate.admit("prem")               # unaffected
+    assert g.priority == CLASS_PRIORITY["premium"]
+
+
+def test_unmetered_tenant_never_sheds(clock):
+    gate = QosGate([Tenant("a")], clock=clock)
+    for _ in range(100):
+        gate.settle(gate.admit("a"), completed_tokens=10 ** 6)
+    assert gate.snapshot()["a"]["bucket"] is None
+
+
+def test_concurrency_cap(clock):
+    gate = QosGate([Tenant("a", max_inflight=2)], clock=clock)
+    g1 = gate.admit("a")
+    gate.admit("a")
+    with pytest.raises(AdmissionError, match="concurrency cap"):
+        gate.admit("a")
+    gate.settle(g1)                      # frees a slot
+    gate.admit("a")
+
+
+def test_unknown_tenant_gets_default_spec(clock):
+    gate = QosGate(default_spec={"tier": "batch", "rate": 5,
+                                 "burst": 5}, clock=clock)
+    g = gate.admit("surprise")
+    assert g.priority == CLASS_PRIORITY["batch"]
+    gate.settle(g, completed_tokens=50)
+    with pytest.raises(AdmissionError):
+        gate.admit("surprise")           # tiny default share exhausted
+
+
+def test_slo_breach_accounting(clock):
+    gate = QosGate([Tenant("a", ttft_slo=0.5, tpot_slo=0.01)],
+                   clock=clock)
+    m = gate._m["breaches"]
+    base_ttft = m.labels("a", "ttft")._value
+    base_tpot = m.labels("a", "tpot")._value
+    gate.settle(gate.admit("a"), completed_tokens=4, ttft=0.2,
+                tpot=0.005)              # within both SLOs
+    assert m.labels("a", "ttft")._value == base_ttft
+    gate.settle(gate.admit("a"), completed_tokens=4, ttft=0.9,
+                tpot=0.02)               # breaches both
+    assert m.labels("a", "ttft")._value == base_ttft + 1
+    assert m.labels("a", "tpot")._value == base_tpot + 1
+
+
+def test_optimistic_admission_costs_nothing_on_shed(clock):
+    """A request that sheds server-side settles with 0 tokens — the
+    tenant's bucket is untouched (debit-from-completion, not reserve)."""
+    gate = QosGate([Tenant("a", rate=10, burst=100)], clock=clock)
+    g = gate.admit("a", max_tokens=10 ** 6)
+    gate.settle(g, completed_tokens=0)
+    assert gate.snapshot()["a"]["bucket"] == 100.0

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Static check: every metric name registered in the codebase appears
+in README.md's metric documentation.
+
+Registration sites are grep-derived: any ``counter("name", ...)`` /
+``gauge("name", ...)`` / ``histogram("name", ...)`` call with a string
+literal first argument under ``paddle_tpu/`` (the registry forwarders
+in ``observability/metrics.py`` take a variable and are skipped
+naturally). Documented names are every backticked token in README.md,
+with two affordances matching the README's established style:
+
+- brace expansion: ``serving_requests_{admitted,completed}_total``
+  documents both expanded names;
+- family wildcards: ``paddle_tpu_xla_*`` documents every metric with
+  that prefix.
+
+Exit 0 when every registered name is documented; exit 1 listing the
+missing ones otherwise. Wired into tier-1 via
+``tests/test_metrics_docs.py`` so a PR that adds a metric without
+documenting it fails CI.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+#: a metric registration with a literal name — possibly line-wrapped
+#: between the open paren and the string
+_REG_RE = re.compile(
+    r"\b(?:counter|gauge|histogram)\(\s*\n?\s*\"([a-z][a-z0-9_]+)\"",
+    re.MULTILINE)
+
+#: backticked tokens in the README that look like metric names
+_DOC_RE = re.compile(r"`([a-zA-Z0-9_{},*]+)`")
+
+#: ``{a,b,c}`` groups inside a documented name
+_BRACE_RE = re.compile(r"\{([a-z0-9_,]+)\}")
+
+
+def registered_metrics(root=ROOT):
+    """{name: [file:line, ...]} of every literal registration site."""
+    out: dict[str, list[str]] = {}
+    for path in sorted((root / "paddle_tpu").rglob("*.py")):
+        text = path.read_text()
+        for m in _REG_RE.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            rel = path.relative_to(root)
+            out.setdefault(m.group(1), []).append(f"{rel}:{line}")
+    return out
+
+
+def _expand_braces(token):
+    # a TRAILING brace group is the README's label-annotation
+    # convention (``watchdog_timeouts_total{watchdog}``) — strip it;
+    # mid-token groups are brace expansions
+    # (``serving_requests_{admitted,completed}_total``)
+    token = re.sub(r"\{[a-z0-9_,]+\}$", "", token)
+    groups = _BRACE_RE.findall(token)
+    if not groups:
+        return [token]
+    template = _BRACE_RE.sub("{}", token)
+    return [template.format(*combo)
+            for combo in itertools.product(
+                *[g.split(",") for g in groups])]
+
+
+def documented_names(readme=None):
+    """(exact_names, wildcard_prefixes) from README backticks."""
+    text = (ROOT / "README.md").read_text() if readme is None else readme
+    exact, prefixes = set(), set()
+    for token in _DOC_RE.findall(text):
+        for name in _expand_braces(token):
+            if name.endswith("*"):
+                prefixes.add(name[:-1])
+            else:
+                exact.add(name)
+    return exact, prefixes
+
+
+def missing_metrics(root=ROOT, readme=None):
+    """[(name, [site, ...])] registered but not documented."""
+    exact, prefixes = documented_names(readme)
+    out = []
+    for name, sites in sorted(registered_metrics(root).items()):
+        if name in exact:
+            continue
+        if any(name.startswith(p) for p in prefixes):
+            continue
+        out.append((name, sites))
+    return out
+
+
+def main(argv=None):
+    missing = missing_metrics()
+    if not missing:
+        n = len(registered_metrics())
+        print(f"ok: all {n} registered metric names documented in "
+              f"README.md")
+        return 0
+    print(f"{len(missing)} registered metric name(s) missing from "
+          f"README.md:", file=sys.stderr)
+    for name, sites in missing:
+        print(f"  {name}   ({sites[0]})", file=sys.stderr)
+    print("document them in a README metric table/list (brace groups "
+          "and `family_*` wildcards count)", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
