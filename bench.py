@@ -313,6 +313,179 @@ def bench_ragged(rows=8, qb=16, heads=16, kv_heads=8, dim=128, page=64,
     }
 
 
+def bench_fused_kv(model, rows=8, qb=16, heads=16, kv_heads=8, dim=128,
+                   page=64, ctx=2048, iters=50, on_tpu=True):
+    """Fused in-kernel KV page write (ROADMAP item 2, first stage) vs
+    the unfused two-op path (scatter + ragged read), at two levels:
+
+    - kernel microbench: ONE `fused_ragged_paged_attention` dispatch vs
+      the `paged_kv_write` scatter followed by `ragged_paged_attention`
+      over the same rows (`fused_kernel_ms` / `unfused_kernel_ms`).
+    - engine e2e: `serving_chunked_tokens_per_sec`-style throughput
+      under PADDLE_TPU_FUSED_KV on vs off, plus each path's
+      `serving_mixed_hbm_bytes` (static cost_analysis of the mixed
+      program) and their delta.
+
+    Gates: ``fused_parity_ok`` — greedy engine outputs BITWISE equal
+    fused vs unfused (fp), q8 kernel within the existing 5%-of-scale
+    bar vs the write-then-read XLA reference, and non-trash pool bytes
+    identical across paths. ``fused_hbm_decreased`` is asserted into
+    ``fused_hbm_ok`` only on TPU: the CPU interpret-mode lowering of
+    the Pallas call inflates cost_analysis with emulation machinery
+    (aliasing copies, per-step slices) that does not exist in the
+    compiled custom call, so off-chip the delta is recorded but the
+    strict-decrease claim rides ROADMAP item 1's on-chip sweep."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.inference.serving import LlamaServingEngine, \
+        _page_write
+    from paddle_tpu.ops import ragged_paged_attention as RPA
+
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    max_pages = ctx // page
+    num_pages = rows * max_pages + 8
+    dump = num_pages - 1
+    kp = jnp.asarray(rng.randn(num_pages, kv_heads, page, dim), dt)
+    vp = jnp.asarray(rng.randn(num_pages, kv_heads, page, dim), dt)
+    q = jnp.asarray(rng.randn(rows, qb, heads, dim), dt)
+    # disjoint tables (each row its own sequence; last page excluded so
+    # the dump page is never referenced), half decode / half chunks
+    perm = rng.permutation(num_pages - 1)[:rows * max_pages]
+    tables = jnp.asarray(perm.reshape(rows, max_pages), jnp.int32)
+    q_lens = np.asarray([1 if i % 2 else 1 + rng.randint(qb)
+                         for i in range(rows)], np.int32)
+    kv = rng.randint(ctx // 2, ctx + 1, (rows,)).astype(np.int32)
+    kv = np.maximum(kv, q_lens)
+    q_starts = kv - q_lens
+    w_starts = q_starts.copy()
+    w_flats = np.concatenate([[0], np.cumsum(q_lens)[:-1]]) \
+        .astype(np.int32)
+    w_ends = kv.copy()
+    t_total = int(q_lens.sum())
+    new_k = jnp.asarray(rng.randn(t_total, kv_heads, dim), dt)
+    new_v = jnp.asarray(rng.randn(t_total, kv_heads, dim), dt)
+    # per-token scatter targets for the unfused reference path
+    pg = np.concatenate([
+        np.asarray(tables)[i, np.arange(q_starts[i], kv[i]) // page]
+        for i in range(rows)]).astype(np.int32)
+    offs = np.concatenate([np.arange(q_starts[i], kv[i]) % page
+                           for i in range(rows)]).astype(np.int32)
+    args_i32 = [jnp.asarray(a) for a in
+                (kv, q_starts, q_lens, w_starts, w_flats, w_ends)]
+    scale = 1.0 / float(np.sqrt(dim))
+
+    def fused_path(q, nk, nv, kp, vp):
+        return RPA._fused_impl(q, nk, nv, kp, vp, tables, *args_i32,
+                               dump, scale)
+
+    def unfused_path(q, nk, nv, kp, vp):
+        kp2 = _page_write(kp, nk, jnp.asarray(pg), jnp.asarray(offs))
+        vp2 = _page_write(vp, nv, jnp.asarray(pg), jnp.asarray(offs))
+        kp2 = getattr(kp2, "_data", kp2)
+        vp2 = getattr(vp2, "_data", vp2)
+        out = RPA._ragged_impl(q, kp2, vp2, tables, args_i32[0],
+                               args_i32[1], args_i32[2], scale)
+        return out, kp2, vp2
+
+    def timeit(f):
+        g = jax.jit(f)
+        out = g(q, new_k, new_v, kp, vp)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g(q, new_k, new_v, kp, vp)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3, out
+
+    fused_ms, (o_f, kpf, vpf) = timeit(fused_path)
+    unfused_ms, (o_u, kpu, vpu) = timeit(unfused_path)
+    live = np.asarray(sorted(set(perm.tolist())))
+    pools_equal = bool(
+        np.array_equal(np.asarray(kpf)[live], np.asarray(kpu)[live])
+        and np.array_equal(np.asarray(vpf)[live], np.asarray(vpu)[live]))
+    out_equal = bool(np.array_equal(np.asarray(o_f), np.asarray(o_u)))
+
+    # q8 kernel parity at the existing 5%-of-scale bar vs the
+    # write-then-read XLA reference
+    kq = jnp.asarray(rng.randint(-127, 128,
+                                 (num_pages, kv_heads, page, dim)),
+                     jnp.int8)
+    vq = jnp.asarray(rng.randint(-127, 128,
+                                 (num_pages, kv_heads, page, dim)),
+                     jnp.int8)
+    ks = jnp.asarray(np.abs(rng.randn(num_pages, kv_heads, page, 1))
+                     .astype(np.float32) * 0.02)
+    vs = jnp.asarray(np.abs(rng.randn(num_pages, kv_heads, page, 1))
+                     .astype(np.float32) * 0.02)
+    q8_args = (jnp.asarray(np.asarray(q, np.float32)),
+               jnp.asarray(np.asarray(new_k, np.float32)),
+               jnp.asarray(np.asarray(new_v, np.float32)),
+               kq, vq, tables, *args_i32, dump)
+    o8f = RPA.fused_ragged_paged_attention(*q8_args, k_scale=ks,
+                                           v_scale=vs)[0]
+    o8x = RPA.fused_ragged_paged_attention_xla(*q8_args, k_scale=ks,
+                                               v_scale=vs)[0]
+    o8f = np.asarray(getattr(o8f, "_data", o8f), np.float32)
+    o8x = np.asarray(o8x, np.float32)
+    err8 = float(np.max(np.abs(o8f - o8x)))
+    bar8 = 0.05 * max(float(np.max(np.abs(o8x))), 1.0)
+
+    # engine e2e under both paths: same workload, fused on vs off
+    model.eval()
+    rng2 = np.random.RandomState(1)
+    v = model.config.vocab_size
+    prompts = [rng2.randint(0, v, (int(rng2.randint(16, 96)),)).tolist()
+               for _ in range(8 if on_tpu else 3)]
+    n_new = 32 if on_tpu else 6
+
+    def e2e(fused):
+        engine = LlamaServingEngine(
+            model, max_batch=8 if on_tpu else 2, page_size=64,
+            num_pages=72 if on_tpu else 24, max_pages_per_seq=8,
+            decode_ticks=16, fused_kv=fused)
+        engine.generate(prompts, max_new_tokens=2)        # compile
+        t0 = time.perf_counter()
+        outs = engine.generate(prompts, max_new_tokens=n_new)
+        dt_ = time.perf_counter() - t0
+        # read THIS engine's cached analysis (budget-shape mixed
+        # program, the largest t_cap) rather than the process-global
+        # gauge: the gauge retains whatever engine last set it, so a
+        # failed attribution in one run would silently compare against
+        # a stale value from another. None (not 0.0) when no analysis
+        # exists (METRICS=0: no AOT executables to cost-analyze) so a
+        # 0-vs-0 comparison can't report a spurious gate failure.
+        hbm = engine._mixed_bytes.get(max(engine._mixed_bytes)) \
+            if engine._mixed_bytes else None
+        engine.close()
+        return outs, sum(len(o) for o in outs) / dt_, hbm
+
+    outs_f, tps_f, hbm_f = e2e(True)
+    outs_u, tps_u, hbm_u = e2e(False)
+    model.train()
+    parity = bool(out_equal and pools_equal and err8 < bar8
+                  and outs_f == outs_u)
+    res = {
+        "fused_kernel_ms": round(fused_ms, 3),
+        "unfused_kernel_ms": round(unfused_ms, 3),
+        "fused_kernel_speedup": round(unfused_ms / fused_ms, 3),
+        "fused_parity_ok": parity,
+        "serving_fused_tokens_per_sec": round(tps_f, 1),
+        "serving_unfused_tokens_per_sec": round(tps_u, 1),
+        "fused_e2e_speedup": round(tps_f / max(tps_u, 1e-9), 3),
+    }
+    if hbm_f is not None and hbm_u is not None:
+        res.update({
+            "serving_mixed_hbm_bytes_fused": hbm_f,
+            "serving_mixed_hbm_bytes_unfused": hbm_u,
+            "fused_hbm_bytes_delta": hbm_u - hbm_f,
+            "fused_hbm_decreased": bool(hbm_f < hbm_u),
+        })
+        if on_tpu:
+            res["fused_hbm_ok"] = bool(hbm_f < hbm_u)
+    return res
+
+
 def bench_serving(model, n_requests=24, new_tokens=48, max_batch=16,
                   decode_ceiling=None, on_tpu=True):
     """Chunked-prefill engine throughput: ragged prompts admitted on the
@@ -1046,6 +1219,18 @@ def main():
     except Exception as e:
         log(f"serving bench failed: {e!r:.300}")
         result["serving_error"] = repr(e)[:200]
+
+    try:
+        model = bench_train_step.last_model
+        if on_tpu:
+            result.update(bench_fused_kv(model, on_tpu=True))
+        else:
+            result.update(bench_fused_kv(
+                model, rows=4, qb=8, heads=4, kv_heads=2, dim=32,
+                page=8, ctx=64, iters=2, on_tpu=False))
+    except Exception as e:
+        log(f"fused-kv bench failed: {e!r:.300}")
+        result["fused_kv_error"] = repr(e)[:200]
 
     try:
         model = bench_train_step.last_model

@@ -48,7 +48,12 @@ def quantize_kv_int8(x):
     """
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=-1)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
+    # multiply by the f32 reciprocal instead of dividing by 127: XLA
+    # strength-reduces constant divides to reciprocal multiplies under
+    # jit, so an eager divide and a compiled one differ by 1 ulp —
+    # writing the multiply keeps the scale bitwise identical across
+    # eager, jit and the fused kernel's in-Pallas quantizer
+    scale = jnp.maximum(amax, 1e-8) * jnp.float32(1.0 / 127.0)
     q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127) \
         .astype(jnp.int8)
     return q, scale

@@ -133,7 +133,8 @@ from ..observability import compile_watch as _cw
 from ..observability import flight_recorder as _fr
 from ..observability import metrics as _om
 from ..observability.trace import span as _span
-from ..ops.ragged_paged_attention import ragged_paged_attention
+from ..ops.ragged_paged_attention import (fused_ragged_paged_attention,
+                                          ragged_paged_attention)
 from ..testing import faults as _faults
 from .paged_cache import PageAllocator, quantize_kv_int8
 from .sampling import SamplingParams, sampled_next_tokens
@@ -317,6 +318,11 @@ def _serving_metrics():
             "serving_constraint_errors_total",
             "constraint hooks that raised (the step proceeds "
             "unconstrained)"),
+        "mixed_hbm": _om.gauge(
+            "serving_mixed_hbm_bytes",
+            "static cost_analysis bytes accessed of the mixed-program "
+            "executable most recently dispatched (fused KV writes show "
+            "as a strict decrease vs PADDLE_TPU_FUSED_KV=0)"),
     }
 
 
@@ -347,11 +353,33 @@ def _fatal_guard(origin):
     return deco
 
 
+def _last_writer_values(new, page_ids, offs, page_slots):
+    """Pin LAST-WRITER-WINS semantics for a scatter whose (page, slot)
+    targets may repeat within one dispatch (padding tokens all aim at
+    the trash page; a chunk-boundary replay may legally re-write a
+    slot): XLA's scatter leaves duplicate-index ordering
+    implementation-defined, so instead of trusting it every duplicate's
+    update VALUE is replaced by the last writer's — identical updates
+    are order-independent by construction. The fused kernel pins the
+    same semantics (the sequence's last row owns the page write), so
+    both paths leave bitwise-identical slots. O(T^2) int compare on the
+    packed token axis — noise next to the model math."""
+    t = page_ids.shape[0]
+    key = page_ids.astype(jnp.int32) * page_slots + offs.astype(jnp.int32)
+    eq = key[:, None] == key[None, :]
+    idx_last = jnp.argmax(
+        jnp.where(eq, jnp.arange(t, dtype=jnp.int32)[None, :], -1),
+        axis=1)
+    return new[idx_last]
+
+
 def _page_write(pages, new, page_ids, offs):
     """Functional scatter of ``new [B, Hk, D]`` into head-major ``pages
     [P, Hk, page, D]`` at (page_ids[b], h, offs[b]) — one token per live
-    sequence."""
+    sequence. Duplicate targets resolve last-writer-wins (see
+    `_last_writer_values`)."""
     def fn(pages, new, page_ids, offs):
+        new = _last_writer_values(new, page_ids, offs, pages.shape[2])
         hidx = jnp.arange(pages.shape[1])[None, :]
         return pages.at[page_ids[:, None], hidx, offs[:, None]].set(
             new.astype(pages.dtype))
@@ -365,9 +393,12 @@ def _page_write_q8(pages, scales, new, page_ids, offs):
     is int8-quantized per head (symmetric, absmax) and scattered into
     ``pages [P, Hk, page, D]`` int8, with the per-head scale landing in
     the ``scales [P, Hk, page, 1]`` sidecar at the same (page, head,
-    slot). Every slot's (int8, scale) pair is written exactly once by
-    its own token — later writes to other slots never skew it."""
+    slot). A slot's (int8, scale) pair is always the LAST writer's —
+    duplicates are rewritten to the last value before the scatter (see
+    `_last_writer_values`), so a twice-written slot's sidecar can never
+    mix one write's int8 with another's scale."""
     def fn(pages, scales, new, page_ids, offs):
+        new = _last_writer_values(new, page_ids, offs, pages.shape[2])
         q, s = quantize_kv_int8(new)             # [B, Hk, D], [B, Hk]
         hidx = jnp.arange(pages.shape[1])[None, :]
         pages = pages.at[page_ids[:, None], hidx, offs[:, None]].set(q)
@@ -483,7 +514,7 @@ class LlamaServingEngine:
                  stuck_min_timeout=30.0, prefix_cache=True,
                  prefix_cache_pages=None, prewarm=None, kv_dtype=None,
                  spec_k=None, spec_ngram=3, drafter_factory=None,
-                 sampling=None, sample_slots=8):
+                 sampling=None, sample_slots=8, fused_kv=None):
         if num_pages is None:
             num_pages = max_batch * 24 + 8
         self.model = model
@@ -590,6 +621,18 @@ class LlamaServingEngine:
         if spec_k is None:
             spec_k = int(os.environ.get("PADDLE_TPU_SPEC_K", "0") or 0)
         self.spec_k = max(0, min(int(spec_k), self.chunk_block - 1))
+        # fused KV page write (ROADMAP item 2, first stage): the mixed
+        # program writes each token's post-rope K/V into its page
+        # INSIDE the ragged attention kernel instead of a separate
+        # scatter op per layer — one HBM round trip less per layer.
+        # PADDLE_TPU_FUSED_KV=0 restores the two-op path byte for byte
+        # (the fallback runbook lives in the README); both paths are
+        # greedy token-exact by construction.
+        if fused_kv is None:
+            fused_kv = os.environ.get(
+                "PADDLE_TPU_FUSED_KV", "1").lower() \
+                not in ("0", "false", "off")
+        self.fused_kv = bool(fused_kv)
         # per-request sampling (ROADMAP item 4): the mixed program
         # grows a vectorized per-row sample step next to the argmax —
         # every sampler knob is runtime data ([R]-shaped arrays), so
@@ -633,6 +676,7 @@ class LlamaServingEngine:
         self._mixed_static = None
         self._scan_static: dict[int, object] = {}   # ticks -> program
         self._warmed_keys: set = set()  # ("mixed", T) / ("scan", k)
+        self._mixed_bytes: dict[int, float] = {}  # t_cap -> hbm bytes
         self._warm_dispatches = 0       # dummy compile-warm dispatches
         # lifecycle state: one re-entrant lock guards _live, the
         # requeue, deferred releases and entry-depth accounting so
@@ -862,9 +906,9 @@ class LlamaServingEngine:
     # ------------------------------------------------------------------
     def _mixed_forward(self, tokens, pos, page_ids, offs, row_tok,
                        flat_idx, last_idx, tables, kv_lens, q_starts,
-                       q_lens, temps, top_ps, top_ks, seeds, slot_ids,
-                       slot_vals, cmodes, k_pools, v_pools, k_scales,
-                       v_scales):
+                       q_lens, w_starts, w_flats, w_ends, temps, top_ps,
+                       top_ks, seeds, slot_ids, slot_vals, cmodes,
+                       k_pools, v_pools, k_scales, v_scales):
         """ONE token-packed model step: embed [1, T] real tokens (a mix
         of prefill-chunk tokens, speculative verify tokens and decode
         tokens, back to back with no inter-row padding), scatter every
@@ -893,10 +937,22 @@ class LlamaServingEngine:
         position — so the draw at a position never depends on how it
         was dispatched (step, scan tick, or speculative verify row).
 
+        With ``fused_kv`` (the default) the per-layer scatter + read
+        pair collapses into ONE `fused_ragged_paged_attention` call:
+        the kernel writes each row's K/V into its pages in-grid (the
+        sequence's last row owns the write-back; every reader row
+        replays this dispatch's writes from the packed rows, so later
+        chunks of one prompt attend earlier chunks of the SAME
+        dispatch without an HBM round trip). ``w_starts``/``w_flats``/
+        ``w_ends`` [R] carry the write-span metadata; ``page_ids``/
+        ``offs`` still enter the program for the unfused path (and are
+        inert, never touched, under fusion).
+
         tokens/pos [1, T]; page_ids/offs/flat_idx [T]; row_tok [R, QB];
-        last_idx/kv_lens/q_starts/q_lens/temps/top_ps/top_ks/seeds/
-        cmodes [R]; slot_ids/slot_vals [R, B]; tables [R, W];
-        k/v_scales are empty lists for float pools.
+        last_idx/kv_lens/q_starts/q_lens/w_starts/w_flats/w_ends/
+        temps/top_ps/top_ks/seeds/cmodes [R]; slot_ids/slot_vals
+        [R, B]; tables [R, W]; k/v_scales are empty lists for float
+        pools.
         Returns (next token ids — 1-D [T] when speculative, 1-D [R]
         otherwise — new k_pools, new v_pools, new k_scales,
         new v_scales)."""
@@ -922,29 +978,54 @@ class LlamaServingEngine:
                 rotary_emb_base=cfg.rope_theta)
             k2 = k.reshape([t, att.num_kv_heads, att.head_dim])
             v2 = v.reshape([t, att.num_kv_heads, att.head_dim])
-            if self.kv_quant:
-                kp, ksc = _page_write_q8(k_pools[li], k_scales[li], k2,
-                                         page_ids, offs)
-                vp, vsc = _page_write_q8(v_pools[li], v_scales[li], v2,
-                                         page_ids, offs)
-                new_ks.append(ksc)
-                new_vs.append(vsc)
-            else:
-                kp = _page_write(k_pools[li], k2, page_ids, offs)
-                vp = _page_write(v_pools[li], v2, page_ids, offs)
-                ksc = vsc = None
-            new_k.append(kp)
-            new_v.append(vp)
             # pack the flat token axis into the kernel's [R, QB] row
-            # blocks; every row's K/V is already in the pool (the
-            # scatter above covers ALL rows of this dispatch), so a
-            # later chunk of the same sequence may attend an earlier
-            # chunk from the same step
+            # blocks
             q4 = _token_gather(
                 q.reshape([t, att.num_heads, att.head_dim]), row_tok)
-            attn4 = ragged_paged_attention(q4, kp, vp, tables, kv_lens,
-                                           q_starts, q_lens,
-                                           k_scale=ksc, v_scale=vsc)
+            if self.fused_kv:
+                # ONE kernel writes this dispatch's K/V into the pages
+                # AND attends through them (in-grid replay keeps later
+                # chunks of one prompt coherent with earlier rows of
+                # the same dispatch) — no separate scatter, no HBM
+                # round trip between producer and consumer
+                if self.kv_quant:
+                    attn4, kp, vp, ksc, vsc = \
+                        fused_ragged_paged_attention(
+                            q4, k2, v2, k_pools[li], v_pools[li],
+                            tables, kv_lens, q_starts, q_lens,
+                            w_starts, w_flats, w_ends, self.trash_page,
+                            k_scale=k_scales[li], v_scale=v_scales[li])
+                    new_ks.append(ksc)
+                    new_vs.append(vsc)
+                else:
+                    attn4, kp, vp = fused_ragged_paged_attention(
+                        q4, k2, v2, k_pools[li], v_pools[li], tables,
+                        kv_lens, q_starts, q_lens, w_starts, w_flats,
+                        w_ends, self.trash_page)
+                new_k.append(kp)
+                new_v.append(vp)
+            else:
+                # unfused reference path (PADDLE_TPU_FUSED_KV=0):
+                # scatter every row's K/V first, then attend — a later
+                # chunk of the same sequence attends what the scatter
+                # just wrote
+                if self.kv_quant:
+                    kp, ksc = _page_write_q8(k_pools[li], k_scales[li],
+                                             k2, page_ids, offs)
+                    vp, vsc = _page_write_q8(v_pools[li], v_scales[li],
+                                             v2, page_ids, offs)
+                    new_ks.append(ksc)
+                    new_vs.append(vsc)
+                else:
+                    kp = _page_write(k_pools[li], k2, page_ids, offs)
+                    vp = _page_write(v_pools[li], v2, page_ids, offs)
+                    ksc = vsc = None
+                new_k.append(kp)
+                new_v.append(vp)
+                attn4 = ragged_paged_attention(q4, kp, vp, tables,
+                                               kv_lens, q_starts,
+                                               q_lens, k_scale=ksc,
+                                               v_scale=vsc)
             attn = _token_gather(
                 attn4.reshape([r_rows * qb, att.num_heads,
                                att.head_dim]), flat_idx)
@@ -1018,6 +1099,43 @@ class LlamaServingEngine:
                 donate_inputs=True, name="serving.mixed_step")
             self._mixed_static._warmed_any = True
         return self._mixed_static
+
+    def _note_mixed_bytes(self, t_cap):
+        """Refresh the ``serving_mixed_hbm_bytes`` gauge with the
+        static cost_analysis bytes of the mixed program just
+        dispatched. The analysis runs ONCE per token shape (cached);
+        every later dispatch is a dict lookup + gauge set. Under
+        PADDLE_TPU_METRICS=0 the AOT executables don't exist and this
+        is a no-op — the zero-cost mandate holds."""
+        if not _om.enabled():
+            return
+        nbytes = self._mixed_bytes.get(t_cap)
+        if nbytes is None:
+            sf = self._mixed_static
+            if sf is None:
+                return
+            compiled = None
+            # match the executable by its signature: the FIRST leaf of
+            # a mixed-program signature is the [1, T] token input, so
+            # its shape identifies the dispatch's t_cap exactly. A
+            # signature whose AOT slot is None (aot unsupported /
+            # AOT_MISMATCH demotion) is skipped — misattributing some
+            # OTHER shape's bytes here would poison the exact
+            # fused-vs-unfused comparison the gauge exists for.
+            for sig, c in sf._aot.items():
+                if c is None:
+                    continue
+                shapes = sig[0]
+                if shapes and shapes[0][0] == (1, t_cap):
+                    compiled = c
+                    break
+            if compiled is None:
+                return
+            _, nbytes, _ = _cw.CompileWatch._analyze(compiled)
+            if nbytes is None:
+                return
+            self._mixed_bytes[t_cap] = nbytes
+        self._m["mixed_hbm"].set(nbytes)
 
     def _prefix_insert(self, reqs, sids):
         """Pin freshly written full prompt pages in the prefix cache
@@ -1306,6 +1424,15 @@ class LlamaServingEngine:
         kv_lens = np.zeros((r_cap,), np.int32)
         q_starts = np.zeros((r_cap,), np.int32)
         q_lens = np.zeros((r_cap,), np.int32)
+        # fused-write metadata: per row, the first position of its
+        # sequence written by THIS dispatch, that position's packed
+        # index, and the sequence's final kv_len (rows of one sequence
+        # are consecutive, so one forward pass collects all three)
+        w_starts = np.zeros((r_cap,), np.int32)
+        w_flats = np.zeros((r_cap,), np.int32)
+        w_ends = np.zeros((r_cap,), np.int32)
+        seq_first: dict[int, tuple] = {}     # sid -> (w_start, w_flat)
+        seq_last: dict[int, int] = {}        # sid -> w_end
         t = 0
         flat_start = []         # each row's first index in the T axis
         for i, (r, sid, start, n, toks, is_dec) in enumerate(rows):
@@ -1322,8 +1449,14 @@ class LlamaServingEngine:
             row_tok[i, :n] = np.arange(t, t + n)
             flat_idx[t:t + n] = i * qb + np.arange(n)
             flat_start.append(t)
+            if sid not in seq_first:
+                seq_first[sid] = (start, t)
+            seq_last[sid] = start + n
             t += n
             last_idx[i] = t - 1
+        for i, (r, sid, start, n, toks, is_dec) in enumerate(rows):
+            w_starts[i], w_flats[i] = seq_first[sid]
+            w_ends[i] = seq_last[sid]
         (temps, top_ps, top_ks, seeds, slot_ids, slot_vals,
          cmodes) = self._sample_arrays([row[0] for row in rows], r_cap)
         self._record_shape("mixed", t_cap)
@@ -1347,6 +1480,9 @@ class LlamaServingEngine:
                     Tensor(jnp.asarray(kv_lens)),
                     Tensor(jnp.asarray(q_starts)),
                     Tensor(jnp.asarray(q_lens)),
+                    Tensor(jnp.asarray(w_starts)),
+                    Tensor(jnp.asarray(w_flats)),
+                    Tensor(jnp.asarray(w_ends)),
                     Tensor(jnp.asarray(temps)),
                     Tensor(jnp.asarray(top_ps)),
                     Tensor(jnp.asarray(top_ks)),
@@ -1362,6 +1498,7 @@ class LlamaServingEngine:
             dur = time.perf_counter() - t0
             self._disarm_watchdog(dur, cold=cold)
             self._warmed_keys.add(key)
+        self._note_mixed_bytes(t_cap)
         self._flush_deferred()
         self.k_pools, self.v_pools = list(new_k), list(new_v)
         if self.kv_quant:
@@ -1545,7 +1682,11 @@ class LlamaServingEngine:
                  # the sample step adds inputs + a vocab sort to every
                  # serving program, and the slot width shapes the bias
                  # arrays — both fork the compiled surface
-                 bool(self.sample_enabled), self.sample_slots)
+                 bool(self.sample_enabled), self.sample_slots,
+                 # fused vs unfused engines compile different mixed
+                 # programs (in-kernel write vs scatter + read): a
+                 # prewarm recipe must never cross the two
+                 bool(self.fused_kv))
         return "llama:" + hashlib.sha1(
             repr(parts).encode()).hexdigest()[:16]
 
@@ -1597,6 +1738,9 @@ class LlamaServingEngine:
                 Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
                 Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
                 Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
+                Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
+                Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
+                Tensor(jnp.asarray(np.zeros((r_cap,), np.int32))),
                 *[Tensor(jnp.asarray(a)) for a in samp],
                 self.k_pools, self.v_pools,
                 self.k_scales, self.v_scales)
@@ -1606,6 +1750,7 @@ class LlamaServingEngine:
         self._warmed_keys.add(("mixed", t_cap))
         self._warm_dispatches += 1
         self._record_shape("mixed", t_cap)
+        self._note_mixed_bytes(t_cap)
         return True
 
     def _warm_scan(self, n):
@@ -2176,7 +2321,14 @@ class LlamaServingEngine:
                     Tensor(pids), Tensor(offs), Tensor(row_tok),
                     Tensor(rows), Tensor(rows), Tensor(tab),
                     Tensor(lc.astype(jnp.int32)), Tensor(start),
-                    Tensor(ones), *samp,
+                    Tensor(ones),
+                    # fused-write metadata for a decode tick: each row
+                    # writes exactly its own one token, so the write
+                    # span starts at the token's position, its packed
+                    # index is the row index, and every row is its
+                    # sequence's last (w_end == kv_len)
+                    Tensor(start), Tensor(rows),
+                    Tensor(lc.astype(jnp.int32)), *samp,
                     [Tensor(a) for a in kc], [Tensor(a) for a in vc],
                     [Tensor(a) for a in ksc], [Tensor(a) for a in vsc])
                 nxt_arr = nxt._data.reshape(tok.shape).astype(tok.dtype)

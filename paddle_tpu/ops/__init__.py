@@ -8,3 +8,4 @@ seam.
 
 from . import flash_attention  # noqa: F401
 from . import grouped_gemm  # noqa: F401
+from . import ragged_paged_attention  # noqa: F401

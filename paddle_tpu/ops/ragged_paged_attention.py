@@ -55,6 +55,45 @@ The kernel runs grid (R, Hk, W) with one online-softmax accumulator in
 VMEM scratch per (row, kv-head); the prefetched block table picks which
 HBM page each grid step streams into VMEM, and pages at or past
 ``kv_lens[r]`` are skipped. Inference-only: no VJP.
+
+Fused KV write (`fused_ragged_paged_attention`): the first step toward
+the per-layer decode megakernel (ROADMAP item 2; MPK arXiv 2512.22219,
+Neptune arXiv 2510.08726). The serving engine's unfused step scatters
+the current tokens' post-rope K/V into the pools with a separate XLA
+op, then this kernel re-reads the same pages through the same block
+tables — an HBM round trip per layer at exactly the producer/consumer
+locality boundary both papers name. The fused variant takes the packed
+new K/V rows (``new_k/new_v [T, Hk, D]``, the flat token axis of the
+mixed dispatch) plus per-row write metadata and performs the page write
+INSIDE the Pallas program, returning the updated pools through
+aliased outputs (`input_output_aliases`), so the scatter op — and its
+round trip — disappears.
+
+Ordering contract (the subtlety): later prefill chunks of one prompt
+may sit in the SAME grid as the rows that produce the K/V they must
+attend. The kernel does not rely on in-kernel write-then-read
+visibility at all — pipelined page fetches may legally race in-kernel
+writes. Instead every row REPLAYS the dispatch's writes on read:
+positions ``[w_start[r], kv_lens[r])`` of row r's sequence were
+written by rows <= r of this dispatch and are overlaid from the packed
+``new_k/new_v`` rows (their flat indices are affine in the position:
+chunks of one sequence are packed contiguously in position order, so
+position p lives at flat index ``w_flat[r] + p - w_start[r]``); only
+positions below ``w_start[r]`` come from the streamed page. The HBM
+write-back itself is done ONCE per page, by the sequence's LAST row in
+the dispatch (``kv_lens[r] == w_end[r]``) — no page is the write
+target of two grid steps, so no copy-out ordering between steps is
+ever required. Grid steps whose page holds no new token write to the
+caller-designated ``dump_page`` (the serving engine's trash page).
+The q8 path quantizes the fresh rows in-kernel with bitwise the same
+math as ``quantize_kv_int8`` (per-head-per-slot symmetric absmax
+scales into the ``[P, Hk, page, 1]`` sidecars), so fused and unfused
+pools agree bit for bit.
+
+`ragged_paged_attention_xla` stays a WRITE-THEN-READ exact-parity
+reference on purpose: two dependent XLA ops have unambiguous
+sequential semantics, which is what the fused kernel's replay must be
+proven against (`fused_ragged_paged_attention_xla` composes them).
 """
 
 from __future__ import annotations
@@ -76,7 +115,8 @@ except ImportError:  # pragma: no cover
 from ..framework.tensor import run_op
 
 __all__ = ["ragged_paged_attention", "ragged_paged_attention_xla",
-           "supported"]
+           "supported", "fused_ragged_paged_attention",
+           "fused_ragged_paged_attention_xla", "fused_supported"]
 
 NEG_INF = -1e30
 
@@ -383,6 +423,555 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
     return run_op("ragged_paged_attention", fn,
                   (q, k_pages, v_pages, block_tables, kv_lens, q_starts,
                    q_lens), differentiable=False)
+
+
+# ----------------------------------------------------------------------
+# fused KV page write (ROADMAP item 2, first stage): the page write of
+# the current dispatch's tokens happens INSIDE the attention kernel —
+# see the module docstring for the replay/ordering contract.
+# ----------------------------------------------------------------------
+
+def fused_supported(q, new_k, new_v, k_pages, v_pages, block_tables,
+                    kv_lens, q_starts, q_lens, w_starts, w_flats,
+                    w_ends, dump_page, k_scale=None, v_scale=None):
+    """Preconditions of the fused kernel: everything `supported`
+    checks, plus packed new-row operands ``new_k/new_v [T, Hk, D]``
+    (T >= 1), per-row write metadata ``w_starts/w_flats/w_ends [R]``
+    and a valid ``dump_page`` id (a page no live table references —
+    grid steps with nothing to write dump their page-sized output
+    there)."""
+    if not supported(q, k_pages, v_pages, block_tables, kv_lens,
+                     q_starts, q_lens, k_scale, v_scale):
+        return False
+    r = getattr(q, "_data", q).shape[0]
+    p, hk, _, d = getattr(k_pages, "_data", k_pages).shape
+    for a in (w_starts, w_flats, w_ends):
+        if tuple(getattr(a, "_data", a).shape) != (r,):
+            return False
+    nk = getattr(new_k, "_data", new_k)
+    nv = getattr(new_v, "_data", new_v)
+    if len(nk.shape) != 3 or tuple(nk.shape) != tuple(nv.shape):
+        return False
+    t, nhk, nd = nk.shape
+    if t < 1 or nhk != hk or nd != d:
+        return False
+    try:
+        dp = int(dump_page)
+    except (TypeError, ValueError):
+        return False
+    return 0 <= dp < p
+
+
+def _fused_kernel(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
+                  w_starts_ref, w_flats_ref, w_ends_ref,
+                  q_ref, k_ref, v_ref, nk_ref, nv_ref,
+                  o_ref, ko_ref, vo_ref,
+                  acc_ref, m_ref, l_ref, *, page_size, group, scale,
+                  pad):
+    r = pl.program_id(0)
+    p = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = kv_lens_ref[r]
+    ws = w_starts_ref[r]
+    page_start = p * page_size
+
+    @pl.when(page_start < ctx)
+    def _compute():
+        # replay this dispatch's writes over the streamed page: slots
+        # at positions [w_start, ctx) were produced by rows <= r of
+        # THIS grid and must be read from the packed new rows, never
+        # from HBM — a pipelined page fetch may legally race the
+        # write-back. Chunks of one sequence are packed contiguously
+        # in position order, so position pos lives at packed index
+        # w_flat + pos - w_start (shifted by the left pad).
+        tpad = nk_ref.shape[1]
+        f0 = jnp.clip(w_flats_ref[r] + page_start - ws + pad, 0,
+                      tpad - page_size)
+        spos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)
+        fresh = (spos >= ws) & (spos < ctx)
+        k_pg = jnp.where(fresh, nk_ref[0, pl.ds(f0, page_size), :],
+                         k_ref[0, 0])
+        v_pg = jnp.where(fresh, nv_ref[0, pl.ds(f0, page_size), :],
+                         v_ref[0, 0])
+
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # [QB*G, D]
+        k = k_pg.astype(jnp.float32)
+        v = v_pg.astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kpos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        qrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+        qpos = q_starts_ref[r] + qrow
+        valid = (kpos <= qpos) & (kpos < ctx) & (qrow < q_lens_ref[r])
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        pexp = jnp.where(valid, pexp, 0.0)
+        l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        # in-kernel page write: ONLY the sequence's last row of this
+        # grid (kv_len == w_end) writes, exactly once per page — the
+        # out index map routes every other step to the dump page. The
+        # condition here must mirror `_fused_write_map` bit for bit: a
+        # step whose map picked a real page MUST fully write the block.
+        @pl.when((ctx == w_ends_ref[r]) & (page_start + page_size > ws)
+                 & (q_lens_ref[r] > 0))
+        def _writeback():
+            ko_ref[0, 0] = k_pg.astype(ko_ref.dtype)
+            vo_ref[0, 0] = v_pg.astype(vo_ref.dtype)
+
+    @pl.when(p == num_pages - 1)
+    def _finish():
+        l = l_ref[...]
+        out = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = jnp.where(l > 0.0, out, 0.0).astype(o_ref.dtype)
+
+
+def _quantize_rows(xf):
+    """Per-slot symmetric int8 quantization of ``[page, D]`` f32 rows —
+    bitwise the same math as `quantize_kv_int8` (absmax over D,
+    ``maximum(amax, 1e-8) / 127``), returning the clipped integer
+    values still in f32 (exact in f32; the caller casts to int8 for
+    storage and multiplies by the scale for the dequantized read, which
+    is bit-identical to storing int8 and dequantizing later). The
+    reciprocal multiply (not a divide) matches `quantize_kv_int8`
+    exactly — see the note there."""
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    sc = jnp.maximum(amax, 1e-8) * jnp.float32(1.0 / 127.0)
+    q = jnp.clip(jnp.round(xf / sc), -127.0, 127.0)
+    return q, sc
+
+
+def _fused_kernel_q8(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
+                     w_starts_ref, w_flats_ref, w_ends_ref,
+                     q_ref, k_ref, v_ref, ks_ref, vs_ref, nk_ref, nv_ref,
+                     o_ref, ko_ref, vo_ref, kso_ref, vso_ref,
+                     acc_ref, m_ref, l_ref, *, page_size, group, scale,
+                     pad):
+    """Int8-pool fused variant: fresh rows are quantized IN the kernel
+    (same bits as `_page_write_q8`'s `quantize_kv_int8`), the softmax
+    reads their dequantized values, and the int8 page + scale sidecar
+    write back together."""
+    r = pl.program_id(0)
+    p = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = kv_lens_ref[r]
+    ws = w_starts_ref[r]
+    page_start = p * page_size
+
+    @pl.when(page_start < ctx)
+    def _compute():
+        tpad = nk_ref.shape[1]
+        f0 = jnp.clip(w_flats_ref[r] + page_start - ws + pad, 0,
+                      tpad - page_size)
+        spos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)
+        fresh = (spos >= ws) & (spos < ctx)
+        k_qn, k_scn = _quantize_rows(
+            nk_ref[0, pl.ds(f0, page_size), :].astype(jnp.float32))
+        v_qn, v_scn = _quantize_rows(
+            nv_ref[0, pl.ds(f0, page_size), :].astype(jnp.float32))
+        # dequantized page view: fresh slots read quantize->dequantize
+        # (NOT the raw float) so the fused step is bitwise what the
+        # unfused engine computes after its quantizing scatter
+        k = jnp.where(fresh, k_qn * k_scn,
+                      k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0])
+        v = jnp.where(fresh, v_qn * v_scn,
+                      v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0])
+
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kpos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        qrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+        qpos = q_starts_ref[r] + qrow
+        valid = (kpos <= qpos) & (kpos < ctx) & (qrow < q_lens_ref[r])
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        pexp = jnp.where(valid, pexp, 0.0)
+        l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when((ctx == w_ends_ref[r]) & (page_start + page_size > ws)
+                 & (q_lens_ref[r] > 0))
+        def _writeback():
+            ko_ref[0, 0] = jnp.where(fresh, k_qn.astype(jnp.int8),
+                                     k_ref[0, 0])
+            vo_ref[0, 0] = jnp.where(fresh, v_qn.astype(jnp.int8),
+                                     v_ref[0, 0])
+            kso_ref[0, 0] = jnp.where(fresh, k_scn, ks_ref[0, 0])
+            vso_ref[0, 0] = jnp.where(fresh, v_scn, vs_ref[0, 0])
+
+    @pl.when(p == num_pages - 1)
+    def _finish():
+        l = l_ref[...]
+        out = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = jnp.where(l > 0.0, out, 0.0).astype(o_ref.dtype)
+
+
+def _fused_write_map(page_size, dump_page):
+    """Out-spec index map for the pool write-back: the page the step
+    writes when it IS the sequence's last row and the page overlaps the
+    dispatch's write span ``[w_start, kv_len)``, else ``dump_page``.
+    Must mirror the kernels' ``_writeback`` condition exactly."""
+    def wmap(ri, hi, pi, tables, kv_lens, q_starts, q_lens, w_starts,
+             w_flats, w_ends):
+        ctx = kv_lens[ri]
+        written = (pi * page_size < ctx) \
+            & ((pi + 1) * page_size > w_starts[ri]) \
+            & (ctx == w_ends[ri]) & (q_lens[ri] > 0)
+        return jnp.where(written, tables[ri, pi], dump_page), hi, 0, 0
+
+    return wmap
+
+
+@functools.lru_cache(maxsize=32)
+def _make_fused(scale, page_size, qb, group, tpad, dump_page,
+                interpret):
+    wmap = _fused_write_map(page_size, dump_page)
+
+    def call(q4, k_pages, v_pages, nk, nv, tables, kv_lens, q_starts,
+             q_lens, w_starts, w_flats, w_ends):
+        r, hk, qbg, d = q4.shape
+        max_pages = tables.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=7,
+            grid=(r, hk, max_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, qbg, d),
+                             lambda ri, hi, pi, *refs: (ri, hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                # the dispatch's packed new K/V rows ride whole in VMEM
+                pl.BlockSpec((1, tpad, d),
+                             lambda ri, hi, pi, *refs: (hi, 0, 0)),
+                pl.BlockSpec((1, tpad, d),
+                             lambda ri, hi, pi, *refs: (hi, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, qbg, d),
+                             lambda ri, hi, pi, *refs: (ri, hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d), wmap),
+                pl.BlockSpec((1, 1, page_size, d), wmap),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((qbg, d), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+            ],
+        )
+        return pl.pallas_call(
+            functools.partial(_fused_kernel, page_size=page_size,
+                              group=group, scale=scale, pad=page_size),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((r, hk, qbg, d), q4.dtype),
+                jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+            ],
+            # the pools pass through in place: inputs 0-6 are the
+            # scalar-prefetch operands, 7 is q4, 8/9 the pools
+            input_output_aliases={8: 1, 9: 2},
+            interpret=interpret,
+        )(tables, kv_lens, q_starts, q_lens, w_starts, w_flats, w_ends,
+          q4, k_pages, v_pages, nk, nv)
+
+    return call
+
+
+@functools.lru_cache(maxsize=32)
+def _make_fused_q8(scale, page_size, qb, group, tpad, dump_page,
+                   interpret):
+    # ONE routing map for pages AND scale sidecars: the kernel writes
+    # a page's int8 block and its scale block under the same condition,
+    # so their out-spec routing must be the same closure, not two that
+    # could drift apart
+    wmap = _fused_write_map(page_size, dump_page)
+
+    def call(q4, k_pages, v_pages, k_scale, v_scale, nk, nv, tables,
+             kv_lens, q_starts, q_lens, w_starts, w_flats, w_ends):
+        r, hk, qbg, d = q4.shape
+        max_pages = tables.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=7,
+            grid=(r, hk, max_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, qbg, d),
+                             lambda ri, hi, pi, *refs: (ri, hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, 1),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, 1),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, tpad, d),
+                             lambda ri, hi, pi, *refs: (hi, 0, 0)),
+                pl.BlockSpec((1, tpad, d),
+                             lambda ri, hi, pi, *refs: (hi, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, qbg, d),
+                             lambda ri, hi, pi, *refs: (ri, hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d), wmap),
+                pl.BlockSpec((1, 1, page_size, d), wmap),
+                pl.BlockSpec((1, 1, page_size, 1), wmap),
+                pl.BlockSpec((1, 1, page_size, 1), wmap),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((qbg, d), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+            ],
+        )
+        return pl.pallas_call(
+            functools.partial(_fused_kernel_q8, page_size=page_size,
+                              group=group, scale=scale, pad=page_size),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((r, hk, qbg, d), q4.dtype),
+                jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+                jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+            ],
+            input_output_aliases={8: 1, 9: 2, 10: 3, 11: 4},
+            interpret=interpret,
+        )(tables, kv_lens, q_starts, q_lens, w_starts, w_flats, w_ends,
+          q4, k_pages, v_pages, k_scale, v_scale, nk, nv)
+
+    return call
+
+
+def _pack_new_rows(new, t, page_size, tpad, dtype):
+    """[T, Hk, D] packed rows -> [Hk, tpad, D] head-major with a
+    page_size left pad, so the kernels' clipped affine slice
+    ``pl.ds(w_flat + page_start - w_start + pad, page_size)`` is always
+    in bounds whenever any slot of the page is fresh."""
+    nk = jnp.swapaxes(new.astype(dtype), 0, 1)
+    return jnp.pad(nk, ((0, 0), (page_size, tpad - t - page_size),
+                        (0, 0)))
+
+
+def _fused_impl(q, new_k, new_v, k_pages, v_pages, block_tables,
+                kv_lens, q_starts, q_lens, w_starts, w_flats, w_ends,
+                dump_page, scale):
+    r, qb, h, d = q.shape
+    hk = k_pages.shape[1]
+    group = h // hk
+    page_size = k_pages.shape[2]
+    t = new_k.shape[0]
+    tpad = -(-(t + 2 * page_size) // 8) * 8
+    q4 = q.reshape(r, qb, hk, group, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(r, hk, qb * group, d)
+    # cast packed rows to the POOL dtype before the kernel: a fresh
+    # slot must read exactly what the unfused scatter would have
+    # stored (write-as-pool-dtype, read back) for decode-bitwise parity
+    nk = _pack_new_rows(new_k, t, page_size, tpad, k_pages.dtype)
+    nv = _pack_new_rows(new_v, t, page_size, tpad, v_pages.dtype)
+    call = _make_fused(scale, page_size, qb, group, tpad,
+                       int(dump_page), _interpret())
+    tables = jnp.clip(block_tables.astype(jnp.int32), 0,
+                      k_pages.shape[0] - 1)
+    out, kp, vp = call(q4, k_pages, v_pages, nk, nv, tables,
+                       kv_lens.astype(jnp.int32),
+                       q_starts.astype(jnp.int32),
+                       q_lens.astype(jnp.int32),
+                       w_starts.astype(jnp.int32),
+                       w_flats.astype(jnp.int32),
+                       w_ends.astype(jnp.int32))
+    out = out.reshape(r, hk, qb, group, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(r, qb, h, d)
+    return out, kp, vp
+
+
+def _fused_impl_q8(q, new_k, new_v, k_pages, v_pages, k_scale, v_scale,
+                   block_tables, kv_lens, q_starts, q_lens, w_starts,
+                   w_flats, w_ends, dump_page, scale):
+    r, qb, h, d = q.shape
+    hk = k_pages.shape[1]
+    group = h // hk
+    page_size = k_pages.shape[2]
+    t = new_k.shape[0]
+    tpad = -(-(t + 2 * page_size) // 8) * 8
+    q4 = q.reshape(r, qb, hk, group, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(r, hk, qb * group, d)
+    # f32 packed rows: the in-kernel quantizer consumes exactly what
+    # `quantize_kv_int8` would (x.astype(f32))
+    nk = _pack_new_rows(new_k, t, page_size, tpad, jnp.float32)
+    nv = _pack_new_rows(new_v, t, page_size, tpad, jnp.float32)
+    call = _make_fused_q8(scale, page_size, qb, group, tpad,
+                          int(dump_page), _interpret())
+    tables = jnp.clip(block_tables.astype(jnp.int32), 0,
+                      k_pages.shape[0] - 1)
+    out, kp, vp, ks, vs = call(
+        q4, k_pages, v_pages, k_scale.astype(jnp.float32),
+        v_scale.astype(jnp.float32), nk, nv, tables,
+        kv_lens.astype(jnp.int32), q_starts.astype(jnp.int32),
+        q_lens.astype(jnp.int32), w_starts.astype(jnp.int32),
+        w_flats.astype(jnp.int32), w_ends.astype(jnp.int32))
+    out = out.reshape(r, hk, qb, group, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(r, qb, h, d)
+    return out, kp, vp, ks, vs
+
+
+def fused_ragged_paged_attention(q, new_k, new_v, k_pages, v_pages,
+                                 block_tables, kv_lens, q_starts,
+                                 q_lens, w_starts, w_flats, w_ends,
+                                 dump_page, scale=None, k_scale=None,
+                                 v_scale=None):
+    """Ragged paged attention WITH the KV page write fused in (see
+    module docstring): writes ``new_k/new_v [T, Hk, D]`` — the
+    dispatch's packed post-rope K/V rows — into each row's pages inside
+    the kernel and attends through them, returning
+    ``(out, k_pages, v_pages)`` (plus updated scale sidecars on the q8
+    path). Per-row write metadata: ``w_starts[r]`` is the first
+    position of row r's sequence written by THIS dispatch,
+    ``w_flats[r]`` that position's index on the packed token axis,
+    ``w_ends[r]`` the sequence's final kv_len in this dispatch (so the
+    last row owns the write-back). ``dump_page`` is a page id no live
+    table references; steps with nothing to write dump there and its
+    contents are undefined after the call."""
+    if not fused_supported(q, new_k, new_v, k_pages, v_pages,
+                           block_tables, kv_lens, q_starts, q_lens,
+                           w_starts, w_flats, w_ends, dump_page,
+                           k_scale, v_scale):
+        raise ValueError(
+            "fused_ragged_paged_attention preconditions not met: the "
+            "`ragged_paged_attention` contract, plus new_k/new_v "
+            "[T,Hk,D] (T >= 1), w_starts/w_flats/w_ends [R] and a "
+            "dump_page id inside the pool")
+    d = getattr(q, "_data", q).shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    dp = int(dump_page)
+
+    if k_scale is not None:
+        def fn_q8(q, nk, nv, kp, vp, ks, vs, bt, kl, qs, ql, wss, wfs,
+                  wes):
+            return _fused_impl_q8(q, nk, nv, kp, vp, ks, vs, bt, kl,
+                                  qs, ql, wss, wfs, wes, dp, s)
+
+        return run_op("fused_ragged_paged_attention_q8", fn_q8,
+                      (q, new_k, new_v, k_pages, v_pages, k_scale,
+                       v_scale, block_tables, kv_lens, q_starts,
+                       q_lens, w_starts, w_flats, w_ends),
+                      differentiable=False)
+
+    def fn(q, nk, nv, kp, vp, bt, kl, qs, ql, wss, wfs, wes):
+        return _fused_impl(q, nk, nv, kp, vp, bt, kl, qs, ql, wss, wfs,
+                           wes, dp, s)
+
+    return run_op("fused_ragged_paged_attention", fn,
+                  (q, new_k, new_v, k_pages, v_pages, block_tables,
+                   kv_lens, q_starts, q_lens, w_starts, w_flats,
+                   w_ends), differentiable=False)
+
+
+def fused_ragged_paged_attention_xla(q, new_k, new_v, k_pages, v_pages,
+                                     block_tables, kv_lens, q_starts,
+                                     q_lens, w_starts, w_flats, w_ends,
+                                     dump_page, scale=None,
+                                     k_scale=None, v_scale=None):
+    """Write-THEN-read reference for the fused kernel: scatter every
+    row's packed new K/V rows into the pools (host-built indices, rows
+    applied in order — unambiguous last-writer-wins), then run the
+    plain `ragged_paged_attention_xla` over the updated pools. Two
+    dependent ops with sequential semantics are exactly what the fused
+    kernel's in-grid replay must reproduce; concrete (non-traced)
+    arrays only. Returns the same tuple as the fused kernel. The dump
+    page is untouched here — its contents are undefined in the fused
+    path, so parity checks must exclude it."""
+    import numpy as np
+    from ..inference.paged_cache import quantize_kv_int8
+
+    unwrap = [getattr(a, "_data", a)
+              for a in (q, new_k, new_v, k_pages, v_pages, block_tables,
+                        kv_lens, q_starts, q_lens, w_starts, w_flats)]
+    (q, new_k, new_v, k_pages, v_pages, block_tables, kv_lens,
+     q_starts, q_lens, w_starts, w_flats) = unwrap
+    ps = k_pages.shape[2]
+    tables = np.asarray(jnp.clip(block_tables.astype(jnp.int32), 0,
+                                 k_pages.shape[0] - 1))
+    kv_np = np.asarray(kv_lens)
+    ql_np = np.asarray(q_lens)
+    qs_np = np.asarray(q_starts)
+    ws_np = np.asarray(w_starts)
+    wf_np = np.asarray(w_flats)
+    quant = k_scale is not None
+    if quant:
+        ks = getattr(k_scale, "_data", k_scale).astype(jnp.float32)
+        vs = getattr(v_scale, "_data", v_scale).astype(jnp.float32)
+        qk, sk = quantize_kv_int8(new_k)
+        qv, sv = quantize_kv_int8(new_v)
+    hidx = np.arange(k_pages.shape[1])[None, :]
+    for r in range(q.shape[0]):
+        if ql_np[r] <= 0 or kv_np[r] <= 0:
+            continue
+        start, end = int(qs_np[r]), int(kv_np[r])
+        pos = np.arange(start, end)
+        pages = tables[r, pos // ps]
+        offs = pos % ps
+        f = int(wf_np[r]) + pos - int(ws_np[r])
+        if quant:
+            k_pages = k_pages.at[pages[:, None], hidx,
+                                 offs[:, None]].set(qk[f])
+            v_pages = v_pages.at[pages[:, None], hidx,
+                                 offs[:, None]].set(qv[f])
+            ks = ks.at[pages[:, None], hidx, offs[:, None], 0].set(sk[f])
+            vs = vs.at[pages[:, None], hidx, offs[:, None], 0].set(sv[f])
+        else:
+            k_pages = k_pages.at[pages[:, None], hidx, offs[:, None]] \
+                .set(new_k[f].astype(k_pages.dtype))
+            v_pages = v_pages.at[pages[:, None], hidx, offs[:, None]] \
+                .set(new_v[f].astype(v_pages.dtype))
+    if quant:
+        out = ragged_paged_attention_xla(q, k_pages, v_pages, tables,
+                                         kv_lens, q_starts, q_lens,
+                                         scale=scale, k_scale=ks,
+                                         v_scale=vs)
+        return out, k_pages, v_pages, ks, vs
+    out = ragged_paged_attention_xla(q, k_pages, v_pages, tables,
+                                     kv_lens, q_starts, q_lens,
+                                     scale=scale)
+    return out, k_pages, v_pages
 
 
 def ragged_paged_attention_xla(q, k_pages, v_pages, block_tables,

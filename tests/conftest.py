@@ -35,7 +35,8 @@ _WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
                         "test_cluster", "test_prefix_cache",
                         "test_subprocess_cluster",
                         "test_chunked_scheduler", "test_speculative",
-                        "test_moe_serving", "test_partition_tolerance"}
+                        "test_moe_serving", "test_partition_tolerance",
+                        "test_ragged_attention"}
 
 # per-module budgets where the default is wrong: subprocess-cluster
 # tests legitimately wait out several worker-process startups (import +
@@ -45,6 +46,10 @@ _WEDGE_BUDGETS = {"test_subprocess_cluster": 700.0,
                   # many engines per test (spec/int8 variants of the
                   # mixed program compile per geometry)
                   "test_speculative": 600.0,
+                  # every fused-vs-unfused parity test compiles BOTH
+                  # mixed programs (in-kernel write + scatter+read),
+                  # several times fp/int8/spec per test
+                  "test_chunked_scheduler": 600.0,
                   # the slow chaos soak waits out several subprocess
                   # worker startups under injected rpc loss
                   "test_partition_tolerance": 700.0}

@@ -255,6 +255,245 @@ def test_requeue_pump_reprefills_through_chunks(model):
     engine.close()
 
 
+# ----------------------------------------------------------------------
+# fused in-kernel KV page write (PADDLE_TPU_FUSED_KV): the engine must
+# be byte-for-byte indistinguishable fused vs unfused
+# ----------------------------------------------------------------------
+
+def _pool_state(engine):
+    """(pools, scales, trash) — non-trash page bytes are the cross-path
+    parity surface; the trash page is an explicit dump with undefined
+    contents under fusion."""
+    pools = [np.asarray(p._data) for p in engine.k_pools + engine.v_pools]
+    scales = [np.asarray(s._data)
+              for s in engine.k_scales + engine.v_scales]
+    return pools, scales, engine.trash_page
+
+
+def _assert_same_pools(a, b, scale_rtol=0.0):
+    """`scale_rtol=0` demands bitwise pool equality. Long int8 runs
+    pass a tiny rtol for the SCALE sidecars only: a scale is a pure
+    f32 function of the K/V row being written, and those rows ride
+    through attention outputs that XLA fuses differently in the fused
+    vs unfused programs (different surrounding graphs -> different
+    FMA/fusion picks), so after many speculative steps a handful of
+    scales drift by ~1 ulp while every int8 page byte and every
+    greedy token stays exact — the q8 engine bar, not a write bug."""
+    pools_a, scales_a, trash = a
+    pools_b, scales_b, _ = b
+    live = [i for i in range(pools_a[0].shape[0]) if i != trash]
+    for x, y in zip(pools_a, pools_b):
+        assert np.array_equal(x[live], y[live])
+    for x, y in zip(scales_a, scales_b):
+        if scale_rtol:
+            np.testing.assert_allclose(x[live], y[live],
+                                       rtol=scale_rtol, atol=0.0)
+        else:
+            assert np.array_equal(x[live], y[live])
+
+
+def test_fused_vs_unfused_token_exact_and_pool_bytes(model):
+    """PADDLE_TPU_FUSED_KV=0 must restore the two-op path byte for
+    byte: same greedy tokens AND identical non-trash pool bytes, fp
+    and int8 (int8 scale sidecars included), across multi-chunk
+    prompts and decode steps."""
+    rng = np.random.RandomState(20)
+    v = model.config.vocab_size
+    prompts = [rng.randint(0, v, (n,)).tolist() for n in (30, 5, 12)]
+
+    def run(fused, **kw):
+        e = _engine(model, chunk_block=8, chunk_budget=32,
+                    fused_kv=fused, **kw)
+        out = e.generate(prompts, max_new_tokens=6)
+        state = _pool_state(e)
+        e.close()
+        return out, state
+
+    for kw in ({}, {"kv_dtype": "int8"}):
+        out_f, st_f = run(True, **kw)
+        out_u, st_u = run(False, **kw)
+        assert out_f == out_u
+        _assert_same_pools(st_f, st_u)
+
+
+def test_fused_spec_rollback_pool_bitwise(model):
+    """Acceptance: after a speculative ROLLBACK (garbage drafter, every
+    draft rejected) the fused engine's pool state is bitwise what the
+    unfused path leaves — rejected-draft slots included — and outputs
+    stay token-exact, fp and int8."""
+    rng = np.random.RandomState(21)
+    v = model.config.vocab_size
+    p = rng.randint(0, v, (5,)).tolist()
+
+    class GarbageDrafter:
+        """Proposes fixed wrong tokens: verification rejects them all,
+        exercising rollback every dispatch."""
+        def sync(self, prompt_ids, output_ids):
+            pass
+
+        def propose(self, k):
+            return [1] * k
+
+    for kw in ({}, {"kv_dtype": "int8"}):
+        def run(fused):
+            e = _engine(model, chunk_block=8, chunk_budget=32,
+                        spec_k=3, drafter_factory=GarbageDrafter,
+                        fused_kv=fused, **kw)
+            r = Request(p, max_new_tokens=6)
+            e.add_request(r)
+            while not r.done:
+                e.step()
+            state = _pool_state(e)
+            spec = e.spec_stats()
+            e.close()
+            return r.output_ids, state, spec
+
+        out_f, st_f, spec_f = run(True)
+        out_u, st_u, spec_u = run(False)
+        assert spec_f["proposed"] > 0           # speculation really ran
+        assert spec_f["accepted"] < spec_f["proposed"]  # and rolled back
+        assert spec_f == spec_u
+        assert out_f == out_u
+        if not kw:
+            # fp only: int8 pools legitimately shift greedy tokens vs
+            # the float reference (the quantized read), while staying
+            # deterministic across fused/unfused above
+            assert out_f == _reference_continuation(model, p, 6)
+        _assert_same_pools(st_f, st_u)
+
+
+def test_fused_cow_guard_still_fires(model):
+    """Prefix-cache COW contract under fusion: a shared page is made
+    private BEFORE the in-kernel write lands, the shared original's
+    bytes stay untouched, and outputs match an unshared run."""
+    rng = np.random.RandomState(22)
+    v = model.config.vocab_size
+    p = rng.randint(0, v, (4,)).tolist()
+
+    def run(pin):
+        e = _engine(model, prefix_cache=False)
+        assert e.fused_kv
+        r = Request(p, max_new_tokens=8)
+        e.add_request(r)
+        frozen = None
+        if pin:
+            sid = r.seq_id
+            page0 = e.alloc._tables[sid][0]
+            e.alloc.incref(page0)            # simulate another owner
+            frozen = [np.asarray(pl._data[page0]).copy()
+                      for pl in e.k_pools + e.v_pools]
+        while not r.done:
+            e.step()
+        if pin:
+            assert e.alloc.cow_count >= 1    # guard fired pre-write
+            for pl, want in zip(e.k_pools + e.v_pools, frozen):
+                assert np.array_equal(np.asarray(pl._data[page0]), want)
+            e.alloc.decref(page0)
+        e.close()
+        return r.output_ids
+
+    assert run(pin=True) == run(pin=False)
+
+
+def test_fused_env_knob_and_shape_key(model, monkeypatch):
+    """PADDLE_TPU_FUSED_KV=0 selects the unfused program; the engine
+    shape key forks so prewarm recipes never cross the two engines."""
+    monkeypatch.setenv("PADDLE_TPU_FUSED_KV", "0")
+    e_off = _engine(model)
+    assert e_off.fused_kv is False
+    monkeypatch.delenv("PADDLE_TPU_FUSED_KV")
+    e_on = _engine(model)
+    assert e_on.fused_kv is True             # default on
+    assert e_on._shape_key != e_off._shape_key
+    e_off.close()
+    e_on.close()
+
+
+def test_fused_mixed_hbm_gauge_recorded(model):
+    """Satellite: `serving_mixed_hbm_bytes` carries the mixed program's
+    static cost_analysis bytes after a dispatch (metrics on)."""
+    from paddle_tpu.observability import metrics as om
+
+    if not om.enabled():
+        pytest.skip("PADDLE_TPU_METRICS=0")
+    engine = _engine(model)
+    engine.generate([[1, 2, 3]], max_new_tokens=2)
+    assert engine._mixed_bytes                  # analysis cached
+    assert om.gauge("serving_mixed_hbm_bytes").value > 0
+    engine.close()
+
+
+def test_page_write_last_writer_wins(model):
+    """Regression pin (satellite): a slot written TWICE in one
+    `_page_write_q8` dispatch must land the LAST writer's int8 values
+    AND its scale — XLA scatter's duplicate ordering is implementation-
+    defined, so the op rewrites duplicates to the last value before
+    scattering. `_page_write` pins the same rule."""
+    import jax.numpy as jnp
+    from paddle_tpu.inference.paged_cache import quantize_kv_int8
+    from paddle_tpu.inference.serving import _page_write, _page_write_q8
+
+    rng = np.random.RandomState(23)
+    P, hk, page, d = 4, 2, 8, 16
+    pages = jnp.zeros((P, hk, page, d), jnp.int8)
+    scales = jnp.zeros((P, hk, page, 1), jnp.float32)
+    new = jnp.asarray(rng.randn(5, hk, d), jnp.float32)
+    # tokens 1 and 3 target the SAME slot (page 2, off 4); 3 must win
+    pids = jnp.asarray(np.asarray([0, 2, 1, 2, 3], np.int32))
+    offs = jnp.asarray(np.asarray([0, 4, 2, 4, 7], np.int32))
+    p_out, s_out = _page_write_q8(pages, scales, new, pids, offs)
+    p_out = np.asarray(p_out._data)
+    s_out = np.asarray(s_out._data)
+    want_q, want_s = quantize_kv_int8(new)
+    assert np.array_equal(p_out[2, :, 4, :], np.asarray(want_q)[3])
+    assert np.array_equal(s_out[2, :, 4, 0], np.asarray(want_s)[3])
+    # float path: same last-writer rule
+    fpages = jnp.zeros((P, hk, page, d), jnp.float32)
+    f_out = np.asarray(_page_write(fpages, new, pids, offs)._data)
+    assert np.array_equal(f_out[2, :, 4, :], np.asarray(new)[3])
+    # non-duplicate slots unaffected
+    assert np.array_equal(f_out[1, :, 2, :], np.asarray(new)[2])
+
+
+@pytest.mark.slow
+def test_fused_mixed_workload_e2e(model):
+    """Heavy fused e2e (slow): decode-heavy batch + long prompts +
+    speculation + int8, fused vs unfused — every request token-exact
+    and pool bytes identical at the end."""
+    rng = np.random.RandomState(24)
+    v = model.config.vocab_size
+    prompts = [rng.randint(0, v, (n,)).tolist() for n in (3, 5, 37, 52)]
+
+    def run(fused):
+        e = _engine(model, num_pages=128, chunk_block=8,
+                    chunk_budget=16, spec_k=3, kv_dtype="int8",
+                    fused_kv=fused)
+        reqs = [Request(p, max_new_tokens=12) for p in prompts]
+        for r in reqs[:2]:
+            e.add_request(r)
+        e.decode_many(4)
+        for r in reqs[2:]:
+            e._admit(r)
+        for _ in range(600):
+            if all(r.done for r in reqs):
+                break
+            if not e.step():
+                break
+        outs = [r.output_ids for r in reqs]
+        state = _pool_state(e)
+        e.close()
+        return outs, state
+
+    out_f, st_f = run(True)
+    out_u, st_u = run(False)
+    assert out_f == out_u                # int8+spec: fused == unfused
+    # int8 page bytes bitwise; scale sidecars at f32-ulp tolerance
+    # (see _assert_same_pools — accumulated cross-program fusion noise
+    # over a long speculative run, not a write-path divergence)
+    _assert_same_pools(st_f, st_u, scale_rtol=1e-6)
+    assert all(len(o) == 12 for o in out_f)
+
+
 @pytest.mark.slow
 def test_mixed_workload_e2e_token_exact(model):
     """Acceptance e2e: a decode-heavy batch with long prompts admitted

@@ -183,6 +183,243 @@ def test_supported_rejects_bad_shapes():
         RPA.ragged_paged_attention(qb, kp, vp, tables, ones, ones, ones)
 
 
+# ----------------------------------------------------------------------
+# fused KV page write (fused_ragged_paged_attention): parity against
+# the write-THEN-read XLA reference and the unfused kernel pipeline
+# ----------------------------------------------------------------------
+
+def _fused_case(rng, kp, vp, dump):
+    """A canonical mixed fused batch over pools kp/vp: sequence A as
+    TWO chunk rows of one dispatch (rows 0/1 — the later chunk attends
+    K/V the earlier row wrote in-kernel), sequence B as a decode row
+    (row 2), one inactive row (row 3). Returns (q, new_k, new_v,
+    tables, kv, qs, ql, ws, wf, we)."""
+    P = kp.shape[0]
+    hk, d = kp.shape[1], kp.shape[3]
+    g = 2
+    tables = np.full((4, 3), dump, np.int32)
+    tables[0, :2] = [2, 3]
+    tables[1, :2] = [2, 3]
+    tables[2, :2] = [7, 1]
+    assert P > 8
+    kv = np.array([11, 13, 10, 0], np.int32)   # A: 5 prior + 6 + 2 new
+    qs = np.array([5, 11, 9, 0], np.int32)
+    ql = np.array([6, 2, 1, 0], np.int32)
+    ws = np.array([5, 5, 9, 0], np.int32)      # A's span [5,13), B [9,10)
+    wf = np.array([0, 0, 8, 0], np.int32)      # packed: A at 0..7, B at 8
+    we = np.array([13, 13, 10, 0], np.int32)
+    t = 9
+    new_k = jnp.asarray(rng.randn(t, hk, d), jnp.float32)
+    new_v = jnp.asarray(rng.randn(t, hk, d), jnp.float32)
+    q = jnp.asarray(rng.randn(4, 8, hk * g, d), jnp.float32)
+    return (q, new_k, new_v, jnp.asarray(tables), jnp.asarray(kv),
+            jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(ws),
+            jnp.asarray(wf), jnp.asarray(we))
+
+
+def _unwrap(a):
+    return np.asarray(getattr(a, "_data", a))
+
+
+def test_fused_multi_chunk_parity_and_pool_bytes():
+    """Tentpole contract: the fused kernel must equal the write-then-
+    read reference on EVERY row — including the later chunk of a
+    sequence whose K/V an earlier row of the same grid produced — and
+    must leave the non-dump pages of the pools bitwise identical to
+    the reference's scatter."""
+    rng = np.random.RandomState(10)
+    kp, vp = _pool(rng, num_pages=16)
+    dump = 15
+    case = _fused_case(rng, kp, vp, dump)
+    q, new_k, new_v, tables, kv, qs, ql, ws, wf, we = case
+    out_f, kpf, vpf = RPA.fused_ragged_paged_attention(
+        q, new_k, new_v, kp, vp, tables, kv, qs, ql, ws, wf, we, dump)
+    out_x, kpx, vpx = RPA.fused_ragged_paged_attention_xla(
+        q, new_k, new_v, kp, vp, tables, kv, qs, ql, ws, wf, we, dump)
+    out_f, kpf, vpf = map(_unwrap, (out_f, kpf, vpf))
+    _assert_parity(jnp.asarray(out_f), jnp.asarray(np.asarray(out_x)))
+    live = [i for i in range(16) if i != dump]
+    assert np.array_equal(kpf[live], np.asarray(kpx)[live])
+    assert np.array_equal(vpf[live], np.asarray(vpx)[live])
+    # untouched pages really untouched (0,4..6,8.. were in no table)
+    for pg in (0, 4, 5, 6, 8):
+        assert np.array_equal(kpf[pg], np.asarray(kp)[pg])
+    # inactive row emits defined zeros
+    assert float(np.max(np.abs(out_f[3]))) == 0.0
+
+
+def test_fused_rows_bitwise_vs_unfused_kernel():
+    """Decode rows (and every other row) of the fused kernel must be
+    BITWISE what the unfused pipeline computes — scatter the new rows
+    first, then run the plain Pallas kernel over the updated pools.
+    This is the engine's greedy-token-exact guarantee at kernel
+    level."""
+    rng = np.random.RandomState(11)
+    kp, vp = _pool(rng, num_pages=16)
+    dump = 15
+    q, new_k, new_v, tables, kv, qs, ql, ws, wf, we = \
+        _fused_case(rng, kp, vp, dump)
+    out_f = _unwrap(RPA.fused_ragged_paged_attention(
+        q, new_k, new_v, kp, vp, tables, kv, qs, ql, ws, wf, we,
+        dump)[0])
+    # reference pools via the write-then-read scatter
+    _, kpx, vpx = RPA.fused_ragged_paged_attention_xla(
+        q, new_k, new_v, kp, vp, tables, kv, qs, ql, ws, wf, we, dump)
+    out_u = np.asarray(RPA._ragged_impl(
+        q, jnp.asarray(np.asarray(kpx)), jnp.asarray(np.asarray(vpx)),
+        tables, kv, qs, ql, 1.0 / np.sqrt(q.shape[-1])))
+    assert np.array_equal(out_f, out_u)
+    # decode row named explicitly: the serving engine's decode contract
+    assert np.array_equal(out_f[2], out_u[2])
+
+
+def test_fused_q8_sidecar_bitwise_parity():
+    """Int8 pools: the in-kernel quantizer must land bitwise the same
+    int8 values AND scale sidecars as `_page_write_q8`'s
+    `quantize_kv_int8` (the write-then-read reference uses it), and
+    the fused output must be bitwise the unfused q8 kernel's over the
+    scattered pools."""
+    rng = np.random.RandomState(12)
+    P, hk, page, d = 16, 2, 8, 16
+    base = rng.randn(P, hk, page, d).astype(np.float32)
+    amax = np.maximum(np.max(np.abs(base), -1, keepdims=True), 1e-8)
+    kq = jnp.asarray(np.clip(np.round(base / (amax / 127.0)), -127,
+                             127).astype(np.int8))
+    ks = jnp.asarray((amax / 127.0).astype(np.float32))
+    vq = jnp.asarray(np.roll(np.asarray(kq), 1, axis=0))
+    vs = jnp.asarray(np.roll(np.asarray(ks), 1, axis=0))
+    dump = 15
+    q, new_k, new_v, tables, kv, qs, ql, ws, wf, we = \
+        _fused_case(rng, jnp.asarray(base), jnp.asarray(base), dump)
+    args = (q, new_k, new_v, kq, vq, tables, kv, qs, ql, ws, wf, we,
+            dump)
+    of, kf, vf, ksf, vsf = map(_unwrap, RPA.fused_ragged_paged_attention(
+        *args, k_scale=ks, v_scale=vs))
+    ox, kx, vx, ksx, vsx = map(np.asarray,
+                               RPA.fused_ragged_paged_attention_xla(
+                                   *args, k_scale=ks, v_scale=vs))
+    live = [i for i in range(P) if i != dump]
+    assert np.array_equal(kf[live], kx[live])
+    assert np.array_equal(vf[live], vx[live])
+    assert np.array_equal(ksf[live], ksx[live])      # scales BITWISE
+    assert np.array_equal(vsf[live], vsx[live])
+    out_u = np.asarray(RPA._ragged_impl_q8(
+        q, jnp.asarray(kx), jnp.asarray(vx), jnp.asarray(ksx),
+        jnp.asarray(vsx), tables, kv, qs, ql, 1.0 / np.sqrt(d)))
+    assert np.array_equal(of, out_u)
+
+
+def test_fused_boundary_page_replay_last_writer_wins():
+    """A page straddling two chunk rows of one sequence is written
+    once, by the LAST row, whose replay re-derives the earlier row's
+    slots from the same packed values — so the twice-covered slots are
+    bitwise the single-writer result (the fused path's last-writer-
+    wins pin; `_page_write_q8`'s scatter-side pin lives in
+    test_chunked_scheduler)."""
+    rng = np.random.RandomState(13)
+    kp, vp = _pool(rng, num_pages=16)
+    dump = 15
+    q, new_k, new_v, tables, kv, qs, ql, ws, wf, we = \
+        _fused_case(rng, kp, vp, dump)
+    # page 3 holds positions 8..12: row 0 wrote 8..10, row 1 wrote
+    # 11..12 — row 1's write-back covers the whole page
+    _, kpf, _ = RPA.fused_ragged_paged_attention(
+        q, new_k, new_v, kp, vp, tables, kv, qs, ql, ws, wf, we, dump)
+    kpf = _unwrap(kpf)
+    # expected slots of page 3: positions 8,9,10 from packed rows 3,4,5
+    for slot, f in ((0, 3), (1, 4), (2, 5), (3, 6), (4, 7)):
+        want = np.asarray(new_k)[f].astype(kpf.dtype)   # [Hk, D]
+        assert np.array_equal(kpf[3, :, slot, :], want)
+    # slots past the span keep the original page bytes
+    assert np.array_equal(kpf[3, :, 5:, :], np.asarray(kp)[3, :, 5:, :])
+
+
+def test_fused_empty_prefill_and_empty_decode():
+    """All-decode and all-chunk fused batches both match the
+    reference."""
+    rng = np.random.RandomState(14)
+    kp, vp = _pool(rng, num_pages=32)
+    dump = 31
+    for spec in ([(9, 1), (17, 1), (32, 1)],          # all decode
+                 [(8, 8), (13, 5), (24, 8)]):         # all chunks
+        r = len(spec)
+        kv = np.asarray([k for k, _ in spec], np.int32)
+        ql = np.asarray([q for _, q in spec], np.int32)
+        qs = kv - ql
+        # DISJOINT per-row tables: the engine's allocator guarantees a
+        # writable page belongs to exactly one sequence — _rows' random
+        # ids could alias one row's write span into another row's read
+        # span, which the fused contract explicitly excludes (and the
+        # write-then-read reference would resolve differently)
+        tables = jnp.asarray(
+            rng.permutation(30)[:r * 4].reshape(r, 4).astype(np.int32))
+        kv, qs, ql = (jnp.asarray(a) for a in (kv, qs, ql))
+        t = int(np.asarray(ql).sum())
+        ws, wf = np.asarray(qs, np.int32).copy(), np.concatenate(
+            [[0], np.cumsum(np.asarray(ql))[:-1]]).astype(np.int32)
+        we = np.asarray(kv, np.int32).copy()
+        new_k = jnp.asarray(rng.randn(t, 2, 16), jnp.float32)
+        new_v = jnp.asarray(rng.randn(t, 2, 16), jnp.float32)
+        q = jnp.asarray(rng.randn(r, 8, 4, 16), jnp.float32)
+        out_f = _unwrap(RPA.fused_ragged_paged_attention(
+            q, new_k, new_v, kp, vp, tables, kv, qs, ql,
+            jnp.asarray(ws), jnp.asarray(wf), jnp.asarray(we),
+            dump)[0])
+        out_x, kpx, vpx = RPA.fused_ragged_paged_attention_xla(
+            q, new_k, new_v, kp, vp, tables, kv, qs, ql,
+            jnp.asarray(ws), jnp.asarray(wf), jnp.asarray(we), dump)
+        _assert_parity(jnp.asarray(out_f), jnp.asarray(np.asarray(out_x)))
+
+
+def test_fused_poisoned_table_tails_never_written():
+    """Table tail entries past the context may hold garbage ids: reads
+    clamp (as in the unfused kernel) and the write-back must never
+    touch the page a poisoned tail points at."""
+    rng = np.random.RandomState(15)
+    kp, vp = _pool(rng, num_pages=16)
+    dump = 15
+    q, new_k, new_v, tables, kv, qs, ql, ws, wf, we = \
+        _fused_case(rng, kp, vp, dump)
+    poisoned = np.asarray(tables).copy()
+    poisoned[:, 2:] = 10_000             # way past the pool
+    out_a, kpa, _ = map(_unwrap, RPA.fused_ragged_paged_attention(
+        q, new_k, new_v, kp, vp, tables, kv, qs, ql, ws, wf, we, dump))
+    out_b, kpb, _ = map(_unwrap, RPA.fused_ragged_paged_attention(
+        q, new_k, new_v, kp, vp, jnp.asarray(poisoned), kv, qs, ql,
+        ws, wf, we, dump))
+    assert np.array_equal(out_a, out_b)
+    live = [i for i in range(16) if i != dump]
+    assert np.array_equal(kpa[live], kpb[live])
+
+
+def test_fused_supported_gates():
+    rng = np.random.RandomState(16)
+    kp, vp = _pool(rng)
+    tables = jnp.zeros((2, 4), jnp.int32)
+    ones = jnp.ones((2,), jnp.int32)
+    q = jnp.zeros((2, 4, 4, 16), jnp.float32)
+    nk = jnp.zeros((2, 2, 16), jnp.float32)
+    ok = (q, nk, nk, kp, vp, tables, ones, ones, ones, ones, ones,
+          ones, 31)
+    assert RPA.fused_supported(*ok)
+    # new rows with the wrong head count
+    bad_nk = jnp.zeros((2, 3, 16), jnp.float32)
+    assert not RPA.fused_supported(q, bad_nk, bad_nk, kp, vp, tables,
+                                   ones, ones, ones, ones, ones, ones,
+                                   31)
+    # dump page outside the pool
+    assert not RPA.fused_supported(q, nk, nk, kp, vp, tables, ones,
+                                   ones, ones, ones, ones, ones, 99)
+    # w metadata with the wrong row count
+    assert not RPA.fused_supported(q, nk, nk, kp, vp, tables, ones,
+                                   ones, ones, jnp.ones((3,), jnp.int32),
+                                   ones, ones, 31)
+    with pytest.raises(ValueError):
+        RPA.fused_ragged_paged_attention(q, bad_nk, bad_nk, kp, vp,
+                                         tables, ones, ones, ones,
+                                         ones, ones, ones, 31)
+
+
 def test_table_tail_garbage_is_clamped():
     """Unused table tail entries may hold anything — including ids past
     the pool — without observable effect (they are clamped before the
