@@ -134,7 +134,9 @@ from ..observability import flight_recorder as _fr
 from ..observability import metrics as _om
 from ..observability.trace import span as _span
 from ..ops.ragged_paged_attention import (fused_ragged_paged_attention,
-                                          ragged_paged_attention)
+                                          fused_rope_geometry_ok,
+                                          ragged_paged_attention,
+                                          rope_tables)
 from ..testing import faults as _faults
 from .paged_cache import PageAllocator, quantize_kv_int8
 from .sampling import SamplingParams, sampled_next_tokens
@@ -514,7 +516,8 @@ class LlamaServingEngine:
                  stuck_min_timeout=30.0, prefix_cache=True,
                  prefix_cache_pages=None, prewarm=None, kv_dtype=None,
                  spec_k=None, spec_ngram=3, drafter_factory=None,
-                 sampling=None, sample_slots=8, fused_kv=None):
+                 sampling=None, sample_slots=8, fused_kv=None,
+                 fused_rope=None):
         if num_pages is None:
             num_pages = max_batch * 24 + 8
         self.model = model
@@ -633,6 +636,23 @@ class LlamaServingEngine:
                 "PADDLE_TPU_FUSED_KV", "1").lower() \
                 not in ("0", "false", "off")
         self.fused_kv = bool(fused_kv)
+        # fused rotary embedding (ROADMAP item 2, second stage): the
+        # mixed program feeds PRE-rope packed q/k straight into the
+        # rope-fused kernel — rope happens in VMEM next to the page
+        # write and attention, deleting the per-layer rope elementwise
+        # op (2 HBM round trips per layer) AND the per-layer host-side
+        # q row-block gather. Requires the fused KV write (the rope
+        # rides its replay metadata); PADDLE_TPU_FUSED_ROPE=0 restores
+        # the PR-13 fused-KV path byte for byte. Geometry the rope
+        # kernel can't serve (odd head_dim, Pallas unavailable)
+        # demotes to the fused-KV path instead of crashing or crawling
+        # through an unsupported interpret lowering.
+        if fused_rope is None:
+            fused_rope = os.environ.get(
+                "PADDLE_TPU_FUSED_ROPE", "1").lower() \
+                not in ("0", "false", "off")
+        self.fused_rope = bool(fused_rope) and self.fused_kv \
+            and fused_rope_geometry_ok(cfg.head_dim)
         # per-request sampling (ROADMAP item 4): the mixed program
         # grows a vectorized per-row sample step next to the argmax —
         # every sampler knob is runtime data ([R]-shaped arrays), so
@@ -904,6 +924,24 @@ class LlamaServingEngine:
     # ------------------------------------------------------------------
     # the mixed program: prefill chunks + decode rows, one dispatch
     # ------------------------------------------------------------------
+    def _rope_tables(self, pos):
+        """Per-dispatch rotary sin/cos tables ``[T, D]`` f32, one row
+        per packed token — computed ONCE per dispatch (inside the
+        traced program, from the packed positions) and shared across
+        every layer. Bitwise the values
+        `fused_rotary_position_embedding` derives from
+        ``position_ids``, so swapping the per-layer derivation for
+        this shared table never moves an output bit."""
+        cfg = self.model.config
+        d = cfg.head_dim
+        base = float(cfg.rope_theta)
+
+        def fn(p):
+            return rope_tables(p, d, base)
+
+        return run_op("serving_rope_tables", fn, (pos,),
+                      differentiable=False)
+
     def _mixed_forward(self, tokens, pos, page_ids, offs, row_tok,
                        flat_idx, last_idx, tables, kv_lens, q_starts,
                        q_lens, w_starts, w_flats, w_ends, temps, top_ps,
@@ -948,6 +986,18 @@ class LlamaServingEngine:
         ``offs`` still enter the program for the unfused path (and are
         inert, never touched, under fusion).
 
+        With ``fused_rope`` on top (the default when ``fused_kv`` is
+        on) the separate rope op disappears too: the kernel takes
+        PRE-rope q (still packed ``[T, H, D]`` — no host-side
+        ``_token_gather`` pack; each row's tokens are contiguous at
+        its write offset, so the kernel slices them via the
+        scalar-prefetched metadata) and pre-rope packed k, plus
+        per-dispatch sin/cos tables computed once and shared across
+        all layers, and applies the rotation in VMEM before the
+        write/attention math — rope + write + attention in one Pallas
+        program, bitwise the fallback chain. ``row_tok`` stays an
+        input for the fallback paths (inert under rope fusion).
+
         tokens/pos [1, T]; page_ids/offs/flat_idx [T]; row_tok [R, QB];
         last_idx/kv_lens/q_starts/q_lens/w_starts/w_flats/w_ends/
         temps/top_ps/top_ks/seeds/cmodes [R]; slot_ids/slot_vals
@@ -962,8 +1012,15 @@ class LlamaServingEngine:
         cfg = self.model.config
         t = tokens.shape[1]
         r_rows, qb = row_tok.shape[0], row_tok.shape[1]
-        pos64 = pos.astype("int64")
         x = m.embed_tokens(tokens)                       # [1, T, H]
+        # per-dispatch rotary sin/cos tables [T, D], computed ONCE and
+        # shared by every layer: the rope-fused kernel consumes them
+        # directly (no transcendentals in-kernel — Mosaic and XLA then
+        # agree bit for bit), and the fallback paths feed them to
+        # fused_rotary_position_embedding via sin=/cos= instead of
+        # re-deriving the trig tables from the positions in every
+        # layer (2 x n_layers redundant elementwise chains per trace)
+        rsin, rcos = self._rope_tables(pos)
         new_k, new_v, new_ks, new_vs = [], [], [], []
         for li, layer in enumerate(m.layers):
             h = layer.input_layernorm(x)
@@ -973,11 +1030,46 @@ class LlamaServingEngine:
                                        att.head_dim])
             v = att.v_proj(h).reshape([1, t, att.num_kv_heads,
                                        att.head_dim])
-            q, k, v = FI.fused_rotary_position_embedding(
-                q, k, v, position_ids=pos64,
-                rotary_emb_base=cfg.rope_theta)
+            if not self.fused_rope:
+                # fallback paths apply rope as a separate elementwise
+                # op, from the shared per-dispatch tables
+                q, k, v = FI.fused_rotary_position_embedding(
+                    q, k, v, sin=rsin, cos=rcos)
             k2 = k.reshape([t, att.num_kv_heads, att.head_dim])
             v2 = v.reshape([t, att.num_kv_heads, att.head_dim])
+            if self.fused_rope:
+                # rope + page write + attention in ONE kernel: q stays
+                # PRE-rope in the packed token layout — the kernel
+                # slices each row's contiguous tokens through the
+                # scalar-prefetched write metadata, so the host-side
+                # _token_gather q pack is gone along with the
+                # per-layer rope round trip for q AND k
+                q3 = q.reshape([t, att.num_heads, att.head_dim])
+                if self.kv_quant:
+                    attn4, kp, vp, ksc, vsc = \
+                        fused_ragged_paged_attention(
+                            q3, k2, v2, k_pools[li], v_pools[li],
+                            tables, kv_lens, q_starts, q_lens,
+                            w_starts, w_flats, w_ends, self.trash_page,
+                            k_scale=k_scales[li],
+                            v_scale=v_scales[li], rope_sin=rsin,
+                            rope_cos=rcos, qblock=qb)
+                    new_ks.append(ksc)
+                    new_vs.append(vsc)
+                else:
+                    attn4, kp, vp = fused_ragged_paged_attention(
+                        q3, k2, v2, k_pools[li], v_pools[li], tables,
+                        kv_lens, q_starts, q_lens, w_starts, w_flats,
+                        w_ends, self.trash_page, rope_sin=rsin,
+                        rope_cos=rcos, qblock=qb)
+                new_k.append(kp)
+                new_v.append(vp)
+                attn = _token_gather(
+                    attn4.reshape([r_rows * qb, att.num_heads,
+                                   att.head_dim]), flat_idx)
+                x = x + att.o_proj(attn.reshape([1, t, -1]))
+                x = x + layer.mlp(layer.post_attention_layernorm(x))
+                continue
             # pack the flat token axis into the kernel's [R, QB] row
             # blocks
             q4 = _token_gather(
@@ -1685,8 +1777,10 @@ class LlamaServingEngine:
                  bool(self.sample_enabled), self.sample_slots,
                  # fused vs unfused engines compile different mixed
                  # programs (in-kernel write vs scatter + read): a
-                 # prewarm recipe must never cross the two
-                 bool(self.fused_kv))
+                 # prewarm recipe must never cross the two; same for
+                 # the rope-fused program (pre-rope packed operands +
+                 # in-kernel rotation vs the separate rope op)
+                 bool(self.fused_kv), bool(self.fused_rope))
         return "llama:" + hashlib.sha1(
             repr(parts).encode()).hexdigest()[:16]
 

@@ -94,6 +94,29 @@ pools agree bit for bit.
 reference on purpose: two dependent XLA ops have unambiguous
 sequential semantics, which is what the fused kernel's replay must be
 proven against (`fused_ragged_paged_attention_xla` composes them).
+
+Fused rotary embedding (ROADMAP item 2, second stage): passing
+``rope_sin``/``rope_cos`` — per-dispatch ``[T, D]`` f32 tables, one row
+per PACKED token (``sin(pos * inv_freq)`` with the neox duplicated-half
+layout, computed ONCE per dispatch and shared by every layer) — makes
+the fused kernel consume PRE-rope operands: ``q`` arrives in the packed
+token layout ``[T, H, D]`` (no host-side row-block gather; each row's
+query tokens sit contiguously on the packed axis at
+``w_flat[r] + q_start[r] - w_start[r]``, the same affine replay index
+the KV overlay already uses, so the kernel slices them with the
+scalar-prefetched metadata) and ``new_k`` is the pre-rope packed K.
+The kernel applies the rotation in VMEM — ``x * cos +
+rotate_half(x) * sin`` in f32, cast back to the model dtype — before
+the write/attention math, with bitwise the same value chain as the
+unfused ``fused_rotary_position_embedding`` + scatter pipeline: the
+transcendentals live in the XLA-computed tables, so the kernel adds
+only IEEE-exact multiplies/adds and greedy outputs and pool bytes stay
+bitwise across all three paths (rope-fused / PR-13 fused-KV /
+two-op). ``qblock`` (the row-block width the caller's metadata was
+built for) becomes an explicit argument because packed q no longer
+carries it. This deletes the per-layer rope elementwise op (2 HBM
+round trips per layer: q and k) and the per-layer q gather from the
+mixed program.
 """
 
 from __future__ import annotations
@@ -116,7 +139,8 @@ from ..framework.tensor import run_op
 
 __all__ = ["ragged_paged_attention", "ragged_paged_attention_xla",
            "supported", "fused_ragged_paged_attention",
-           "fused_ragged_paged_attention_xla", "fused_supported"]
+           "fused_ragged_paged_attention_xla", "fused_supported",
+           "fused_rope_geometry_ok", "rope_tables"]
 
 NEG_INF = -1e30
 
@@ -158,6 +182,49 @@ def supported(q, k_pages, v_pages, block_tables, kv_lens, q_starts,
     return True
 
 
+def _softmax_accumulate(q, k, v, page_start, q_start, q_len, ctx,
+                        group, acc_ref, m_ref, l_ref):
+    """ONE page step of the shared online-softmax update: causal/
+    ragged masking, running max/sum rescale, accumulator update. Every
+    kernel in this module calls exactly this body — the engine's
+    cross-path bitwise parity contract requires the accumulation math
+    to be maintained in ONE place, never per-kernel copies. ``q``
+    ``[QB*G, D]`` is pre-scaled f32; ``k``/``v`` ``[page, D]`` f32."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    kpos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    # query rows are laid out [QB, G] flattened (qi major): the
+    # token index of softmax row i is i // G
+    qrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+    qpos = q_start + qrow
+    valid = (kpos <= qpos) & (kpos < ctx) & (qrow < q_len)
+    s = jnp.where(valid, s, NEG_INF)
+    m_prev, l_prev = m_ref[...], l_ref[...]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    pexp = jnp.exp(s - m_new)
+    # fully-masked softmax rows (a padded query, or a page entirely
+    # behind this query's causal horizon) must contribute nothing:
+    # with finite NEG_INF, exp(s - m_new) would be exp(0) = 1 when
+    # m_new is still NEG_INF, silently polluting l and acc
+    pexp = jnp.where(valid, pexp, 0.0)
+    l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+    m_ref[...] = m_new
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        pexp, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _softmax_finish(o_ref, acc_ref, l_ref):
+    """Emit the normalized accumulator on the last page step. l == 0:
+    inactive row (kv_len 0) or padded query row — emit zeros, never
+    NaN."""
+    l = l_ref[...]
+    out = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
+    o_ref[0, 0] = jnp.where(l > 0.0, out, 0.0).astype(o_ref.dtype)
+
+
 def _ragged_kernel(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
                    q_ref, k_ref, v_ref, o_ref,
                    acc_ref, m_ref, l_ref, *, page_size, group, scale):
@@ -179,38 +246,13 @@ def _ragged_kernel(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
         q = q_ref[0, 0].astype(jnp.float32) * scale      # [QB*G, D]
         k = k_ref[0, 0].astype(jnp.float32)              # [page, D]
         v = v_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        kpos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        # query rows are laid out [QB, G] flattened (qi major): the
-        # token index of softmax row i is i // G
-        qrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
-        qpos = q_starts_ref[r] + qrow
-        valid = (kpos <= qpos) & (kpos < ctx) & (qrow < q_lens_ref[r])
-        s = jnp.where(valid, s, NEG_INF)
-        m_prev, l_prev = m_ref[...], l_ref[...]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        pexp = jnp.exp(s - m_new)
-        # fully-masked softmax rows (a padded query, or a page entirely
-        # behind this query's causal horizon) must contribute nothing:
-        # with finite NEG_INF, exp(s - m_new) would be exp(0) = 1 when
-        # m_new is still NEG_INF, silently polluting l and acc
-        pexp = jnp.where(valid, pexp, 0.0)
-        l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
-        m_ref[...] = m_new
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            pexp, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _softmax_accumulate(q, k, v, page_start, q_starts_ref[r],
+                            q_lens_ref[r], ctx, group, acc_ref, m_ref,
+                            l_ref)
 
     @pl.when(p == num_pages - 1)
     def _finish():
-        l = l_ref[...]
-        # l == 0: inactive row (kv_len 0) or padded query row — emit
-        # zeros, never NaN
-        out = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
-        o_ref[0, 0] = jnp.where(l > 0.0, out, 0.0).astype(o_ref.dtype)
+        _softmax_finish(o_ref, acc_ref, l_ref)
 
 
 def _ragged_kernel_q8(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
@@ -239,30 +281,13 @@ def _ragged_kernel_q8(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
         # dequantize the page in VMEM: [page, D] int8 * [page, 1] f32
         k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]
         v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        kpos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        qrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
-        qpos = q_starts_ref[r] + qrow
-        valid = (kpos <= qpos) & (kpos < ctx) & (qrow < q_lens_ref[r])
-        s = jnp.where(valid, s, NEG_INF)
-        m_prev, l_prev = m_ref[...], l_ref[...]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        pexp = jnp.exp(s - m_new)
-        pexp = jnp.where(valid, pexp, 0.0)
-        l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
-        m_ref[...] = m_new
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            pexp, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _softmax_accumulate(q, k, v, page_start, q_starts_ref[r],
+                            q_lens_ref[r], ctx, group, acc_ref, m_ref,
+                            l_ref)
 
     @pl.when(p == num_pages - 1)
     def _finish():
-        l = l_ref[...]
-        out = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
-        o_ref[0, 0] = jnp.where(l > 0.0, out, 0.0).astype(o_ref.dtype)
+        _softmax_finish(o_ref, acc_ref, l_ref)
 
 
 @functools.lru_cache(maxsize=32)
@@ -431,15 +456,73 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
 # see the module docstring for the replay/ordering contract.
 # ----------------------------------------------------------------------
 
+def fused_rope_geometry_ok(head_dim):
+    """Cheap static gate for the rope-fused kernel: Pallas must be
+    importable and the head_dim even (the neox rotation splits it in
+    half). The serving engine consults this at construction and
+    demotes ``fused_rope`` to the PR-13 fused-KV path (never a crash,
+    never an interpret-mode crawl through an unsupported lowering)
+    when it fails."""
+    return _HAS_PLTPU and head_dim % 2 == 0 and head_dim >= 2
+
+
+def rope_tables(pos, head_dim, base):
+    """Per-dispatch rotary sin/cos tables, one row per PACKED token:
+    ``[T, D]`` f32 with the neox duplicated-half layout (``emb =
+    concat([ang, ang])``). Bitwise the same values
+    `fused_rotary_position_embedding` derives from ``position_ids`` —
+    the single source of the angle formula, computed ONCE per dispatch
+    and shared by every layer (fused kernel and unfused fallback
+    alike). ``pos`` is any integer array; it is flattened to ``[T]``.
+    Pure jnp — safe under jit/trace."""
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                     dtype=jnp.float32) / head_dim))
+    ang = pos.reshape(-1).astype(jnp.float32)[:, None] * inv  # [T, D/2]
+    emb = jnp.concatenate([ang, ang], axis=-1)                # [T, D]
+    return jnp.sin(emb), jnp.cos(emb)
+
+
 def fused_supported(q, new_k, new_v, k_pages, v_pages, block_tables,
                     kv_lens, q_starts, q_lens, w_starts, w_flats,
-                    w_ends, dump_page, k_scale=None, v_scale=None):
+                    w_ends, dump_page, k_scale=None, v_scale=None,
+                    rope_sin=None, rope_cos=None, qblock=None):
     """Preconditions of the fused kernel: everything `supported`
     checks, plus packed new-row operands ``new_k/new_v [T, Hk, D]``
     (T >= 1), per-row write metadata ``w_starts/w_flats/w_ends [R]``
     and a valid ``dump_page`` id (a page no live table references —
     grid steps with nothing to write dump their page-sized output
-    there)."""
+    there). With ``rope_sin``/``rope_cos`` (the rope-fused variant) q
+    switches to the packed pre-rope ``[T, H, D]`` layout, the tables
+    must be ``[T, D]`` and ``qblock`` (the row-block width) must be
+    given explicitly."""
+    if (rope_sin is None) != (rope_cos is None):
+        return False
+    if rope_sin is not None:
+        qs = getattr(q, "_data", q).shape
+        nk = getattr(new_k, "_data", new_k)
+        bt = getattr(block_tables, "_data", block_tables)
+        if len(qs) != 3 or len(nk.shape) != 3 or len(bt.shape) != 2:
+            return False
+        t, h, d = (int(x) for x in qs)
+        if qblock is None or int(qblock) < 1 or t != nk.shape[0]:
+            return False
+        if not fused_rope_geometry_ok(d):
+            return False
+        want = (t, d)
+        for tb in (rope_sin, rope_cos):
+            if tuple(getattr(tb, "_data", tb).shape) != want:
+                return False
+        hk = getattr(k_pages, "_data", k_pages).shape[1]
+        if hk == 0 or h % hk:
+            return False
+        # remaining checks ride the non-rope validation with a
+        # shape-only proxy for the row-blocked q the metadata implies
+        proxy = jax.ShapeDtypeStruct((bt.shape[0], int(qblock), h, d),
+                                     jnp.float32)
+        return fused_supported(proxy, new_k, new_v, k_pages, v_pages,
+                               block_tables, kv_lens, q_starts, q_lens,
+                               w_starts, w_flats, w_ends, dump_page,
+                               k_scale, v_scale)
     if not supported(q, k_pages, v_pages, block_tables, kv_lens,
                      q_starts, q_lens, k_scale, v_scale):
         return False
@@ -503,26 +586,10 @@ def _fused_kernel(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
                          v_ref[0, 0])
 
         q = q_ref[0, 0].astype(jnp.float32) * scale      # [QB*G, D]
-        k = k_pg.astype(jnp.float32)
-        v = v_pg.astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        kpos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        qrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
-        qpos = q_starts_ref[r] + qrow
-        valid = (kpos <= qpos) & (kpos < ctx) & (qrow < q_lens_ref[r])
-        s = jnp.where(valid, s, NEG_INF)
-        m_prev, l_prev = m_ref[...], l_ref[...]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        pexp = jnp.exp(s - m_new)
-        pexp = jnp.where(valid, pexp, 0.0)
-        l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
-        m_ref[...] = m_new
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            pexp, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _softmax_accumulate(q, k_pg.astype(jnp.float32),
+                            v_pg.astype(jnp.float32), page_start,
+                            q_starts_ref[r], q_lens_ref[r], ctx, group,
+                            acc_ref, m_ref, l_ref)
 
         # in-kernel page write: ONLY the sequence's last row of this
         # grid (kv_len == w_end) writes, exactly once per page — the
@@ -537,9 +604,7 @@ def _fused_kernel(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
 
     @pl.when(p == num_pages - 1)
     def _finish():
-        l = l_ref[...]
-        out = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
-        o_ref[0, 0] = jnp.where(l > 0.0, out, 0.0).astype(o_ref.dtype)
+        _softmax_finish(o_ref, acc_ref, l_ref)
 
 
 def _quantize_rows(xf):
@@ -602,24 +667,9 @@ def _fused_kernel_q8(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
                       v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0])
 
         q = q_ref[0, 0].astype(jnp.float32) * scale
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        kpos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        qrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
-        qpos = q_starts_ref[r] + qrow
-        valid = (kpos <= qpos) & (kpos < ctx) & (qrow < q_lens_ref[r])
-        s = jnp.where(valid, s, NEG_INF)
-        m_prev, l_prev = m_ref[...], l_ref[...]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        pexp = jnp.exp(s - m_new)
-        pexp = jnp.where(valid, pexp, 0.0)
-        l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
-        m_ref[...] = m_new
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            pexp, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _softmax_accumulate(q, k, v, page_start, q_starts_ref[r],
+                            q_lens_ref[r], ctx, group, acc_ref, m_ref,
+                            l_ref)
 
         @pl.when((ctx == w_ends_ref[r]) & (page_start + page_size > ws)
                  & (q_lens_ref[r] > 0))
@@ -633,9 +683,184 @@ def _fused_kernel_q8(tables_ref, kv_lens_ref, q_starts_ref, q_lens_ref,
 
     @pl.when(p == num_pages - 1)
     def _finish():
-        l = l_ref[...]
-        out = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
-        o_ref[0, 0] = jnp.where(l > 0.0, out, 0.0).astype(o_ref.dtype)
+        _softmax_finish(o_ref, acc_ref, l_ref)
+
+
+def _rot_half(x):
+    """``rotate_half`` on the last (head_dim) axis — same values as
+    `incubate.nn.functional._rotate_half` (neox pairing)."""
+    h = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., h:], x[..., :h]], axis=-1)
+
+
+def _rope_k_page(nk_ref, sin_ref, cos_ref, f0, page_size):
+    """Rope one replay slice of the packed pre-rope K rows: the SAME
+    ``f0`` offset picks the rows and their positions' sin/cos (the
+    tables are padded identically), and the rotated rows cast back
+    through the MODEL dtype — exactly `_apply_rope`'s output. Shared
+    by the fp and q8 rope kernels so the parity-critical rotation
+    chain lives in one place (like `_softmax_accumulate`)."""
+    sin_k = sin_ref[pl.ds(f0, page_size), :]
+    cos_k = cos_ref[pl.ds(f0, page_size), :]
+    k_new = nk_ref[0, pl.ds(f0, page_size), :].astype(jnp.float32)
+    return (k_new * cos_k + _rot_half(k_new) * sin_k) \
+        .astype(nk_ref.dtype)
+
+
+def _rope_q_block(q_ref, sin_ref, cos_ref, q_starts_ref, w_starts_ref,
+                  w_flats_ref, r, pad, qblock, group, scale):
+    """Load + rope + scale one row's query block from the packed
+    pre-rope q: the row's tokens sit contiguously on the packed axis
+    at ``w_flat + (q_start - w_start)`` — the same affine replay index
+    the KV overlay uses, read with the already-prefetched scalars
+    (this is what deletes the host-side ``_token_gather`` q pack).
+    Returns the scaled f32 ``[QB*G, D]`` block the softmax consumes;
+    called ONCE per (row, kv-head) — the result lives in VMEM scratch
+    across the page loop."""
+    tpad = q_ref.shape[1]
+    f0q = jnp.clip(w_flats_ref[r] + q_starts_ref[r] - w_starts_ref[r]
+                   + pad, 0, tpad - qblock)
+    qv = q_ref[0, pl.ds(f0q, qblock), :, :].astype(jnp.float32)
+    sin_q = sin_ref[pl.ds(f0q, qblock), :][:, None, :]
+    cos_q = cos_ref[pl.ds(f0q, qblock), :][:, None, :]
+    q_rot = (qv * cos_q + _rot_half(qv) * sin_q) \
+        .astype(q_ref.dtype)                          # [QB, G, D]
+    return q_rot.reshape(qblock * group, qv.shape[-1]) \
+        .astype(jnp.float32) * scale                  # [QB*G, D]
+
+
+def _fused_rope_kernel(tables_ref, kv_lens_ref, q_starts_ref,
+                       q_lens_ref, w_starts_ref, w_flats_ref,
+                       w_ends_ref, q_ref, k_ref, v_ref, nk_ref, nv_ref,
+                       sin_ref, cos_ref, o_ref, ko_ref, vo_ref,
+                       acc_ref, m_ref, l_ref, q_s, *, page_size, group,
+                       scale, pad, qblock):
+    """Rope-fused variant of `_fused_kernel`: q and new_k arrive
+    PRE-rope in packed layouts (q ``[Hk, tpad, G, D]`` head-major,
+    new_k ``[Hk, tpad, D]`` in the MODEL dtype), the sin/cos tables
+    ride whole in VMEM aligned to the same padded packed axis, and the
+    rotation — ``x * cos + rotate_half(x) * sin`` in f32, cast back to
+    the model dtype — happens here, feeding bitwise the same values
+    into the write/attention math the post-rope kernel would have been
+    handed. No transcendentals in-kernel: the tables carry them, so
+    Mosaic and XLA compute identical bits. The roped q block is
+    computed ONCE per (row, kv-head) into the ``q_s`` scratch — it
+    depends only on the row, never on the page step."""
+    r = pl.program_id(0)
+    p = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        q_s[...] = _rope_q_block(q_ref, sin_ref, cos_ref, q_starts_ref,
+                                 w_starts_ref, w_flats_ref, r, pad,
+                                 qblock, group, scale)
+
+    ctx = kv_lens_ref[r]
+    ws = w_starts_ref[r]
+    page_start = p * page_size
+
+    @pl.when(page_start < ctx)
+    def _compute():
+        tpad = nk_ref.shape[1]
+        f0 = jnp.clip(w_flats_ref[r] + page_start - ws + pad, 0,
+                      tpad - page_size)
+        spos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)
+        fresh = (spos >= ws) & (spos < ctx)
+        # rope the fresh K rows in VMEM (shared chain: `_rope_k_page`),
+        # then cast on to the pool dtype, matching what the unfused
+        # scatter would have stored
+        k_rot = _rope_k_page(nk_ref, sin_ref, cos_ref, f0, page_size)
+        k_pg = jnp.where(fresh, k_rot.astype(ko_ref.dtype), k_ref[0, 0])
+        v_pg = jnp.where(fresh, nv_ref[0, pl.ds(f0, page_size), :],
+                         v_ref[0, 0])
+
+        _softmax_accumulate(q_s[...], k_pg.astype(jnp.float32),
+                            v_pg.astype(jnp.float32), page_start,
+                            q_starts_ref[r], q_lens_ref[r], ctx, group,
+                            acc_ref, m_ref, l_ref)
+
+        @pl.when((ctx == w_ends_ref[r]) & (page_start + page_size > ws)
+                 & (q_lens_ref[r] > 0))
+        def _writeback():
+            ko_ref[0, 0] = k_pg
+            vo_ref[0, 0] = v_pg
+
+    @pl.when(p == num_pages - 1)
+    def _finish():
+        _softmax_finish(o_ref, acc_ref, l_ref)
+
+
+def _fused_rope_kernel_q8(tables_ref, kv_lens_ref, q_starts_ref,
+                          q_lens_ref, w_starts_ref, w_flats_ref,
+                          w_ends_ref, q_ref, k_ref, v_ref, ks_ref,
+                          vs_ref, nk_ref, nv_ref, sin_ref, cos_ref,
+                          o_ref, ko_ref, vo_ref, kso_ref, vso_ref,
+                          acc_ref, m_ref, l_ref, q_s, *, page_size,
+                          group, scale, pad, qblock):
+    """Int8-pool rope-fused variant: rope the fresh rows (as in
+    `_fused_rope_kernel`, incl. the model-dtype round trip), THEN
+    quantize them in-kernel with bitwise `quantize_kv_int8` math —
+    the quantizer consumes exactly what the unfused engine's
+    post-rope `_page_write_q8` would."""
+    r = pl.program_id(0)
+    p = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        q_s[...] = _rope_q_block(q_ref, sin_ref, cos_ref, q_starts_ref,
+                                 w_starts_ref, w_flats_ref, r, pad,
+                                 qblock, group, scale)
+
+    ctx = kv_lens_ref[r]
+    ws = w_starts_ref[r]
+    page_start = p * page_size
+
+    @pl.when(page_start < ctx)
+    def _compute():
+        tpad = nk_ref.shape[1]
+        f0 = jnp.clip(w_flats_ref[r] + page_start - ws + pad, 0,
+                      tpad - page_size)
+        spos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)
+        fresh = (spos >= ws) & (spos < ctx)
+        # shared rotation chain, then the exact f32 widening the
+        # unfused engine's post-rope quantizer consumes
+        k_rot = _rope_k_page(nk_ref, sin_ref, cos_ref, f0, page_size) \
+            .astype(jnp.float32)
+        k_qn, k_scn = _quantize_rows(k_rot)
+        v_qn, v_scn = _quantize_rows(
+            nv_ref[0, pl.ds(f0, page_size), :].astype(jnp.float32))
+        k = jnp.where(fresh, k_qn * k_scn,
+                      k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0])
+        v = jnp.where(fresh, v_qn * v_scn,
+                      v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0])
+
+        _softmax_accumulate(q_s[...], k, v, page_start,
+                            q_starts_ref[r], q_lens_ref[r], ctx, group,
+                            acc_ref, m_ref, l_ref)
+
+        @pl.when((ctx == w_ends_ref[r]) & (page_start + page_size > ws)
+                 & (q_lens_ref[r] > 0))
+        def _writeback():
+            ko_ref[0, 0] = jnp.where(fresh, k_qn.astype(jnp.int8),
+                                     k_ref[0, 0])
+            vo_ref[0, 0] = jnp.where(fresh, v_qn.astype(jnp.int8),
+                                     v_ref[0, 0])
+            kso_ref[0, 0] = jnp.where(fresh, k_scn, ks_ref[0, 0])
+            vso_ref[0, 0] = jnp.where(fresh, v_scn, vs_ref[0, 0])
+
+    @pl.when(p == num_pages - 1)
+    def _finish():
+        _softmax_finish(o_ref, acc_ref, l_ref)
 
 
 def _fused_write_map(page_size, dump_page):
@@ -781,6 +1006,151 @@ def _make_fused_q8(scale, page_size, qb, group, tpad, dump_page,
     return call
 
 
+@functools.lru_cache(maxsize=32)
+def _make_fused_rope(scale, page_size, qblock, group, tpad, dump_page,
+                     interpret):
+    wmap = _fused_write_map(page_size, dump_page)
+
+    def call(qp, k_pages, v_pages, nk, nv, sin, cos, tables, kv_lens,
+             q_starts, q_lens, w_starts, w_flats, w_ends):
+        hk, _, g, d = qp.shape
+        r = tables.shape[0]
+        qbg = qblock * group
+        max_pages = tables.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=7,
+            grid=(r, hk, max_pages),
+            in_specs=[
+                # pre-rope packed q rides whole, head-major, per kv-head
+                pl.BlockSpec((1, tpad, g, d),
+                             lambda ri, hi, pi, *refs: (hi, 0, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, tpad, d),
+                             lambda ri, hi, pi, *refs: (hi, 0, 0)),
+                pl.BlockSpec((1, tpad, d),
+                             lambda ri, hi, pi, *refs: (hi, 0, 0)),
+                # the per-dispatch sin/cos tables are position-aligned
+                # to the SAME padded packed axis and shared by every
+                # grid step (constant index map -> fetched once)
+                pl.BlockSpec((tpad, d),
+                             lambda ri, hi, pi, *refs: (0, 0)),
+                pl.BlockSpec((tpad, d),
+                             lambda ri, hi, pi, *refs: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, qbg, d),
+                             lambda ri, hi, pi, *refs: (ri, hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d), wmap),
+                pl.BlockSpec((1, 1, page_size, d), wmap),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((qbg, d), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+                # the row's roped+scaled q block, computed once per
+                # (row, kv-head) and reused across the page loop
+                pltpu.VMEM((qbg, d), jnp.float32),
+            ],
+        )
+        return pl.pallas_call(
+            functools.partial(_fused_rope_kernel, page_size=page_size,
+                              group=group, scale=scale, pad=page_size,
+                              qblock=qblock),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((r, hk, qbg, d), qp.dtype),
+                jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+            ],
+            # inputs 0-6 scalar prefetch, 7 packed q, 8/9 the pools
+            input_output_aliases={8: 1, 9: 2},
+            interpret=interpret,
+        )(tables, kv_lens, q_starts, q_lens, w_starts, w_flats, w_ends,
+          qp, k_pages, v_pages, nk, nv, sin, cos)
+
+    return call
+
+
+@functools.lru_cache(maxsize=32)
+def _make_fused_rope_q8(scale, page_size, qblock, group, tpad,
+                        dump_page, interpret):
+    wmap = _fused_write_map(page_size, dump_page)
+
+    def call(qp, k_pages, v_pages, k_scale, v_scale, nk, nv, sin, cos,
+             tables, kv_lens, q_starts, q_lens, w_starts, w_flats,
+             w_ends):
+        hk, _, g, d = qp.shape
+        r = tables.shape[0]
+        qbg = qblock * group
+        max_pages = tables.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=7,
+            grid=(r, hk, max_pages),
+            in_specs=[
+                pl.BlockSpec((1, tpad, g, d),
+                             lambda ri, hi, pi, *refs: (hi, 0, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, 1),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, 1),
+                             lambda ri, hi, pi, tables, *refs:
+                             (tables[ri, pi], hi, 0, 0)),
+                pl.BlockSpec((1, tpad, d),
+                             lambda ri, hi, pi, *refs: (hi, 0, 0)),
+                pl.BlockSpec((1, tpad, d),
+                             lambda ri, hi, pi, *refs: (hi, 0, 0)),
+                pl.BlockSpec((tpad, d),
+                             lambda ri, hi, pi, *refs: (0, 0)),
+                pl.BlockSpec((tpad, d),
+                             lambda ri, hi, pi, *refs: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, qbg, d),
+                             lambda ri, hi, pi, *refs: (ri, hi, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d), wmap),
+                pl.BlockSpec((1, 1, page_size, d), wmap),
+                pl.BlockSpec((1, 1, page_size, 1), wmap),
+                pl.BlockSpec((1, 1, page_size, 1), wmap),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((qbg, d), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+                pltpu.VMEM((qbg, 1), jnp.float32),
+                pltpu.VMEM((qbg, d), jnp.float32),
+            ],
+        )
+        return pl.pallas_call(
+            functools.partial(_fused_rope_kernel_q8,
+                              page_size=page_size, group=group,
+                              scale=scale, pad=page_size,
+                              qblock=qblock),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((r, hk, qbg, d), qp.dtype),
+                jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+                jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+            ],
+            input_output_aliases={8: 1, 9: 2, 10: 3, 11: 4},
+            interpret=interpret,
+        )(tables, kv_lens, q_starts, q_lens, w_starts, w_flats, w_ends,
+          qp, k_pages, v_pages, k_scale, v_scale, nk, nv, sin, cos)
+
+    return call
+
+
 def _pack_new_rows(new, t, page_size, tpad, dtype):
     """[T, Hk, D] packed rows -> [Hk, tpad, D] head-major with a
     page_size left pad, so the kernels' clipped affine slice
@@ -853,11 +1223,106 @@ def _fused_impl_q8(q, new_k, new_v, k_pages, v_pages, k_scale, v_scale,
     return out, kp, vp, ks, vs
 
 
+def _pack_new_q(q, t, group, page_size, tpad):
+    """Pre-rope packed q ``[T, H, D]`` -> ``[Hk, tpad, G, D]``
+    head-major with the same page_size left pad as `_pack_new_rows`,
+    so one affine offset addresses q rows, K/V rows and the sin/cos
+    tables alike."""
+    hk = q.shape[1] // group
+    d = q.shape[-1]
+    q4 = q.reshape(t, hk, group, d).transpose(1, 0, 2, 3)
+    return jnp.pad(q4, ((0, 0), (page_size, tpad - t - page_size),
+                        (0, 0), (0, 0)))
+
+
+def _pack_rope_table(tb, t, page_size, tpad):
+    return jnp.pad(tb.astype(jnp.float32),
+                   ((page_size, tpad - t - page_size), (0, 0)))
+
+
+def _rope_tpad(t, page_size, qblock):
+    """Padded packed-axis length for the rope-fused kernel: the left
+    pad is page_size (as in `_pack_new_rows`) and the right pad must
+    cover BOTH the page-sized K replay slice and the qblock-sized q
+    slice starting at the last packed token."""
+    return -(-(t + page_size + max(page_size, qblock)) // 8) * 8
+
+
+def _fused_rope_impl(q, new_k, new_v, k_pages, v_pages, block_tables,
+                     kv_lens, q_starts, q_lens, w_starts, w_flats,
+                     w_ends, rope_sin, rope_cos, dump_page, scale,
+                     qblock):
+    t, h, d = q.shape
+    hk = k_pages.shape[1]
+    group = h // hk
+    page_size = k_pages.shape[2]
+    r = block_tables.shape[0]
+    tpad = _rope_tpad(t, page_size, qblock)
+    # q and new_k stay in the MODEL dtype: the kernel ropes them in
+    # f32 and casts back through the model dtype (the `_apply_rope`
+    # output) before the pool-dtype store — new_v needs no rope and
+    # pre-casts to the pool dtype exactly like the post-rope kernel
+    qp = _pack_new_q(q, t, group, page_size, tpad)
+    nk = _pack_new_rows(new_k, t, page_size, tpad, new_k.dtype)
+    nv = _pack_new_rows(new_v, t, page_size, tpad, v_pages.dtype)
+    sin = _pack_rope_table(rope_sin, t, page_size, tpad)
+    cos = _pack_rope_table(rope_cos, t, page_size, tpad)
+    call = _make_fused_rope(scale, page_size, qblock, group, tpad,
+                            int(dump_page), _interpret())
+    tables = jnp.clip(block_tables.astype(jnp.int32), 0,
+                      k_pages.shape[0] - 1)
+    out, kp, vp = call(qp, k_pages, v_pages, nk, nv, sin, cos, tables,
+                       kv_lens.astype(jnp.int32),
+                       q_starts.astype(jnp.int32),
+                       q_lens.astype(jnp.int32),
+                       w_starts.astype(jnp.int32),
+                       w_flats.astype(jnp.int32),
+                       w_ends.astype(jnp.int32))
+    out = out.reshape(r, hk, qblock, group, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(r, qblock, h, d)
+    return out, kp, vp
+
+
+def _fused_rope_impl_q8(q, new_k, new_v, k_pages, v_pages, k_scale,
+                        v_scale, block_tables, kv_lens, q_starts,
+                        q_lens, w_starts, w_flats, w_ends, rope_sin,
+                        rope_cos, dump_page, scale, qblock):
+    t, h, d = q.shape
+    hk = k_pages.shape[1]
+    group = h // hk
+    page_size = k_pages.shape[2]
+    r = block_tables.shape[0]
+    tpad = _rope_tpad(t, page_size, qblock)
+    # both packed rows keep the MODEL dtype: the kernel ropes k, round
+    # trips through the model dtype and widens to f32 for the bitwise
+    # `quantize_kv_int8` math (an exact widening — identical to the
+    # post-rope kernel's f32 pack)
+    qp = _pack_new_q(q, t, group, page_size, tpad)
+    nk = _pack_new_rows(new_k, t, page_size, tpad, new_k.dtype)
+    nv = _pack_new_rows(new_v, t, page_size, tpad, new_v.dtype)
+    sin = _pack_rope_table(rope_sin, t, page_size, tpad)
+    cos = _pack_rope_table(rope_cos, t, page_size, tpad)
+    call = _make_fused_rope_q8(scale, page_size, qblock, group, tpad,
+                               int(dump_page), _interpret())
+    tables = jnp.clip(block_tables.astype(jnp.int32), 0,
+                      k_pages.shape[0] - 1)
+    out, kp, vp, ks, vs = call(
+        qp, k_pages, v_pages, k_scale.astype(jnp.float32),
+        v_scale.astype(jnp.float32), nk, nv, sin, cos, tables,
+        kv_lens.astype(jnp.int32), q_starts.astype(jnp.int32),
+        q_lens.astype(jnp.int32), w_starts.astype(jnp.int32),
+        w_flats.astype(jnp.int32), w_ends.astype(jnp.int32))
+    out = out.reshape(r, hk, qblock, group, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(r, qblock, h, d)
+    return out, kp, vp, ks, vs
+
+
 def fused_ragged_paged_attention(q, new_k, new_v, k_pages, v_pages,
                                  block_tables, kv_lens, q_starts,
                                  q_lens, w_starts, w_flats, w_ends,
                                  dump_page, scale=None, k_scale=None,
-                                 v_scale=None):
+                                 v_scale=None, rope_sin=None,
+                                 rope_cos=None, qblock=None):
     """Ragged paged attention WITH the KV page write fused in (see
     module docstring): writes ``new_k/new_v [T, Hk, D]`` — the
     dispatch's packed post-rope K/V rows — into each row's pages inside
@@ -869,19 +1334,61 @@ def fused_ragged_paged_attention(q, new_k, new_v, k_pages, v_pages,
     ``w_ends[r]`` the sequence's final kv_len in this dispatch (so the
     last row owns the write-back). ``dump_page`` is a page id no live
     table references; steps with nothing to write dump there and its
-    contents are undefined after the call."""
+    contents are undefined after the call.
+
+    With ``rope_sin``/``rope_cos`` (per-dispatch ``[T, D]`` f32 tables
+    from :func:`rope_tables`) the call is the ROPE-FUSED variant:
+    ``q`` arrives PRE-rope in the packed ``[T, H, D]`` token layout
+    (the kernel slices each row's contiguous tokens via the write
+    metadata — no host-side row-block gather), ``new_k`` is the
+    pre-rope packed K, and the rotation happens in VMEM before the
+    write/attention math, bitwise the unfused
+    `fused_rotary_position_embedding` chain. ``qblock`` (the row-block
+    width the metadata was built for) is required, and the returned
+    attention output keeps the ``[R, qblock, H, D]`` row-block
+    layout."""
     if not fused_supported(q, new_k, new_v, k_pages, v_pages,
                            block_tables, kv_lens, q_starts, q_lens,
                            w_starts, w_flats, w_ends, dump_page,
-                           k_scale, v_scale):
+                           k_scale, v_scale, rope_sin, rope_cos,
+                           qblock):
         raise ValueError(
             "fused_ragged_paged_attention preconditions not met: the "
             "`ragged_paged_attention` contract, plus new_k/new_v "
             "[T,Hk,D] (T >= 1), w_starts/w_flats/w_ends [R] and a "
-            "dump_page id inside the pool")
+            "dump_page id inside the pool; the rope-fused variant "
+            "additionally needs packed q [T,H,D], rope_sin/rope_cos "
+            "[T,D] and an explicit qblock >= 1")
     d = getattr(q, "_data", q).shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     dp = int(dump_page)
+
+    if rope_sin is not None:
+        qb = int(qblock)
+        if k_scale is not None:
+            def fn_rope_q8(q, nk, nv, kp, vp, ks, vs, bt, kl, qs, ql,
+                           wss, wfs, wes, rs, rc):
+                return _fused_rope_impl_q8(q, nk, nv, kp, vp, ks, vs,
+                                           bt, kl, qs, ql, wss, wfs,
+                                           wes, rs, rc, dp, s, qb)
+
+            return run_op("fused_rope_ragged_paged_attention_q8",
+                          fn_rope_q8,
+                          (q, new_k, new_v, k_pages, v_pages, k_scale,
+                           v_scale, block_tables, kv_lens, q_starts,
+                           q_lens, w_starts, w_flats, w_ends, rope_sin,
+                           rope_cos), differentiable=False)
+
+        def fn_rope(q, nk, nv, kp, vp, bt, kl, qs, ql, wss, wfs, wes,
+                    rs, rc):
+            return _fused_rope_impl(q, nk, nv, kp, vp, bt, kl, qs, ql,
+                                    wss, wfs, wes, rs, rc, dp, s, qb)
+
+        return run_op("fused_rope_ragged_paged_attention", fn_rope,
+                      (q, new_k, new_v, k_pages, v_pages, block_tables,
+                       kv_lens, q_starts, q_lens, w_starts, w_flats,
+                       w_ends, rope_sin, rope_cos),
+                      differentiable=False)
 
     if k_scale is not None:
         def fn_q8(q, nk, nv, kp, vp, ks, vs, bt, kl, qs, ql, wss, wfs,
@@ -909,7 +1416,9 @@ def fused_ragged_paged_attention_xla(q, new_k, new_v, k_pages, v_pages,
                                      block_tables, kv_lens, q_starts,
                                      q_lens, w_starts, w_flats, w_ends,
                                      dump_page, scale=None,
-                                     k_scale=None, v_scale=None):
+                                     k_scale=None, v_scale=None,
+                                     rope_sin=None, rope_cos=None,
+                                     qblock=None):
     """Write-THEN-read reference for the fused kernel: scatter every
     row's packed new K/V rows into the pools (host-built indices, rows
     applied in order — unambiguous last-writer-wins), then run the
@@ -918,7 +1427,13 @@ def fused_ragged_paged_attention_xla(q, new_k, new_v, k_pages, v_pages,
     kernel's in-grid replay must reproduce; concrete (non-traced)
     arrays only. Returns the same tuple as the fused kernel. The dump
     page is untouched here — its contents are undefined in the fused
-    path, so parity checks must exclude it."""
+    path, so parity checks must exclude it.
+
+    With ``rope_sin``/``rope_cos`` this is the ROPE-then-write-then-
+    read reference: apply the table-driven rotation to the packed
+    pre-rope ``q [T, H, D]`` and ``new_k`` first (the unfused
+    `_apply_rope` chain, bit for bit), gather q into ``[R, qblock]``
+    row blocks via the write metadata, then proceed as above."""
     import numpy as np
     from ..inference.paged_cache import quantize_kv_int8
 
@@ -927,6 +1442,39 @@ def fused_ragged_paged_attention_xla(q, new_k, new_v, k_pages, v_pages,
                         kv_lens, q_starts, q_lens, w_starts, w_flats)]
     (q, new_k, new_v, k_pages, v_pages, block_tables, kv_lens,
      q_starts, q_lens, w_starts, w_flats) = unwrap
+    if rope_sin is not None:
+        sin = jnp.asarray(getattr(rope_sin, "_data", rope_sin),
+                          jnp.float32)
+        cos = jnp.asarray(getattr(rope_cos, "_data", rope_cos),
+                          jnp.float32)
+
+        @jax.jit
+        def _rope(x):                       # [T, heads, D], table [T, D]
+            # jitted ON PURPOSE: XLA contracts the mul+add chain into
+            # an FMA under jit but not in eager dispatch (1-ulp
+            # difference), and the Pallas kernel this reference is
+            # proven against always runs as a jitted computation
+            xf = x.astype(jnp.float32)
+            out = xf * cos[:, None, :] + _rot_half(xf) * sin[:, None, :]
+            return out.astype(x.dtype)
+
+        q_rot = np.asarray(_rope(q))
+        new_k = _rope(new_k)
+        # pack the roped q into the row blocks the metadata implies:
+        # row r's tokens sit at packed [w_flat + q_start - w_start, +n)
+        r_rows = block_tables.shape[0]
+        qb = int(qblock)
+        qr = np.zeros((r_rows, qb) + q_rot.shape[1:], q_rot.dtype)
+        ql_np = np.asarray(q_lens)
+        for i in range(r_rows):
+            n = int(ql_np[i])
+            if n <= 0:
+                continue
+            f0 = int(np.asarray(w_flats)[i]) \
+                + int(np.asarray(q_starts)[i]) \
+                - int(np.asarray(w_starts)[i])
+            qr[i, :n] = q_rot[f0:f0 + n]
+        q = jnp.asarray(qr)
     ps = k_pages.shape[2]
     tables = np.asarray(jnp.clip(block_tables.astype(jnp.int32), 0,
                                  k_pages.shape[0] - 1))

@@ -48,8 +48,14 @@ _WEDGE_BUDGETS = {"test_subprocess_cluster": 700.0,
                   "test_speculative": 600.0,
                   # every fused-vs-unfused parity test compiles BOTH
                   # mixed programs (in-kernel write + scatter+read),
-                  # several times fp/int8/spec per test
-                  "test_chunked_scheduler": 600.0,
+                  # several times fp/int8/spec per test — and the
+                  # rope ladder tests compile THREE (rope-fused /
+                  # fused-KV / two-op)
+                  "test_chunked_scheduler": 700.0,
+                  # the fused-rope parity suite compiles both the
+                  # rope-fused and the post-rope Pallas programs per
+                  # case (fp + q8)
+                  "test_ragged_attention": 600.0,
                   # the slow chaos soak waits out several subprocess
                   # worker startups under injected rpc loss
                   "test_partition_tolerance": 700.0}

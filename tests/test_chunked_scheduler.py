@@ -423,6 +423,214 @@ def test_fused_mixed_hbm_gauge_recorded(model):
     engine.close()
 
 
+# ----------------------------------------------------------------------
+# fused rope (PADDLE_TPU_FUSED_ROPE): rope + write + attention in one
+# Pallas program — the engine must be byte-for-byte indistinguishable
+# from the PR-13 fused-KV path and the fully-unfused path
+# ----------------------------------------------------------------------
+
+def test_fused_rope_env_knob_and_shape_key(model, monkeypatch):
+    """PADDLE_TPU_FUSED_ROPE=0 restores the PR-13 fused-KV program;
+    the shape key forks on the flag; rope fusion requires the fused KV
+    write (PADDLE_TPU_FUSED_KV=0 reaches the original two-op path,
+    rope knob notwithstanding)."""
+    monkeypatch.setenv("PADDLE_TPU_FUSED_ROPE", "0")
+    e_off = _engine(model)
+    assert e_off.fused_kv is True and e_off.fused_rope is False
+    monkeypatch.delenv("PADDLE_TPU_FUSED_ROPE")
+    e_on = _engine(model)
+    assert e_on.fused_rope is True               # default on
+    assert e_on._shape_key != e_off._shape_key
+    # no rope fusion without the fused KV write it rides on
+    e_u = _engine(model, fused_kv=False)
+    assert e_u.fused_rope is False
+    assert len({e_on._shape_key, e_off._shape_key, e_u._shape_key}) == 3
+    for e in (e_off, e_on, e_u):
+        e.close()
+
+
+def test_fused_rope_vs_pr13_vs_unfused_token_exact_and_pools(model):
+    """The three-program ladder (rope-fused / fused-KV / two-op) must
+    agree token-exactly with identical non-trash pool bytes, fp and
+    int8 (scale sidecars included), across multi-chunk prompts and
+    decode steps — including the SAME-prompt multi-chunk replay inside
+    one dispatch (the 30-token prompt spans 4 chunk rows of a single
+    32-token budget)."""
+    rng = np.random.RandomState(40)
+    v = model.config.vocab_size
+    prompts = [rng.randint(0, v, (n,)).tolist() for n in (30, 5, 12)]
+
+    def run(**kw):
+        e = _engine(model, chunk_block=8, chunk_budget=32, **kw)
+        out = e.generate(prompts, max_new_tokens=6)
+        state = _pool_state(e)
+        e.close()
+        return out, state
+
+    for kw in ({}, {"kv_dtype": "int8"}):
+        out_r, st_r = run(**kw)                       # rope-fused
+        out_f, st_f = run(fused_rope=False, **kw)     # PR-13
+        out_u, st_u = run(fused_kv=False, **kw)       # two-op
+        assert out_r == out_f == out_u
+        _assert_same_pools(st_r, st_f)
+        _assert_same_pools(st_f, st_u)
+    # and the fp outputs match the model's own reference continuation
+    want = [_reference_continuation(model, p, 6) for p in prompts]
+    assert run()[0] == want
+
+
+def test_fused_rope_decode_scan_matches_reference(model):
+    """The decode scan carry under rope fusion: a long scanned decode
+    run (decode_many -> lax.scan ticks, per-tick rope tables from the
+    length carry) stays token-exact vs the reference and vs the
+    PR-13 path."""
+    rng = np.random.RandomState(41)
+    v = model.config.vocab_size
+    p = rng.randint(0, v, (5,)).tolist()
+
+    def run(fused_rope):
+        e = _engine(model, decode_ticks=8, fused_rope=fused_rope)
+        r = Request(p, max_new_tokens=20)
+        e.add_request(r)
+        e.decode_many(20)
+        out = list(r.output_ids)
+        e.close()
+        return out
+
+    want = _reference_continuation(model, p, 20)
+    assert run(True) == want
+    assert run(False) == want
+
+
+def test_fused_rope_spec_rollback_pool_bitwise(model):
+    """Speculative ROLLBACK under rope fusion: rejected-draft slots
+    included, pools bitwise vs the PR-13 path, outputs token-exact,
+    fp and int8."""
+    rng = np.random.RandomState(42)
+    v = model.config.vocab_size
+    p = rng.randint(0, v, (5,)).tolist()
+
+    class GarbageDrafter:
+        def sync(self, prompt_ids, output_ids):
+            pass
+
+        def propose(self, k):
+            return [1] * k
+
+    for kw in ({}, {"kv_dtype": "int8"}):
+        def run(fused_rope):
+            e = _engine(model, chunk_block=8, chunk_budget=32,
+                        spec_k=3, drafter_factory=GarbageDrafter,
+                        fused_rope=fused_rope, **kw)
+            r = Request(p, max_new_tokens=6)
+            e.add_request(r)
+            while not r.done:
+                e.step()
+            state = _pool_state(e)
+            spec = e.spec_stats()
+            e.close()
+            return r.output_ids, state, spec
+
+        out_r, st_r, spec_r = run(True)
+        out_f, st_f, spec_f = run(False)
+        assert spec_r["proposed"] > 0
+        assert spec_r["accepted"] < spec_r["proposed"]
+        assert spec_r == spec_f
+        assert out_r == out_f
+        _assert_same_pools(st_r, st_f)
+
+
+def test_fused_rope_cow_guard_still_fires(model):
+    """Prefix-cache COW contract under rope fusion: the shared page
+    goes private BEFORE the in-kernel write, the original's bytes stay
+    frozen, outputs match an unshared run."""
+    rng = np.random.RandomState(43)
+    v = model.config.vocab_size
+    p = rng.randint(0, v, (4,)).tolist()
+
+    def run(pin):
+        e = _engine(model, prefix_cache=False)
+        assert e.fused_rope
+        r = Request(p, max_new_tokens=8)
+        e.add_request(r)
+        frozen = None
+        if pin:
+            sid = r.seq_id
+            page0 = e.alloc._tables[sid][0]
+            e.alloc.incref(page0)
+            frozen = [np.asarray(pl._data[page0]).copy()
+                      for pl in e.k_pools + e.v_pools]
+        while not r.done:
+            e.step()
+        if pin:
+            assert e.alloc.cow_count >= 1
+            for pl, want in zip(e.k_pools + e.v_pools, frozen):
+                assert np.array_equal(np.asarray(pl._data[page0]), want)
+            e.alloc.decref(page0)
+        e.close()
+        return r.output_ids
+
+    assert run(pin=True) == run(pin=False)
+
+
+def test_fused_rope_same_prompt_multi_chunk_replay(model):
+    """Multi-chunk same-prompt replay under rope fusion: the same
+    prompt pushed through tight budgets (several dispatches) and a
+    wide budget (all chunks in ONE dispatch, later chunks attending
+    K/V that earlier rows of the same grid roped AND wrote) must agree
+    with each other and the reference."""
+    rng = np.random.RandomState(44)
+    v = model.config.vocab_size
+    p = rng.randint(0, v, (41,)).tolist()
+    want = _reference_continuation(model, p, 5)
+
+    def run(**kw):
+        e = _engine(model, **kw)
+        assert e.fused_rope
+        out = e.generate([p], max_new_tokens=5)[0]
+        e.close()
+        return out
+
+    assert run(chunk_block=8, chunk_budget=16) == want
+    assert run(chunk_block=8, chunk_budget=48) == want
+
+
+@pytest.mark.slow
+def test_fused_rope_mixed_workload_e2e(model):
+    """Heavy rope-fused e2e (slow): decode-heavy batch + long prompts
+    + speculation + int8, rope-fused vs PR-13 — token-exact, int8 page
+    bytes bitwise, scales at the f32-ulp bar."""
+    rng = np.random.RandomState(45)
+    v = model.config.vocab_size
+    prompts = [rng.randint(0, v, (n,)).tolist() for n in (3, 5, 37, 52)]
+
+    def run(fused_rope):
+        e = _engine(model, num_pages=128, chunk_block=8,
+                    chunk_budget=16, spec_k=3, kv_dtype="int8",
+                    fused_rope=fused_rope)
+        reqs = [Request(p, max_new_tokens=12) for p in prompts]
+        for r in reqs[:2]:
+            e.add_request(r)
+        e.decode_many(4)
+        for r in reqs[2:]:
+            e._admit(r)
+        for _ in range(600):
+            if all(r.done for r in reqs):
+                break
+            if not e.step():
+                break
+        outs = [r.output_ids for r in reqs]
+        state = _pool_state(e)
+        e.close()
+        return outs, state
+
+    out_r, st_r = run(True)
+    out_f, st_f = run(False)
+    assert out_r == out_f
+    _assert_same_pools(st_r, st_f, scale_rtol=1e-6)
+    assert all(len(o) == 12 for o in out_r)
+
+
 def test_page_write_last_writer_wins(model):
     """Regression pin (satellite): a slot written TWICE in one
     `_page_write_q8` dispatch must land the LAST writer's int8 values

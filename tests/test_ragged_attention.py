@@ -420,6 +420,240 @@ def test_fused_supported_gates():
                                          ones, ones, ones, 31)
 
 
+# ----------------------------------------------------------------------
+# fused rope (rope_sin/rope_cos): rope + write + attention in one
+# kernel, proven against the rope-THEN-write-THEN-read reference and
+# bitwise against the PR-13 post-rope pipeline
+# ----------------------------------------------------------------------
+
+def _packed_positions(qs, ql):
+    return np.concatenate(
+        [np.arange(int(s), int(s) + int(n))
+         for s, n in zip(np.asarray(qs), np.asarray(ql))]) \
+        .astype(np.int32)
+
+
+def _rope_jitted(x, sin, cos):
+    """The unfused `_apply_rope` chain, JITTED — XLA contracts the
+    mul+add into an FMA under jit (1 ulp off eager), and every path
+    under test runs as a jitted computation."""
+    import functools
+
+    @functools.partial(jax.jit, static_argnums=())
+    def f(x, sin, cos):
+        xf = x.astype(jnp.float32)
+        h = xf.shape[-1] // 2
+        rot = jnp.concatenate([-xf[..., h:], xf[..., :h]], -1)
+        out = xf * cos[:, None, :] + rot * sin[:, None, :]
+        return out.astype(x.dtype)
+
+    return np.asarray(f(x, sin, cos))
+
+
+def _rope_case(rng, kp, vp, dump, qb=8):
+    """The `_fused_case` geometry with PRE-rope packed q [T, H, D] and
+    per-dispatch sin/cos tables at the rows' (arbitrary, non-zero-
+    based) positions."""
+    q, new_k, new_v, tables, kv, qs, ql, ws, wf, we = \
+        _fused_case(rng, kp, vp, dump)
+    t = int(np.asarray(ql).sum())
+    h = q.shape[2]
+    d = q.shape[3]
+    q_packed = jnp.asarray(rng.randn(t, h, d), jnp.float32)
+    pos = _packed_positions(qs, ql)
+    sin, cos = RPA.rope_tables(jnp.asarray(pos), d, 10000.0)
+    return (q_packed, new_k, new_v, tables, kv, qs, ql, ws, wf, we,
+            sin, cos, qb)
+
+
+def test_fused_rope_matches_rope_then_write_then_read():
+    """Tentpole contract: the rope-fused kernel equals the rope-then-
+    scatter-then-read XLA reference at arbitrary non-contiguous
+    positions — outputs to float rounding, written pool bytes
+    BITWISE."""
+    rng = np.random.RandomState(30)
+    kp, vp = _pool(rng, num_pages=16)
+    dump = 15
+    (q_packed, new_k, new_v, tables, kv, qs, ql, ws, wf, we, sin, cos,
+     qb) = _rope_case(rng, kp, vp, dump)
+    args = (q_packed, new_k, new_v, kp, vp, tables, kv, qs, ql, ws,
+            wf, we, dump)
+    out_f, kpf, vpf = map(_unwrap, RPA.fused_ragged_paged_attention(
+        *args, rope_sin=sin, rope_cos=cos, qblock=qb))
+    out_x, kpx, vpx = map(np.asarray,
+                          RPA.fused_ragged_paged_attention_xla(
+                              *args, rope_sin=sin, rope_cos=cos,
+                              qblock=qb))
+    _assert_parity(jnp.asarray(out_f), jnp.asarray(out_x))
+    live = [i for i in range(16) if i != dump]
+    assert np.array_equal(kpf[live], kpx[live])
+    assert np.array_equal(vpf[live], vpx[live])
+    # inactive row still emits defined zeros
+    assert float(np.max(np.abs(out_f[3]))) == 0.0
+
+
+def test_fused_rope_bitwise_vs_post_rope_kernel():
+    """Given identical rope bits (the jitted table chain), the rope-
+    fused kernel must produce BITWISE the PR-13 fused kernel's outputs
+    and pools — the in-kernel rotation adds only IEEE-exact ops. This
+    is the engine's fused_rope=0 byte-for-byte fallback at kernel
+    level, decode rows included."""
+    rng = np.random.RandomState(31)
+    kp, vp = _pool(rng, num_pages=16)
+    dump = 15
+    (q_packed, new_k, new_v, tables, kv, qs, ql, ws, wf, we, sin, cos,
+     qb) = _rope_case(rng, kp, vp, dump)
+    out_f, kpf, vpf = map(_unwrap, RPA.fused_ragged_paged_attention(
+        q_packed, new_k, new_v, kp, vp, tables, kv, qs, ql, ws, wf,
+        we, dump, rope_sin=sin, rope_cos=cos, qblock=qb))
+    # manual rope + row-block pack, then the post-rope fused kernel
+    q_rot = _rope_jitted(q_packed, np.asarray(sin), np.asarray(cos))
+    k_rot = jnp.asarray(_rope_jitted(new_k, np.asarray(sin),
+                                     np.asarray(cos)))
+    r = tables.shape[0]
+    qr = np.zeros((r, qb) + q_rot.shape[1:], q_rot.dtype)
+    off = 0
+    for i in range(r):
+        n = int(np.asarray(ql)[i])
+        qr[i, :n] = q_rot[off:off + n]
+        off += n
+    out_13, kp13, vp13 = map(_unwrap, RPA.fused_ragged_paged_attention(
+        jnp.asarray(qr), k_rot, new_v, kp, vp, tables, kv, qs, ql, ws,
+        wf, we, dump))
+    assert np.array_equal(out_f, out_13)
+    live = [i for i in range(16) if i != dump]
+    assert np.array_equal(kpf[live], kp13[live])
+    assert np.array_equal(vpf[live], vp13[live])
+    # the decode row (row 2) named explicitly: serving decode contract
+    assert np.array_equal(out_f[2], out_13[2])
+
+
+def test_fused_rope_all_decode_rows():
+    """An all-decode dispatch (every row q_len 1, qblock 1 — the
+    engine's scan-tick shape) through the rope-fused kernel matches
+    the reference: the decode carry's per-tick metadata is exactly
+    this layout."""
+    rng = np.random.RandomState(32)
+    kp, vp = _pool(rng, num_pages=32)
+    dump = 31
+    spec = [(9, 1), (17, 1), (32, 1)]
+    r = len(spec)
+    kv = np.asarray([k for k, _ in spec], np.int32)
+    ql = np.asarray([q for _, q in spec], np.int32)
+    qs = kv - ql
+    tables = jnp.asarray(
+        rng.permutation(30)[:r * 4].reshape(r, 4).astype(np.int32))
+    ws, wf = qs.copy(), np.arange(r, dtype=np.int32)
+    we = kv.copy()
+    t = r
+    new_k = jnp.asarray(rng.randn(t, 2, 16), jnp.float32)
+    new_v = jnp.asarray(rng.randn(t, 2, 16), jnp.float32)
+    q_packed = jnp.asarray(rng.randn(t, 4, 16), jnp.float32)
+    sin, cos = RPA.rope_tables(jnp.asarray(_packed_positions(qs, ql)),
+                               16, 10000.0)
+    args = (q_packed, new_k, new_v, kp, vp, tables, jnp.asarray(kv),
+            jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(ws),
+            jnp.asarray(wf), jnp.asarray(we), dump)
+    out_f, kpf, vpf = map(_unwrap, RPA.fused_ragged_paged_attention(
+        *args, rope_sin=sin, rope_cos=cos, qblock=1))
+    out_x, kpx, vpx = map(np.asarray,
+                          RPA.fused_ragged_paged_attention_xla(
+                              *args, rope_sin=sin, rope_cos=cos,
+                              qblock=1))
+    _assert_parity(jnp.asarray(out_f), jnp.asarray(out_x))
+    live = [i for i in range(32) if i != dump]
+    assert np.array_equal(kpf[live], kpx[live])
+    assert np.array_equal(vpf[live], vpx[live])
+
+
+def test_fused_rope_q8_sidecar_bitwise():
+    """Int8 pools under rope fusion: the in-kernel rope->quantize chain
+    must land bitwise the same int8 pages AND scale sidecars as the
+    rope-then-`quantize_kv_int8`-then-scatter reference."""
+    rng = np.random.RandomState(33)
+    P, hk, page, d = 16, 2, 8, 16
+    base = rng.randn(P, hk, page, d).astype(np.float32)
+    amax = np.maximum(np.max(np.abs(base), -1, keepdims=True), 1e-8)
+    kq = jnp.asarray(np.clip(np.round(base / (amax / 127.0)), -127,
+                             127).astype(np.int8))
+    ks = jnp.asarray((amax / 127.0).astype(np.float32))
+    vq = jnp.asarray(np.roll(np.asarray(kq), 1, axis=0))
+    vs = jnp.asarray(np.roll(np.asarray(ks), 1, axis=0))
+    dump = 15
+    (q_packed, new_k, new_v, tables, kv, qs, ql, ws, wf, we, sin, cos,
+     qb) = _rope_case(rng, jnp.asarray(base), jnp.asarray(base), dump)
+    args = (q_packed, new_k, new_v, kq, vq, tables, kv, qs, ql, ws,
+            wf, we, dump)
+    of, kf, vf, ksf, vsf = map(_unwrap, RPA.fused_ragged_paged_attention(
+        *args, k_scale=ks, v_scale=vs, rope_sin=sin, rope_cos=cos,
+        qblock=qb))
+    ox, kx, vx, ksx, vsx = map(np.asarray,
+                               RPA.fused_ragged_paged_attention_xla(
+                                   *args, k_scale=ks, v_scale=vs,
+                                   rope_sin=sin, rope_cos=cos,
+                                   qblock=qb))
+    live = [i for i in range(P) if i != dump]
+    assert np.array_equal(kf[live], kx[live])
+    assert np.array_equal(vf[live], vx[live])
+    assert np.array_equal(ksf[live], ksx[live])      # scales BITWISE
+    assert np.array_equal(vsf[live], vsx[live])
+    err = float(np.max(np.abs(of.astype(np.float32) - ox)))
+    assert err < 0.05 * max(float(np.max(np.abs(ox))), 1.0)
+
+
+def test_fused_rope_poisoned_table_tails_never_written():
+    rng = np.random.RandomState(34)
+    kp, vp = _pool(rng, num_pages=16)
+    dump = 15
+    (q_packed, new_k, new_v, tables, kv, qs, ql, ws, wf, we, sin, cos,
+     qb) = _rope_case(rng, kp, vp, dump)
+    poisoned = np.asarray(tables).copy()
+    poisoned[:, 2:] = 10_000
+    out_a, kpa, _ = map(_unwrap, RPA.fused_ragged_paged_attention(
+        q_packed, new_k, new_v, kp, vp, tables, kv, qs, ql, ws, wf,
+        we, dump, rope_sin=sin, rope_cos=cos, qblock=qb))
+    out_b, kpb, _ = map(_unwrap, RPA.fused_ragged_paged_attention(
+        q_packed, new_k, new_v, kp, vp, jnp.asarray(poisoned), kv, qs,
+        ql, ws, wf, we, dump, rope_sin=sin, rope_cos=cos, qblock=qb))
+    assert np.array_equal(out_a, out_b)
+    live = [i for i in range(16) if i != dump]
+    assert np.array_equal(kpa[live], kpb[live])
+
+
+def test_fused_rope_supported_gates():
+    rng = np.random.RandomState(35)
+    kp, vp = _pool(rng)
+    tables = jnp.zeros((2, 4), jnp.int32)
+    ones = jnp.ones((2,), jnp.int32)
+    qp = jnp.zeros((2, 4, 16), jnp.float32)       # packed [T, H, D]
+    nk = jnp.zeros((2, 2, 16), jnp.float32)
+    tb = jnp.zeros((2, 16), jnp.float32)
+    base = (qp, nk, nk, kp, vp, tables, ones, ones, ones, ones, ones,
+            ones, 31)
+    assert RPA.fused_supported(*base, rope_sin=tb, rope_cos=tb,
+                               qblock=4)
+    # qblock is mandatory with rope tables
+    assert not RPA.fused_supported(*base, rope_sin=tb, rope_cos=tb)
+    # one table missing
+    assert not RPA.fused_supported(*base, rope_sin=tb, qblock=4)
+    # table rows must match the packed token count
+    bad_tb = jnp.zeros((3, 16), jnp.float32)
+    assert not RPA.fused_supported(*base, rope_sin=bad_tb,
+                                   rope_cos=bad_tb, qblock=4)
+    # q must be the packed 3-D layout when rope is fused
+    q4 = jnp.zeros((2, 4, 4, 16), jnp.float32)
+    assert not RPA.fused_supported(q4, *base[1:], rope_sin=tb,
+                                   rope_cos=tb, qblock=4)
+    # geometry gate: odd head_dim can't rotate
+    assert not RPA.fused_rope_geometry_ok(15)
+    assert RPA.fused_rope_geometry_ok(16)
+    with pytest.raises(ValueError):
+        RPA.fused_ragged_paged_attention(qp, nk, nk, kp, vp, tables,
+                                         ones, ones, ones, ones, ones,
+                                         ones, 31, rope_sin=tb,
+                                         rope_cos=tb)
+
+
 def test_table_tail_garbage_is_clamped():
     """Unused table tail entries may hold anything — including ids past
     the pool — without observable effect (they are clamped before the
