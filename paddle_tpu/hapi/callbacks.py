@@ -203,6 +203,14 @@ class MetricsCallback(Callback):
       ``train_mfu`` gauge as ``train_flops_multiplier * flops_per_sample
       * batch_size / step_time / peak_flops`` (the multiplier defaults
       to 3.0 — forward + backward ~= 2x forward).
+    - ``flops_watch`` (default ``"hapi.train_step"``): when the compile
+      watcher holds a ``cost_analysis`` FLOPs gauge for that callable
+      (``paddle_tpu_xla_program_flops{callable=...}``), MFU reads the
+      COMPILED step's exact FLOPs (forward + backward + update, per
+      step, already batch-inclusive) instead of the ``model_summary``
+      analytic count — so fused-loss and MoE models, whose hooked
+      forward under-/over-counts, report honest MFU. ``None`` disables
+      the gauge read (analytic accounting only).
     - ``sample_memory`` (default True): per-step device-memory gauges
       (``paddle_tpu_device_bytes_in_use`` / ``..._live_array_bytes``,
       see ``observability.compile_watch.sample_device_memory``) plus a
@@ -220,12 +228,13 @@ class MetricsCallback(Callback):
     def __init__(self, batch_size=None, flops_per_sample=None,
                  input_size=None, peak_flops=None,
                  train_flops_multiplier=3.0, registry=None,
-                 sample_memory=True):
+                 sample_memory=True, flops_watch="hapi.train_step"):
         super().__init__()
         from ..observability import metrics as om
         reg = registry if registry is not None else om.default_registry()
         self.sample_memory = bool(sample_memory)
         self._registry = registry
+        self.flops_watch = flops_watch
         self.batch_size = batch_size
         self.flops_per_sample = flops_per_sample
         self.input_size = input_size
@@ -252,6 +261,25 @@ class MetricsCallback(Callback):
             except Exception:
                 self.flops_per_sample = None   # un-hookable nets: no MFU
 
+    def _watched_step_flops(self):
+        """FLOPs of the last program the compile watcher recorded for
+        ``flops_watch`` — the cost_analysis gauge, peeked so an absent
+        watch (METRICS=0, jit=False, un-analyzed backend) never mints an
+        empty gauge child; None falls back to the analytic count."""
+        if not self.flops_watch:
+            return None
+        from ..observability import metrics as om
+        reg = self._registry if self._registry is not None \
+            else om.default_registry()
+        fam = reg.get("paddle_tpu_xla_program_flops")
+        if fam is None:
+            return None
+        child = fam.peek(self.flops_watch)
+        if child is None:
+            return None
+        v = child.value
+        return v if v and v > 0 else None
+
     def on_train_batch_begin(self, step, logs=None):
         self._t0 = time.perf_counter()
 
@@ -267,9 +295,17 @@ class MetricsCallback(Callback):
             self._loss.set(float(np.asarray(loss).reshape(-1)[0]))
         if self.batch_size and dt > 0:
             self._ips.set(self.batch_size / dt)
-            if self.flops_per_sample and self.peak_flops:
+        if self.peak_flops and dt > 0:
+            step_flops = self._watched_step_flops()
+            if step_flops:
+                # exact per-step FLOPs of the compiled program
+                # (cost_analysis counts fwd+bwd+update, whole batch) —
+                # needs no batch_size: the gauge is batch-inclusive
+                self._mfu.set(step_flops / dt / self.peak_flops)
+            elif self.flops_per_sample and self.batch_size:
                 achieved = (self.train_flops_multiplier
-                            * self.flops_per_sample * self.batch_size / dt)
+                            * self.flops_per_sample
+                            * self.batch_size / dt)
                 self._mfu.set(achieved / self.peak_flops)
         if self.sample_memory:
             from ..observability import compile_watch, flight_recorder

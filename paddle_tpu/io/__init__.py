@@ -12,7 +12,9 @@ from .sampler import (  # noqa: F401
     SubsetRandomSampler, BatchSampler, DistributedBatchSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
-from .token_feed import TokenFeed, PyTokenFeed  # noqa: F401
+from .token_feed import (  # noqa: F401
+    TokenFeed, PyTokenFeed, DevicePrefetcher,
+)
 
 
 class WorkerInfo:
@@ -32,7 +34,7 @@ def get_worker_info():
     return None
 
 __all__ = [
-    "TokenFeed", "PyTokenFeed",
+    "TokenFeed", "PyTokenFeed", "DevicePrefetcher",
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "ConcatDataset", "Subset", "random_split",
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",

@@ -12,11 +12,15 @@ serves the same contract from ``np.memmap`` synchronously.
 
 from __future__ import annotations
 
+import queue
+import threading
+import time
+
 import numpy as np
 
 from .. import native as _native
 
-__all__ = ["TokenFeed", "PyTokenFeed"]
+__all__ = ["TokenFeed", "PyTokenFeed", "DevicePrefetcher"]
 
 
 class PyTokenFeed:
@@ -75,6 +79,148 @@ class PyTokenFeed:
 
     def close(self):
         pass
+
+
+class DevicePrefetcher:
+    """Double-buffered async host->device prefetch over any host-batch
+    iterator.
+
+    A background thread pulls the next host batch from ``source``,
+    applies ``transform`` (e.g. split ``[B, S+1]`` ids into the train
+    step's ``(ids, labels)`` views), and ``put``s every array leaf onto
+    the device — so the NEXT batch's host work and H2D copy overlap the
+    CURRENT step's device compute. Combined with
+    ``jit.to_static(donate_inputs=True)`` this is the input half of the
+    training hot loop: the step consumes a fresh donated device batch
+    while the prefetcher is already copying the following one.
+
+    ``depth`` bounds the queue (default 2: one batch in flight on
+    device, one being filled — classic double buffering). Iteration
+    ends when ``source`` does; a source exception re-raises in the
+    consumer.
+
+    Stall accounting: :meth:`mark` returns ``(stall_seconds,
+    wall_seconds)`` since the previous mark — time the CONSUMER spent
+    blocked waiting for a batch vs wall time — and publishes the ratio
+    as the ``train_input_stall_frac`` gauge. A fraction near 0 means
+    the input pipeline hides behind compute; anything above a few
+    percent is headroom the accelerator is not getting.
+    """
+
+    def __init__(self, source, transform=None, depth=2, put=None):
+        if put is None:
+            import jax
+            put = jax.device_put
+        self._put = put
+        self._transform = transform
+        self._src = iter(source)
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._stall = 0.0
+        self._mark_stall = 0.0
+        self._mark_t = time.perf_counter()
+        self._terminal = None   # sticky: StopIteration / source error
+        self.batches = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="device-prefetch")
+        self._thread.start()
+
+    def _device_put_tree(self, item):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda leaf: self._put(np.ascontiguousarray(leaf))
+            if isinstance(leaf, np.ndarray) else leaf, item)
+
+    def _enqueue(self, entry):
+        """put with a stop-aware timeout so close() never deadlocks on a
+        full queue with no consumer."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(entry, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    item = next(self._src)
+                except StopIteration:
+                    self._enqueue(("end", None))
+                    return
+                if self._transform is not None:
+                    item = self._transform(item)
+                if not self._enqueue(("ok", self._device_put_tree(item))):
+                    return
+        except Exception as e:  # surface in the consumer, not the log
+            self._enqueue(("err", e))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._terminal is not None:
+            raise self._terminal
+        if self._stop.is_set():
+            raise StopIteration
+        t0 = time.perf_counter()
+        kind, payload = self._q.get()
+        self._stall += time.perf_counter() - t0
+        if kind == "end":
+            # sticky: later next() calls re-raise instead of blocking
+            # on a queue the worker will never fill again
+            self._terminal = StopIteration()
+            raise self._terminal
+        if kind == "err":
+            self._terminal = payload
+            raise payload
+        self.batches += 1
+        return payload
+
+    @property
+    def stall_seconds(self):
+        """Total consumer time spent blocked waiting for a batch."""
+        return self._stall
+
+    def mark(self):
+        """(stall_seconds, wall_seconds) since the previous mark; also
+        sets the ``train_input_stall_frac`` gauge to their ratio."""
+        now = time.perf_counter()
+        stall = self._stall - self._mark_stall
+        wall = max(now - self._mark_t, 1e-9)
+        self._mark_stall = self._stall
+        self._mark_t = now
+        try:
+            from ..observability import metrics as om
+            if om.enabled():
+                om.gauge("train_input_stall_frac",
+                         "fraction of the window the train loop spent "
+                         "blocked on input prefetch").set(
+                    min(1.0, stall / wall))
+        except Exception:
+            pass
+        return stall, wall
+
+    def close(self):
+        self._stop.set()
+        # drain so a worker blocked on put can observe the stop
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+        src_close = getattr(self._src, "close", None)
+        if callable(src_close):
+            src_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def TokenFeed(path, sample_elems, batch_size, dtype=np.int32, shuffle=True,

@@ -397,7 +397,8 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, state=None, full_graph=True,
-              warmup="per-signature", name=None, **kwargs):
+              warmup="per-signature", name=None, donate_inputs=False,
+              **kwargs):
     """Decorator/wrapper: compile an imperative step into one XLA program.
 
     ``state`` optionally lists Layers/Optimizers/Tensors the function
@@ -409,6 +410,12 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     optimizer accumulators); later unseen shapes compile directly. Use when
     the eager pass at full shape would exceed HBM (eager holds every
     intermediate; the compiled program lets XLA schedule memory).
+
+    ``donate_inputs=True`` additionally donates the call's INPUT buffers
+    to XLA (e.g. a train step's ids/labels: their HBM is reusable as
+    workspace the moment the embedding gather read them). Only safe when
+    every call gets fresh inputs — a caller re-feeding the same device
+    batch would dispatch donated (invalidated) buffers.
     """
     def wrap(fn):
         from ..nn import Layer
@@ -417,11 +424,13 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             sf = StaticFunction(layer.forward, input_spec=input_spec,
                                 state=[layer] + list(state or ()),
                                 warmup=warmup,
+                                donate_inputs=donate_inputs,
                                 name=name or type(layer).__name__)
             layer.forward = sf
             return layer
         return StaticFunction(fn, input_spec=input_spec, state=state,
-                              warmup=warmup, name=name)
+                              warmup=warmup, donate_inputs=donate_inputs,
+                              name=name)
     if function is not None:
         return wrap(function)
     return wrap

@@ -412,9 +412,12 @@ class LlamaModel(nn.Layer):
 
 
 class LlamaForCausalLM(nn.Layer):
-    """Decoder LM. ``forward(input_ids, labels=None)`` returns logits, or
-    ``(loss, logits)`` when next-token labels are given (labels are the
-    input shifted by the caller, ignore_index=-100)."""
+    """Decoder LM. ``forward(input_ids, labels=None)`` returns logits;
+    with next-token labels (the input shifted by the caller,
+    ignore_index=-100) it returns ``(loss, None)`` on the default
+    chunked fused cross-entropy path — the logits are never built — or
+    ``(loss, logits)`` under ``PADDLE_TPU_FUSED_CE=0`` / tied
+    embeddings (the materialized path)."""
 
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -434,8 +437,28 @@ class LlamaForCausalLM(nn.Layer):
         return linalg.matmul(hidden, self.model.embed_tokens.weight,
                              transpose_y=True)
 
+    def _fused_ce_enabled(self):
+        """Default loss path: the chunked fused cross-entropy lm-head
+        (``ops.fused_linear_cross_entropy``) — the ``[B*S, V]`` logits
+        tensor never exists. ``PADDLE_TPU_FUSED_CE=0`` restores the
+        materialized path byte-for-byte (and the tied-embedding model,
+        whose projection is the transposed embedding table, always
+        takes it)."""
+        import os
+        if self.lm_head is None:
+            return False
+        return os.environ.get("PADDLE_TPU_FUSED_CE", "1") != "0"
+
     def forward(self, input_ids, labels=None, position_ids=None):
         hidden = self.model(input_ids, position_ids)
+        if labels is not None and self._fused_ce_enabled():
+            # fused path returns (loss, None): logits were never built.
+            # Callers needing them set PADDLE_TPU_FUSED_CE=0.
+            from ..ops.fused_linear_cross_entropy import (
+                fused_linear_cross_entropy)
+            loss = fused_linear_cross_entropy(
+                hidden, self.lm_head.weight, labels, ignore_index=-100)
+            return loss, None
         logits = self._logits(hidden)
         if labels is None:
             return logits
@@ -584,7 +607,7 @@ class LlamaForCausalLM(nn.Layer):
 # expressed as GSPMD annotations (reference: fleet/layers/mpu/mp_layers.py)
 # ---------------------------------------------------------------------------
 def shard_llama(model: LlamaForCausalLM, mesh, tp_axis="mp",
-                fsdp_axis=None):
+                fsdp_axis=None, ep_axis=None):
     """Annotate a LlamaForCausalLM's weights over ``mesh``.
 
     - attention q/k/v and mlp gate/up: column-parallel (out-dim on tp)
@@ -592,18 +615,32 @@ def shard_llama(model: LlamaForCausalLM, mesh, tp_axis="mp",
     - embedding + lm_head: vocab-parallel
     - fsdp_axis (optional) shards the *other* matrix dim, giving the
       ZeRO-3 layout; norms shard on fsdp only.
+    - ep_axis (optional, MoE models) shards the stacked ``[E, ...]``
+      expert weights on their EXPERT dim over that mesh axis — expert
+      parallelism: each rank owns ``E / ep`` experts' FFN weights, the
+      router stays replicated (every rank routes every token), and the
+      grouped-GEMM path demotes to the GSPMD XLA formulation exactly as
+      the ``sharded`` stamp already does, so GSPMD partitions the
+      batched per-expert dot and inserts the dispatch collectives.
     """
     from ..distributed import shard_tensor, Shard, Replicate
 
     tp_dim = mesh.dim_names.index(tp_axis) if tp_axis else None
     fs_dim = mesh.dim_names.index(fsdp_axis) if fsdp_axis else None
+    ep_dim = mesh.dim_names.index(ep_axis) if ep_axis else None
+    if ep_axis and not model.config.moe_num_experts:
+        raise ValueError(
+            "ep_axis shards stacked expert weights, but this config has "
+            "moe_num_experts == 0 (dense FFN) — nothing to shard")
 
-    def place(t, tp_tensor_dim, fsdp_tensor_dim):
+    def place(t, tp_tensor_dim, fsdp_tensor_dim, ep_tensor_dim=None):
         p = [Replicate()] * mesh.ndim
         if tp_dim is not None and tp_tensor_dim is not None:
             p[tp_dim] = Shard(tp_tensor_dim)
         if fs_dim is not None and fsdp_tensor_dim is not None:
             p[fs_dim] = Shard(fsdp_tensor_dim)
+        if ep_dim is not None and ep_tensor_dim is not None:
+            p[ep_dim] = Shard(ep_tensor_dim)
         return shard_tensor(t, mesh, p)
 
     m = model.model
@@ -619,12 +656,13 @@ def shard_llama(model: LlamaForCausalLM, mesh, tp_axis="mp",
         if isinstance(mlp, LlamaMoEMLP):
             # stacked [E, in, out] expert weights: tp splits the FFN
             # width exactly like the dense column/row layout; the
-            # router stays replicated on tp (every rank routes every
-            # token) and fsdp shards the other matrix dim
+            # router stays replicated on tp AND ep (every rank routes
+            # every token); fsdp shards the other matrix dim; ep shards
+            # the expert dim itself
             mlp.gate = place(mlp.gate, None, 0)
-            mlp.gate_proj = place(mlp.gate_proj, 2, 1)
-            mlp.up_proj = place(mlp.up_proj, 2, 1)
-            mlp.down_proj = place(mlp.down_proj, 1, 2)
+            mlp.gate_proj = place(mlp.gate_proj, 2, 1, 0)
+            mlp.up_proj = place(mlp.up_proj, 2, 1, 0)
+            mlp.down_proj = place(mlp.down_proj, 1, 2, 0)
             # sharded experts: GSPMD needs the XLA grouped formulation
             # (drop any kernel-path programs built before sharding)
             mlp.sharded = True

@@ -131,6 +131,14 @@ class _Metric:
                 self._children[values] = child
             return child
 
+    def peek(self, *values):
+        """Child metric for one label-value combination, or None when it
+        was never created — a read that, unlike :meth:`labels`, never
+        mints an empty child into the export."""
+        values = tuple(str(v) for v in values)
+        with self._lock:
+            return self._children.get(values)
+
     def remove(self, *values):
         """Drop the child for one label-value combination (no-op when
         absent) — lets short-lived instruments bound label cardinality

@@ -7,5 +7,6 @@ seam.
 """
 
 from . import flash_attention  # noqa: F401
+from . import fused_linear_cross_entropy  # noqa: F401
 from . import grouped_gemm  # noqa: F401
 from . import ragged_paged_attention  # noqa: F401

@@ -36,7 +36,7 @@ _WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
                         "test_subprocess_cluster",
                         "test_chunked_scheduler", "test_speculative",
                         "test_moe_serving", "test_partition_tolerance",
-                        "test_ragged_attention"}
+                        "test_ragged_attention", "test_fused_ce"}
 
 # per-module budgets where the default is wrong: subprocess-cluster
 # tests legitimately wait out several worker-process startups (import +
@@ -58,7 +58,11 @@ _WEDGE_BUDGETS = {"test_subprocess_cluster": 700.0,
                   "test_ragged_attention": 600.0,
                   # the slow chaos soak waits out several subprocess
                   # worker startups under injected rpc loss
-                  "test_partition_tolerance": 700.0}
+                  "test_partition_tolerance": 700.0,
+                  # donated train-step + memory-analysis tests compile
+                  # several full fwd+bwd programs, and the Pallas parity
+                  # tests run the interpreter
+                  "test_fused_ce": 600.0}
 
 
 @pytest.fixture(autouse=True)
