@@ -1116,6 +1116,124 @@ def _q8_attention_fn(RPA):
     return fn
 
 
+def bench_weight_int8(model, on_tpu=True):
+    """Weight-only int8 serving gates (ROADMAP item 3, weight side;
+    ``paddle_tpu/quant``).
+
+    - ``weight_int8_greedy_match`` / ``weight_int8_parity_ok``: the
+      bundled-prompt quality gate (``quant/quality.py``) on a briefly
+      prompt-fitted copy of the bench model — greedy-match >= 0.99 and
+      logits error within the 0.05x-scale budget (the stated bars;
+      random-init models measure tie-breaking noise instead, see
+      ``quality.fit_on_prompts``).
+    - ``weight_int8_capacity_x``: bf16 weight bytes / as-served bytes
+      (int8 + f32 scale sidecars + the float leftovers — embeddings,
+      norms, lm_head — all counted). ~2x on real configs where
+      projections dominate; the small-vocab bench config lands lower
+      because its embedding slice is proportionally large, so the gate
+      is >= 1.4.
+    - ``weight_int8_dequant_ms`` vs ``weight_int8_dequant_xla_ms``:
+      fused (in-VMEM dequant) Pallas kernel vs the exact XLA
+      formulation on the model's MLP projection shape (TPU only).
+    - ``weight_int8_tokens_per_sec`` / ``weight_bf16_tokens_per_sec``:
+      e2e serving throughput both paths, plus
+      ``weight_int8_token_match`` (greedy e2e agreement)."""
+    import copy
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.serving import LlamaServingEngine
+    from paddle_tpu.quant import quality
+    from paddle_tpu.quant.format import (quantize_model, quantize_weight,
+                                         serving_weight_bytes)
+    from paddle_tpu.quant.kernels import _dequant_matmul
+
+    block = 128 if on_tpu else 64
+
+    # -- quality gate on prompt-fitted copies --------------------------
+    mfp = copy.deepcopy(model)
+    quality.fit_on_prompts(mfp, steps=40)
+    mfp.eval()
+    mq = copy.deepcopy(mfp)
+    quantize_model(mq, block=block)
+    rep = quality.logits_quality(mfp, mq)
+
+    # -- capacity: judged against the bf16 counterfactual --------------
+    if hasattr(mq, "bfloat16"):
+        mcap = copy.deepcopy(mq).bfloat16()   # int8 buffers survive
+    else:
+        mcap = mq
+    actual, bf16_base, _ = serving_weight_bytes(mcap)
+    capacity_x = bf16_base / max(actual, 1)
+
+    # -- fused vs XLA dequant-matmul micro-bench (TPU only) ------------
+    h = model.config.hidden_size
+    inter = model.config.intermediate_size
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    wq, ws = quantize_weight(
+        jnp.asarray(rng.randn(h, inter) * 0.05, jnp.float32), block)
+    xs = jnp.asarray(rng.randn(256 if on_tpu else 16, h), dt)
+    dq_ms = {}
+    iters = 20 if on_tpu else 2
+    for key, uk in (("weight_int8_dequant_ms", True),
+                    ("weight_int8_dequant_xla_ms", False)):
+        if uk and not on_tpu:
+            continue    # interpret-mode timing is meaningless
+        f = jax.jit(lambda a, q, s, uk=uk: _dequant_matmul(
+            a, q, s, block, use_kernel=uk))
+        f(xs, wq, ws).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = f(xs, wq, ws)
+        y.block_until_ready()
+        dq_ms[key] = round((time.perf_counter() - t0) / iters * 1e3, 4)
+
+    # -- e2e serving throughput, both paths ----------------------------
+    kw = dict(max_batch=2, page_size=16 if on_tpu else 8, num_pages=64,
+              max_pages_per_seq=16, chunk_block=8, chunk_budget=16,
+              prefix_cache=False)
+    v = model.config.vocab_size
+    prompts = [p[:12] for p in quality.bundled_prompt_ids(v)[:2]]
+    new_toks = 64 if on_tpu else 24
+
+    q8e = LlamaServingEngine(mq, **kw)      # pre-quantized: honored
+    q8_bytes = q8e.weight_bytes_per_param
+    q8e.generate(prompts, max_new_tokens=q8e.decode_ticks + 2)
+    t0 = time.perf_counter()
+    outs_q8 = q8e.generate(prompts, max_new_tokens=new_toks)
+    dt_q8 = time.perf_counter() - t0
+    q8e.close()
+
+    fpe = LlamaServingEngine(mfp, **kw)
+    fpe.generate(prompts, max_new_tokens=fpe.decode_ticks + 2)
+    t0 = time.perf_counter()
+    outs_fp = fpe.generate(prompts, max_new_tokens=new_toks)
+    dt_fp = time.perf_counter() - t0
+    fpe.close()
+
+    tok_match = sum(a == b for of, oq in zip(outs_fp, outs_q8)
+                    for a, b in zip(of, oq))
+    tok_total = max(sum(len(o) for o in outs_fp), 1)
+
+    out = {
+        "weight_int8_greedy_match": round(rep["greedy_match"], 4),
+        "weight_int8_logits_max_err": round(rep["max_err"], 5),
+        "weight_int8_parity_ok": bool(rep["passes"]),
+        "weight_int8_capacity_x": round(capacity_x, 3),
+        "weight_int8_capacity_ok": bool(capacity_x >= 1.4),
+        "serving_weight_bytes_per_param": round(q8_bytes, 4),
+        "weight_int8_token_match": round(tok_match / tok_total, 4),
+        "weight_int8_tokens_per_sec": round(
+            sum(len(o) for o in outs_q8) / dt_q8, 1),
+        "weight_bf16_tokens_per_sec": round(
+            sum(len(o) for o in outs_fp) / dt_fp, 1),
+    }
+    out.update(dq_ms)
+    return out
+
+
 def bench_restart_ttft(on_tpu=True):
     """Cold vs warm-cache restart-to-first-token for a SUBPROCESS
     serving replica (ROADMAP item 5 / PR 7): a worker process is
@@ -1668,6 +1786,13 @@ def main():
     except Exception as e:
         log(f"kv-int8 bench failed: {e!r:.300}")
         result["kv_int8_error"] = repr(e)[:200]
+
+    try:
+        model = bench_train_step.last_model
+        result.update(bench_weight_int8(model, on_tpu=on_tpu))
+    except Exception as e:
+        log(f"weight-int8 bench failed: {e!r:.300}")
+        result["weight_int8_error"] = repr(e)[:200]
 
     try:
         result.update(bench_restart_ttft(on_tpu=on_tpu))

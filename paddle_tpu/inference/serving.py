@@ -308,6 +308,11 @@ def _serving_metrics():
             "kv_page_bytes_per_token",
             "HBM bytes one cached token costs across all layers (K+V "
             "data plus any int8 scale sidecars)"),
+        "weight_bytes": _om.gauge(
+            "serving_weight_bytes_per_param",
+            "bytes per model weight element as served (int8 weights + "
+            "f32 scale sidecars land near 1; bf16 weights at 2; f32 "
+            "at 4)"),
         "stop_hits": _om.counter(
             "serving_stop_token_hits_total",
             "requests retired by a per-request stop token (the stop "
@@ -517,7 +522,7 @@ class LlamaServingEngine:
                  prefix_cache_pages=None, prewarm=None, kv_dtype=None,
                  spec_k=None, spec_ngram=3, drafter_factory=None,
                  sampling=None, sample_slots=8, fused_kv=None,
-                 fused_rope=None):
+                 fused_rope=None, weight_dtype=None, weight_block=None):
         if num_pages is None:
             num_pages = max_batch * 24 + 8
         self.model = model
@@ -582,6 +587,32 @@ class LlamaServingEngine:
         self.prefix = PrefixCache(self.alloc, page_size,
                                   max_pages=prefix_cache_pages) \
             if prefix_cache else None
+        # weight-only int8 serving (ROADMAP item 3, weight side): every
+        # decode-side projection stores int8 + per-block f32 scale
+        # sidecars and dequantizes in VMEM on use — about half the HBM
+        # bytes a decode step streams. PADDLE_TPU_WEIGHT_DTYPE=int8 is
+        # the fleet knob; the engine arg wins when given; "bf16" (the
+        # default) leaves the model untouched — the old path byte for
+        # byte. Quantization is in place: a pre-quantized model (e.g.
+        # load_quantized / the QAT bridge) is honored as-is.
+        if weight_dtype is None:
+            weight_dtype = os.environ.get(
+                "PADDLE_TPU_WEIGHT_DTYPE", "") or None
+        if weight_dtype == "bf16":
+            weight_dtype = None
+        if weight_dtype not in (None, "int8"):
+            raise ValueError(
+                f"weight_dtype must be 'bf16' (model dtype) or 'int8', "
+                f"got {weight_dtype!r}")
+        from ..quant.format import (is_quantized, model_weight_block,
+                                    quantize_model, serving_weight_bytes)
+        if weight_dtype == "int8" and not is_quantized(model):
+            quantize_model(model, block=weight_block)
+        self.weight_quant = bool(weight_dtype == "int8"
+                                 or is_quantized(model))
+        self.weight_block = model_weight_block(model) or 0
+        wbytes, _, welems = serving_weight_bytes(model)
+        self.weight_bytes_per_param = wbytes / max(welems, 1)
         dt = model.parameters()[0].dtype
         hk, d = cfg.num_key_value_heads, cfg.head_dim
         # int8 KV pages (ROADMAP item 3b): quantize on write, dequantize
@@ -688,6 +719,7 @@ class LlamaServingEngine:
             tok_bytes += 2 * hk * 4 * n_layers     # f32 scale sidecars
         self.kv_bytes_per_token = tok_bytes
         self._m["kv_bytes"].set(tok_bytes)
+        self._m["weight_bytes"].set(self.weight_bytes_per_param)
         self._next_id = 0
         # ONE traced mixed-program function covers every dispatch; its
         # per-signature cache holds the chunk_budget-token shape and the
@@ -1185,10 +1217,16 @@ class LlamaServingEngine:
 
             # no lazy state (params exist, no optimizer): skip the eager
             # warmup and compile directly; donate pools for in-place
-            # page writes
+            # page writes. donate=False: serving state is read-only
+            # pass-through (weights are never updated), so donating it
+            # saves nothing — and with many same-aval state slots (e.g.
+            # int8 weights + per-block scale sidecars) XLA's aval-based
+            # alias assignment scrambles the pass-through outputs across
+            # the donated buffers, corrupting the model in place.
             self._mixed_static = StaticFunction(
                 self._mixed_forward, state=[self.model], warmup="once",
-                donate_inputs=True, name="serving.mixed_step")
+                donate=False, donate_inputs=True,
+                name="serving.mixed_step")
             self._mixed_static._warmed_any = True
         return self._mixed_static
 
@@ -1780,7 +1818,14 @@ class LlamaServingEngine:
                  # prewarm recipe must never cross the two; same for
                  # the rope-fused program (pre-rope packed operands +
                  # in-kernel rotation vs the separate rope op)
-                 bool(self.fused_kv), bool(self.fused_rope))
+                 bool(self.fused_kv), bool(self.fused_rope),
+                 # weight-only int8 forks every serving program: the
+                 # projections trade one bf16 weight input for an int8
+                 # weight + scale-sidecar pair (and the block size
+                 # shapes the sidecars), so a prewarm recipe recorded
+                 # by a bf16 engine must never drive an int8 one (or
+                 # vice versa, or across block sizes)
+                 bool(self.weight_quant), int(self.weight_block))
         return "llama:" + hashlib.sha1(
             repr(parts).encode()).hexdigest()[:16]
 
@@ -2443,9 +2488,12 @@ class LlamaServingEngine:
         if sf is None:
             from ..jit import StaticFunction
 
+            # donate=False for the same reason as the mixed step: model
+            # state is pass-through here, and donating same-aval weight
+            # slots lets XLA alias them across each other
             sf = StaticFunction(self._decode_scan_fn(n),
                                 state=[self.model], warmup="once",
-                                donate_inputs=True,
+                                donate=False, donate_inputs=True,
                                 name=f"serving.mixed_scan[{n}]")
             # no lazy state to materialize (params exist; no optimizer):
             # skip the eager warmup — n scanned steps of per-op dispatch

@@ -140,6 +140,13 @@ class StaticFunction:
         self._fn = function
         self._input_spec = input_spec
         self._extra_state = state
+        # donate=True is for steps that UPDATE state (train steps): the
+        # old param buffers are dead after the call and XLA reuses them.
+        # Pass donate=False for read-only programs (serving, generate) —
+        # pass-through state gains nothing from donation, and when many
+        # state slots share an aval (e.g. int8 weights + scale sidecars)
+        # XLA's aval-based alias matching can scramble the identity
+        # outputs across the donated buffers.
         self._donate = donate
         # compile-watch identity: per-callable compile counters/gauges
         # are labeled with this name (see observability.compile_watch)

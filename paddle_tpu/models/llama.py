@@ -208,7 +208,49 @@ class LlamaMoEMLP(nn.Layer):
         #: GSPMD-partitionable XLA formulation (a Pallas custom call
         #: would pin execution to one replica)
         self.sharded = False
+        #: set by quantize_weights: the per-block size of the int8
+        #: expert weights (None/0 = float weights, the default)
+        self.weight_block = None
         self._fns: "dict[int, object]" = collections.OrderedDict()
+
+    def quantize_weights(self, block=None):
+        """Swap the stacked expert weights (in place) for their
+        weight-only int8 serving form: each ``[E, K, N]`` Parameter
+        becomes an int8 buffer of the same shape plus an
+        ``[E, ceil(K/B), N]`` f32 scale buffer (``<name>_scale``), and
+        the grouped FFN reroutes through ``grouped_gemm_q8`` (in-VMEM
+        dequant). Serving-side only — the quantized weights are frozen
+        (see :mod:`paddle_tpu.quant`). The router gate stays float
+        (tiny, and routing decisions are the quality-critical bits)."""
+        from ..quant.format import effective_block, quantize_weight
+
+        if self.weight_block:
+            return
+        # one nominal block; per-tensor effective blocks (clamped to
+        # each K) are derived from it at build time
+        block = effective_block(max(self.d_model, self.d_ff), block)
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            p = getattr(self, name)
+            b = min(block, p.shape[-2])
+            q, s = quantize_weight(p, b)
+            delattr(self, name)
+            self.register_buffer(name, Tensor(np.asarray(q)))
+            self.register_buffer(name + "_scale", Tensor(np.asarray(s)))
+        self.weight_block = int(block)
+        self._fns.clear()
+
+    def to(self, device=None, dtype=None, blocking=None):
+        # model-wide dtype casts must keep the quantized format's
+        # invariant: scale sidecars stay f32 (bf16 scales would change
+        # the dequant products; see quant.layers.WeightOnlyLinear.to)
+        out = super().to(device=device, dtype=dtype, blocking=blocking)
+        if self.weight_block:
+            for name in ("gate_proj_scale", "up_proj_scale",
+                         "down_proj_scale"):
+                s = self._buffers[name]
+                if s._data.dtype != jnp.float32:
+                    s._data = s._data.astype(jnp.float32)
+        return out
 
     def _build_fn(self, n):
         from ..incubate.moe import top_k_routing
@@ -216,6 +258,9 @@ class LlamaMoEMLP(nn.Layer):
 
         e, k = self.num_experts, self.top_k
         uk = False if self.sharded else None
+
+        if self.weight_block:
+            return self._build_q8_fn(n, e, k, uk)
 
         def fn(x2d, gate, wg, wu, wd):
             logits = jnp.matmul(x2d.astype(jnp.float32), gate)
@@ -235,6 +280,35 @@ class LlamaMoEMLP(nn.Layer):
             u = _grouped(gathered, wu, gs, use_kernel=uk)
             h = jax.nn.silu(g) * u                          # swiglu
             y = _grouped(h, wd, gs, use_kernel=uk)
+            idx = expert_of * n + jnp.clip(pos_of, 0, n - 1)
+            picked = y[idx]                                 # [n, k, D]
+            wk = (weights * keep).astype(x2d.dtype)
+            return jnp.einsum("nk,nkd->nd", wk, picked), aux
+
+        return fn
+
+    def _build_q8_fn(self, n, e, k, uk):
+        """The weight-only int8 forward: same routing, the three
+        grouped GEMMs ride ``grouped_gemm_q8`` (int8 expert weights +
+        scale sidecars, in-VMEM dequant). Per-tensor effective blocks
+        clamp the nominal block to each contraction dim."""
+        from ..incubate.moe import top_k_routing
+        from ..ops.grouped_gemm import _grouped_q8
+
+        bg = min(self.weight_block, self.d_model)   # gate/up: K=d_model
+        bd = min(self.weight_block, self.d_ff)      # down: K=d_ff
+
+        def fn(x2d, gate, wg, sg, wu, su, wd, sd):
+            logits = jnp.matmul(x2d.astype(jnp.float32), gate)
+            slot_token, expert_of, pos_of, keep, weights, aux = \
+                top_k_routing(logits, k, n, normalize=True)
+            gs = jnp.zeros((e,), jnp.int32).at[expert_of.reshape(-1)] \
+                .add(keep.reshape(-1).astype(jnp.int32))
+            gathered = x2d[jnp.maximum(slot_token, 0)]      # [E*n, D]
+            g = _grouped_q8(gathered, wg, sg, gs, bg, use_kernel=uk)
+            u = _grouped_q8(gathered, wu, su, gs, bg, use_kernel=uk)
+            h = jax.nn.silu(g) * u                          # swiglu
+            y = _grouped_q8(h, wd, sd, gs, bd, use_kernel=uk)
             idx = expert_of * n + jnp.clip(pos_of, 0, n - 1)
             picked = y[idx]                                 # [n, k, D]
             wk = (weights * keep).astype(x2d.dtype)
@@ -262,10 +336,18 @@ class LlamaMoEMLP(nn.Layer):
         for s in shape[:-1]:
             n *= s
         x2d = x.reshape([n, d])
-        out, aux = run_op(
-            "moe_mlp", self.build_fn(n),
-            (x2d, self.gate, self.gate_proj, self.up_proj,
-             self.down_proj))
+        if self.weight_block:
+            # frozen int8 weights: the op is not differentiable
+            out, aux = run_op(
+                "moe_mlp", self.build_fn(n),
+                (x2d, self.gate, self.gate_proj, self.gate_proj_scale,
+                 self.up_proj, self.up_proj_scale, self.down_proj,
+                 self.down_proj_scale), differentiable=False)
+        else:
+            out, aux = run_op(
+                "moe_mlp", self.build_fn(n),
+                (x2d, self.gate, self.gate_proj, self.up_proj,
+                 self.down_proj))
         self.l_aux = aux
         return out.reshape(shape)
 
@@ -558,9 +640,15 @@ class LlamaForCausalLM(nn.Layer):
             def step_fn(tokens, cache_len, caches, rng_key):
                 return self._decode_step(tokens, cache_len, caches,
                                          rng_key, sampler)
+            # donate=False: weights are read-only pass-through in the
+            # decode step, so donating them buys nothing — and with a
+            # quantized model's many same-aval int8/scale slots XLA's
+            # aval-based alias matching can scramble the pass-through
+            # outputs across donated buffers (the caches still donate
+            # via donate_inputs, which is where the in-place win lives)
             self._decode_static = jit.StaticFunction(
-                step_fn, state=[self], warmup="once", donate_inputs=True,
-                name="llama.generate_step")
+                step_fn, state=[self], warmup="once", donate=False,
+                donate_inputs=True, name="llama.generate_step")
             self._decode_param_key = param_key
         step = self._decode_static
         base_key = jax.random.key(seed) if seed is not None \

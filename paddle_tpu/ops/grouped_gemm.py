@@ -58,7 +58,8 @@ except ImportError:  # pragma: no cover
 
 from ..framework.tensor import run_op
 
-__all__ = ["grouped_gemm", "grouped_gemm_xla", "supported"]
+__all__ = ["grouped_gemm", "grouped_gemm_xla", "supported",
+           "grouped_gemm_q8", "grouped_gemm_q8_xla", "supported_q8"]
 
 #: VMEM budget for one grid step's blocks (x tile + w tile + out tile),
 #: kept well under the ~16 MB/core ceiling (see pallas_guide.md)
@@ -289,3 +290,191 @@ def grouped_gemm_xla(x, w, group_sizes):
         return _grouped(x, w, gs, use_kernel=False)
 
     return run_op("grouped_gemm_xla", fn, (x, w, group_sizes))
+
+
+# ---------------------------------------------------------------------------
+# int8 weight-only variant (paddle_tpu.quant): stacked expert weights
+# stay int8 in HBM with per-block f32 scale sidecars [E, K/B, N]; the
+# dequantize (upcast x scale) happens in VMEM right before each
+# expert's dot. Serving-side only — quantized weights are frozen, so
+# there is no VJP; the ragged row semantics (masking, skip, clamp) are
+# identical to the float kernel above.
+# ---------------------------------------------------------------------------
+
+def _q8_dequant_w(w_q, scales, block):
+    """Shared dequant expression (see quant.kernels._dequant_w): the
+    kernel and the XLA formulation compute the SAME elementwise
+    products, so both paths stay bitwise-identical."""
+    k, n = w_q.shape[-2], w_q.shape[-1]
+    kb = scales.shape[-2]
+    shape = w_q.shape[:-2] + (kb, block, n)
+    return (w_q.astype(jnp.float32).reshape(shape)
+            * scales[..., :, None, :]).reshape(w_q.shape)
+
+
+def _q8_vmem(bm, k, kb, bn, itemsize):
+    return (bm * k * itemsize       # x tile
+            + k * bn                # int8 weight tile
+            + kb * bn * 4           # f32 scale tile
+            + k * bn * 4            # dequantized f32 weight
+            + bm * bn * 4)          # out tile
+
+
+def supported_q8(x, w_q, scales, group_sizes, block):
+    """Pallas-path preconditions for the int8 grouped GEMM: everything
+    :func:`supported` checks, plus int8 weights, scales
+    ``[E, K/B, N]`` tiling K exactly, and the (bigger — dequant temp)
+    VMEM budget."""
+    if not _HAS_PLTPU or _interpret():
+        return False
+    xs, ws, ss, gs = (_shape_of(x), _shape_of(w_q), _shape_of(scales),
+                      _shape_of(group_sizes))
+    if len(xs) != 2 or len(ws) != 3 or len(ss) != 3 or len(gs) != 1:
+        return False
+    m, k = xs
+    e, kw, n = ws
+    if e == 0 or gs[0] != e or kw != k:
+        return False
+    if m == 0 or m % e or k % 8 or n % 8:
+        return False
+    b = int(block)
+    if b <= 0 or k % b:
+        return False
+    if ss != (e, k // b, n):
+        return False
+    qa = getattr(w_q, "_data", w_q)
+    sa = getattr(scales, "_data", scales)
+    if jnp.dtype(qa.dtype) != jnp.int8 \
+            or jnp.dtype(sa.dtype) != jnp.float32:
+        return False
+    c = m // e
+    itemsize = max(jnp.dtype(getattr(x, "_data", x).dtype).itemsize, 4)
+    bm, bn = _blocks(c, k, n, itemsize)
+    if n % bn:
+        return False
+    return _q8_vmem(bm, k, k // b, bn, itemsize) <= _VMEM_BUDGET
+
+
+def _gg_q8_kernel(gs_ref, x_ref, w_ref, s_ref, o_ref, *, block_m,
+                  block):
+    e = pl.program_id(0)
+    mi = pl.program_id(1)
+    rows = gs_ref[e]
+
+    @pl.when(mi * block_m < rows)
+    def _compute():
+        x = x_ref[0].astype(jnp.float32)                    # [BM, K]
+        ridx = mi * block_m + jax.lax.broadcasted_iota(
+            jnp.int32, (block_m, 1), 0)
+        x = jnp.where(ridx < rows, x, 0.0)
+        w = _q8_dequant_w(w_ref[0], s_ref[0], block)        # [K, BN]
+        o_ref[0] = jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+    @pl.when(mi * block_m >= rows)
+    def _skip():
+        o_ref[0] = jnp.zeros(o_ref.shape[1:], o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _make_grouped_q8(e, c, k, n, kb, block, block_m, block_n,
+                     out_dtype, interpret):
+    mt = -(-c // block_m)
+    nt = -(-n // block_n)
+
+    def x_index(ei, mi, ni, gs):
+        last = jnp.maximum(gs[ei] - 1, 0) // block_m
+        return (ei, jnp.minimum(mi, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(e, mt, nt),
+        in_specs=[
+            pl.BlockSpec((1, block_m, k), x_index),
+            pl.BlockSpec((1, k, block_n),
+                         lambda ei, mi, ni, gs: (ei, 0, ni)),
+            pl.BlockSpec((1, kb, block_n),
+                         lambda ei, mi, ni, gs: (ei, 0, ni)),
+        ],
+        out_specs=pl.BlockSpec((1, block_m, block_n),
+                               lambda ei, mi, ni, gs: (ei, mi, ni)),
+    )
+
+    def call(x3, w_q, scales, gs):
+        return pl.pallas_call(
+            functools.partial(_gg_q8_kernel, block_m=block_m,
+                              block=block),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((e, c, n), out_dtype),
+            interpret=interpret,
+        )(gs, x3, w_q, scales)
+
+    return call
+
+
+def _q8_impl(x, w_q, scales, group_sizes, block):
+    """Pallas dispatch (raw arrays). Caller guarantees
+    :func:`supported_q8` (or forces interpret for the parity tests)."""
+    m, k = x.shape
+    e, _, n = w_q.shape
+    c = m // e
+    kb = scales.shape[1]
+    bm, bn = _blocks(c, k, n, max(jnp.dtype(x.dtype).itemsize, 4))
+    call = _make_grouped_q8(e, c, k, n, kb, int(block), bm, bn,
+                            x.dtype, _interpret())
+    gs = jnp.clip(group_sizes.astype(jnp.int32), 0, c)
+    return call(x.reshape(e, c, k), w_q, scales, gs).reshape(m, n)
+
+
+def _q8_xla_impl(x, w_q, scales, group_sizes, block):
+    """XLA formulation: dequantize the stacked weights with the SAME
+    elementwise expression the kernel uses, then the float reference's
+    masked batched dot — exact parity by construction."""
+    m, k = x.shape
+    e, _, n = w_q.shape
+    c = m // e
+    gs = jnp.clip(group_sizes.astype(jnp.int32), 0, c)
+    w = _q8_dequant_w(w_q, scales, int(block))
+    x3 = x.reshape(e, c, k)
+    mask = (jnp.arange(c, dtype=jnp.int32)[None, :] < gs[:, None])
+    x3 = jnp.where(mask[..., None], x3.astype(jnp.float32), 0.0)
+    y = jax.lax.dot_general(
+        x3, w, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    return y.astype(x.dtype).reshape(m, n)
+
+
+def _grouped_q8(x, w_q, scales, group_sizes, block, use_kernel=None):
+    """Raw-array int8 grouped GEMM (no VJP — serving-only frozen
+    weights). ``use_kernel=None`` auto-selects; ``True`` forces the
+    kernel (interpret mode off-TPU: the parity tests); ``False`` the
+    XLA formulation (the SPMD path)."""
+    if use_kernel is None:
+        use_kernel = supported_q8(x, w_q, scales, group_sizes, block)
+    impl = _q8_impl if use_kernel else _q8_xla_impl
+    return impl(x, w_q, scales, group_sizes.astype(jnp.int32),
+                int(block))
+
+
+def grouped_gemm_q8(x, w_q, scales, group_sizes, block):
+    """Tensor-level int8 grouped GEMM: ``y[e*C + i] = x[e*C + i] @
+    (w_q[e] * scales[e])`` for ``i < group_sizes[e]``, zeros past each
+    group's length. Weights stay int8 in HBM (scale sidecars ride the
+    same expert index); dequant happens in VMEM. Not differentiable."""
+
+    def fn(x, w, s, gs):
+        return _grouped_q8(x, w, s, gs, block)
+
+    return run_op("grouped_gemm_q8", fn,
+                  (x, w_q, scales, group_sizes), differentiable=False)
+
+
+def grouped_gemm_q8_xla(x, w_q, scales, group_sizes, block):
+    """XLA formulation of :func:`grouped_gemm_q8` (parity bar)."""
+
+    def fn(x, w, s, gs):
+        return _grouped_q8(x, w, s, gs, block, use_kernel=False)
+
+    return run_op("grouped_gemm_q8_xla", fn,
+                  (x, w_q, scales, group_sizes), differentiable=False)

@@ -1,0 +1,50 @@
+"""``paddle_tpu.quant`` — serving-side weight-only int8 quantization.
+
+Decode is weight-bandwidth-bound: every projection the mixed serving
+program touches streams its full weight matrix from HBM per step.
+Storing those weights as int8 with per-block absmax f32 scale sidecars
+(:mod:`.format`) halves the bytes a decode step moves — the same
+multiplicative lever the int8 KV pages proved for the cache side — and
+the dequant happens on-use, in VMEM next to the matmul
+(:mod:`.kernels`), so HBM only ever sees int8.
+
+Pieces:
+
+- :mod:`.format` — the quantized weight format (`[K, N]` int8 +
+  ``[ceil(K/B), N]`` f32 scales, block size a knob) and the
+  ``quantize_model`` / ``dequantize_weight`` APIs;
+- :mod:`.kernels` — the Pallas dequant-matmul (int8 x scale in VMEM,
+  f32 accumulate) with its exact-parity XLA formulation and a
+  ``supported()`` gate in the ``grouped_gemm`` style;
+- :mod:`.layers` — ``WeightOnlyLinear``, the drop-in serving form of
+  ``nn.Linear`` (int8 + scale buffers, dequant-on-use forward);
+- :mod:`.bridge` — lossless converter from the QAT/PTQ module's
+  ``convert`` output into this serving format (no requantization);
+- :mod:`.checkpoint` — ``save_quantized`` / ``load_quantized`` on the
+  ``CheckpointManager`` atomic-commit/CRC contract;
+- :mod:`.quality` — the bundled-prompt quality gate (max/mean logits
+  error + greedy-match rate of the quantized model vs the float one).
+
+The engine knob is ``LlamaServingEngine(weight_dtype="int8")`` /
+``PADDLE_TPU_WEIGHT_DTYPE=int8``; ``bf16`` (the default) leaves the
+model untouched — the old path byte for byte.
+"""
+
+from .format import (DEFAULT_BLOCK, default_block, dequantize_weight,
+                     effective_block, is_quantized, model_weight_block,
+                     quantize_model, quantize_weight,
+                     serving_weight_bytes)
+from .kernels import dequant_matmul, dequant_matmul_xla, supported
+from .layers import WeightOnlyLinear
+from .bridge import bridge_linear, bridge_model
+from .checkpoint import load_quantized, save_quantized
+from . import quality
+
+__all__ = [
+    "DEFAULT_BLOCK", "default_block", "effective_block",
+    "quantize_weight", "dequantize_weight", "quantize_model",
+    "is_quantized", "model_weight_block", "serving_weight_bytes",
+    "dequant_matmul", "dequant_matmul_xla", "supported",
+    "WeightOnlyLinear", "bridge_linear", "bridge_model",
+    "save_quantized", "load_quantized", "quality",
+]

@@ -1,0 +1,72 @@
+"""Bridge from the QAT/PTQ module's deployed form to serving weights.
+
+``paddle_tpu.quantization``'s ``convert`` emits ``ConvertedLinear``:
+int8 weights + ONE per-tensor absmax scale, dequanting as
+``w = q * (scale / 127)``. The serving format
+(:mod:`paddle_tpu.quant.format`) is the per-block generalization of
+exactly that math — so a QAT'd model deploys **without
+requantization**: the int8 values are reused verbatim and the
+per-tensor scale is replicated into the per-block sidecar
+(``scales[kb, n] = scale / 127`` for every block/column). The bridged
+layer's dequantized weight is bitwise-identical to the source's — the
+round-trip test pins it.
+
+PTQ models that calibrated an activation scale carry semantics the
+weight-only serving path drops (input snapping to the int8 grid);
+``strict=True`` (the default) refuses those, ``strict=False`` bridges
+weight-only and discards the activation scale.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..quantization import ConvertedLinear
+from .format import effective_block
+from .layers import WeightOnlyLinear
+
+__all__ = ["bridge_linear", "bridge_model"]
+
+
+def bridge_linear(converted, block=None, strict=True):
+    """One ``ConvertedLinear`` -> :class:`WeightOnlyLinear`, lossless
+    (same int8 values, replicated scale sidecar — no requantization)."""
+    if not isinstance(converted, ConvertedLinear):
+        raise TypeError(
+            f"expected quantization.ConvertedLinear, got "
+            f"{type(converted).__name__}")
+    if converted.act_scale is not None:
+        if strict:
+            raise ValueError(
+                "ConvertedLinear carries a calibrated act_scale; the "
+                "weight-only serving path drops activation snapping — "
+                "pass strict=False to bridge weight-only anyway")
+    q = converted.weight_int8.numpy()
+    k, n = q.shape
+    b = effective_block(k, block)
+    kb = -(-k // b)
+    # ConvertedLinear dequants w = q * (scale / 127): replicating that
+    # value into every [kb, n] slot reproduces the identical products
+    per_block = float(np.asarray(converted.weight_scale.numpy(),
+                                 np.float32)) / 127.0
+    scales = np.full((kb, n), per_block, np.float32)
+    return WeightOnlyLinear(q, scales, bias=converted.bias, block=b)
+
+
+def bridge_model(model, block=None, strict=True):
+    """Swap every ``ConvertedLinear`` under ``model`` (in place) for
+    its bridged serving form; returns the number swapped."""
+    count = 0
+
+    def walk(layer):
+        nonlocal count
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, ConvertedLinear):
+                layer._sub_layers[name] = \
+                    bridge_linear(sub, block=block, strict=strict)
+                count += 1
+            else:
+                walk(sub)
+
+    walk(model)
+    return count

@@ -1,0 +1,195 @@
+"""The quantized weight format and model-level quantize APIs.
+
+Format (one weight ``w [K, N]``, contraction axis K):
+
+- ``q      [K, N]  int8`` — the quantized values, same layout as ``w``;
+- ``scales [ceil(K/B), N]  f32`` — per-(row-block, column) absmax
+  scales: ``scales[kb, n] = max(|w[kb*B:(kb+1)*B, n]|) / 127``, so
+  ``w[k, n] ~= q[k, n] * scales[k // B, n]``.
+
+B (the block size) is the knob: ``PADDLE_TPU_WEIGHT_BLOCK`` fleet-wide,
+or per call. The layout is deliberately *tile-streamable*: a VMEM tile
+of ``B`` weight rows carries exactly one contiguous scale row
+``scales[kb, :]`` (N minor in both arrays), so the later megakernel
+stage can stream ``(int8 rows, their scales)`` pairs without a gather —
+the same sidecar-rides-the-same-index pattern the int8 KV pages use.
+
+Stacked MoE expert weights ``[E, K, N]`` quantize per expert to
+``[E, K, N]`` int8 + ``[E, ceil(K/B), N]`` scales.
+
+``quantize_model`` swaps every ``nn.Linear`` under the model for a
+:class:`~paddle_tpu.quant.layers.WeightOnlyLinear` and asks layers that
+expose ``quantize_weights(block)`` (the stacked-expert MoE FFN) to
+self-quantize. ``lm_head`` is skipped by default: the output projection
+is the most quality-sensitive matmul, its weight is shared with the
+fused-CE training path, and at ~vocab x hidden it is a small fraction
+of decode bytes on real configs — the standard weight-only recipe.
+Embeddings are lookups, not matmuls, and stay float too.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+#: default per-block rows covered by one scale row — 128 matches the
+#: MXU/lane tile so a kernel weight tile never straddles a scale row
+DEFAULT_BLOCK = 128
+
+
+def _raw(a):
+    return a._data if isinstance(a, Tensor) else jnp.asarray(a)
+
+
+def default_block():
+    """Fleet default block size (``PADDLE_TPU_WEIGHT_BLOCK`` wins)."""
+    env = os.environ.get("PADDLE_TPU_WEIGHT_BLOCK", "")
+    return int(env) if env else DEFAULT_BLOCK
+
+
+def effective_block(k, block=None):
+    """The block size actually used for a contraction dim of ``k``:
+    the requested (or default) block, clamped to ``k`` — a weight
+    shorter than one block gets exactly one scale row, and the clamped
+    value keeps ``K % B == 0`` for kernel-friendly shapes like
+    ``K < DEFAULT_BLOCK`` tiny configs."""
+    b = int(block) if block else default_block()
+    if b <= 0:
+        raise ValueError(f"weight block must be positive, got {b}")
+    return min(b, int(k))
+
+
+def quantize_weight(w, block=None):
+    """``[*, K, N]`` float -> ``([*, K, N] int8, [*, ceil(K/B), N] f32)``.
+
+    Symmetric per-block absmax: each scale is ``absmax / 127`` so the
+    full block range maps onto ``[-127, 127]`` (-128 unused, keeping
+    the grid symmetric). An all-zero block gets scale 0 and dequantizes
+    to exact zeros."""
+    arr = _raw(w).astype(jnp.float32)
+    if arr.ndim < 2:
+        raise ValueError(f"weight must be at least 2-D, got {arr.shape}")
+    k, n = arr.shape[-2], arr.shape[-1]
+    b = effective_block(k, block)
+    kb = -(-k // b)
+    pad = kb * b - k
+    if pad:
+        cfg = [(0, 0)] * (arr.ndim - 2) + [(0, pad), (0, 0)]
+        arr = jnp.pad(arr, cfg)
+    blocked = arr.reshape(arr.shape[:-2] + (kb, b, n))
+    scales = (jnp.max(jnp.abs(blocked), axis=-2) / 127.0) \
+        .astype(jnp.float32)
+    q = jnp.clip(
+        jnp.round(blocked / jnp.maximum(scales, 1e-12)[..., None, :]),
+        -127, 127).astype(jnp.int8)
+    q = q.reshape(arr.shape[:-2] + (kb * b, n))[..., :k, :]
+    return q, scales
+
+
+def dequantize_weight(q, scales, block=None):
+    """Exact inverse map of the format: ``q * scales`` broadcast over
+    row blocks, f32 out. ``block`` must be the value quantization used
+    (the default resolves the same knob ``quantize_weight`` did)."""
+    qa, sa = _raw(q), _raw(scales)
+    k = qa.shape[-2]
+    b = effective_block(k, block)
+    if sa.shape[-2] != -(-k // b):
+        raise ValueError(
+            f"scales rows {sa.shape[-2]} do not match ceil({k}/{b}); "
+            "pass the block size the weight was quantized with")
+    s = jnp.repeat(sa.astype(jnp.float32), b, axis=-2)[..., :k, :]
+    return qa.astype(jnp.float32) * s
+
+
+def quantize_model(model, block=None, skip=("lm_head",)):
+    """Swap every quantizable layer under ``model`` (in place) for its
+    weight-only int8 serving form. Returns the model; raises if nothing
+    was quantizable (a config error, not a silent no-op).
+
+    - ``nn.Linear`` -> :class:`WeightOnlyLinear` (int8 + scale buffers,
+      dequant-on-use forward);
+    - layers exposing ``quantize_weights(block)`` (the stacked-expert
+      ``LlamaMoEMLP``) self-quantize in place;
+    - attribute names in ``skip`` (default: ``lm_head``) stay float.
+    """
+    from .. import nn
+    from .layers import WeightOnlyLinear
+
+    count = 0
+
+    def walk(layer):
+        nonlocal count
+        for name, sub in list(layer._sub_layers.items()):
+            if name in skip:
+                continue
+            if isinstance(sub, WeightOnlyLinear):
+                count += 1
+            elif isinstance(sub, nn.Linear):
+                layer._sub_layers[name] = \
+                    WeightOnlyLinear.from_linear(sub, block=block)
+                count += 1
+            elif hasattr(sub, "quantize_weights"):
+                if not getattr(sub, "weight_block", None):
+                    sub.quantize_weights(block)
+                count += 1
+            else:
+                walk(sub)
+
+    walk(model)
+    if count == 0:
+        raise ValueError(
+            "quantize_model found no quantizable layers (nn.Linear or "
+            "quantize_weights-capable) under the model")
+    return model
+
+
+def is_quantized(model):
+    """True when any layer under ``model`` is in the weight-only form."""
+    from .layers import WeightOnlyLinear
+
+    for _, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, WeightOnlyLinear):
+            return True
+        if getattr(sub, "weight_block", None):
+            return True
+    return False
+
+
+def model_weight_block(model):
+    """The block size of a quantized model (first quantized layer
+    found), or None when the model is float."""
+    from .layers import WeightOnlyLinear
+
+    for _, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, WeightOnlyLinear):
+            return sub.weight_block
+        b = getattr(sub, "weight_block", None)
+        if b:
+            return int(b)
+    return None
+
+
+def serving_weight_bytes(model):
+    """``(actual_bytes, bf16_baseline_bytes, weight_elems)`` over the
+    model's state (params + persistable buffers).
+
+    ``actual_bytes`` counts everything as stored — int8 weights, f32
+    scale sidecars, float leftovers (embeddings, norms, lm_head).
+    ``bf16_baseline_bytes`` is what the same *weights* would cost at
+    bf16 (2 bytes/elem, sidecars excluded — they don't exist in the
+    float model). The ratio is the serving capacity win; per-param
+    bytes (``actual / elems``) feeds the
+    ``serving_weight_bytes_per_param`` gauge."""
+    actual = baseline = elems = 0
+    for name, t in model.state_dict().items():
+        arr = _raw(t)
+        nbytes = int(arr.size) * jnp.dtype(arr.dtype).itemsize
+        actual += nbytes
+        if name.rsplit(".", 1)[-1].endswith("_scale"):
+            continue        # sidecar: real bytes, not a weight elem
+        elems += int(arr.size)
+        baseline += 2 * int(arr.size)
+    return actual, baseline, elems

@@ -1,0 +1,88 @@
+"""``WeightOnlyLinear`` — the serving form of ``nn.Linear``.
+
+Weight lives as int8 + per-block f32 scales (persistable *buffers*, so
+they ride ``state_dict`` / checkpointing and trace into compiled
+serving programs through ``StaticFunction``'s state collection), and
+the forward dequantizes on use through :func:`dequant_matmul` — Pallas
+in VMEM on TPU, the exact XLA formulation elsewhere. Bias (when the
+source layer had one) stays a float Parameter.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..framework.tensor import Tensor
+from .format import effective_block, quantize_weight
+from .kernels import dequant_matmul
+
+__all__ = ["WeightOnlyLinear"]
+
+
+class WeightOnlyLinear(nn.Layer):
+    """Drop-in dequant-on-use linear: ``y = x @ (q * scales) (+ b)``.
+
+    Construct from pre-quantized data (the checkpoint / QAT-bridge
+    path) or via :meth:`from_linear` (quantize a float layer). The
+    block size is part of the layer (it shapes the scale sidecar and
+    the kernel's tiling), not re-derived per call.
+    """
+
+    def __init__(self, weight_int8, weight_scale, bias=None, block=None):
+        super().__init__()
+        q = weight_int8.numpy() if isinstance(weight_int8, Tensor) \
+            else np.asarray(weight_int8)
+        s = weight_scale.numpy() if isinstance(weight_scale, Tensor) \
+            else np.asarray(weight_scale)
+        if q.ndim != 2 or s.ndim != 2:
+            raise ValueError(
+                f"expected 2-D weight + scales, got {q.shape} / "
+                f"{s.shape}")
+        self.in_features, self.out_features = int(q.shape[0]), \
+            int(q.shape[1])
+        self.weight_block = effective_block(self.in_features, block)
+        kb = -(-self.in_features // self.weight_block)
+        if s.shape != (kb, self.out_features):
+            raise ValueError(
+                f"scales {s.shape} do not match ceil({self.in_features}"
+                f"/{self.weight_block}) x {self.out_features}")
+        self.register_buffer("weight_int8",
+                             Tensor(np.ascontiguousarray(q, np.int8)))
+        self.register_buffer("weight_scale",
+                             Tensor(np.ascontiguousarray(s, np.float32)))
+        self.bias = bias
+
+    @classmethod
+    def from_linear(cls, linear, block=None):
+        """Quantize a float ``nn.Linear`` into the serving form (the
+        float weight is dropped; bias is carried over as-is)."""
+        b = effective_block(linear.weight.shape[-2], block)
+        q, s = quantize_weight(linear.weight, b)
+        return cls(np.asarray(q), np.asarray(s), bias=linear.bias,
+                   block=b)
+
+    def forward(self, x):
+        y = dequant_matmul(x, self.weight_int8, self.weight_scale,
+                           self.weight_block)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+    def to(self, device=None, dtype=None, blocking=None):
+        # model-wide dtype casts (``model.bfloat16()``) must not touch
+        # the format's invariants: int8 weights are non-floating
+        # (Layer.to skips them) and the f32 scale sidecars are pinned
+        # here — bf16 scales would change the dequant products and
+        # fail the kernel's supported() gate
+        out = super().to(device=device, dtype=dtype, blocking=blocking)
+        import jax.numpy as jnp
+        s = self._buffers["weight_scale"]
+        if s._data.dtype != jnp.float32:
+            s._data = s._data.astype(jnp.float32)
+        return out
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"block={self.weight_block}, "
+                f"bias={self.bias is not None}")

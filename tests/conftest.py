@@ -36,7 +36,8 @@ _WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
                         "test_subprocess_cluster",
                         "test_chunked_scheduler", "test_speculative",
                         "test_moe_serving", "test_partition_tolerance",
-                        "test_ragged_attention", "test_fused_ce"}
+                        "test_ragged_attention", "test_fused_ce",
+                        "test_weight_quant"}
 
 # per-module budgets where the default is wrong: subprocess-cluster
 # tests legitimately wait out several worker-process startups (import +
@@ -62,7 +63,11 @@ _WEDGE_BUDGETS = {"test_subprocess_cluster": 700.0,
                   # donated train-step + memory-analysis tests compile
                   # several full fwd+bwd programs, and the Pallas parity
                   # tests run the interpreter
-                  "test_fused_ce": 600.0}
+                  "test_fused_ce": 600.0,
+                  # the quality-gate test fits a model on the bundled
+                  # prompts (40 Adam steps) and the engine-knob tests
+                  # build several serving engines
+                  "test_weight_quant": 600.0}
 
 
 @pytest.fixture(autouse=True)
