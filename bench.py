@@ -1459,6 +1459,70 @@ def bench_frontend(model, on_tpu=True):
     }
 
 
+def bench_trace_overhead(model, on_tpu=True):
+    """Distributed-tracing tax at the cluster tier: tokens/sec through
+    a ServingCluster with a per-request trace context active (route +
+    admit + first-token spans mint and record) vs plain dispatch.
+    ``trace_overhead_frac`` is the fractional rate loss; the gate
+    ``trace_overhead_ok`` requires <= 3%."""
+    from paddle_tpu.inference.cluster import ServingCluster
+    from paddle_tpu.inference.serving import LlamaServingEngine
+    from paddle_tpu.observability import tracing as _tracing
+
+    model.eval()
+    # each timed run must be long enough that per-span cost (~µs) is
+    # resolvable above scheduler jitter — sub-second runs gate on noise
+    max_batch = 8 if on_tpu else 2
+    new_tokens = 48 if on_tpu else 64
+    n_reqs = 24 if on_tpu else 12
+    rounds = 3 if on_tpu else 4
+    cluster = ServingCluster(
+        engine_factory=lambda: LlamaServingEngine(
+            model, max_batch=max_batch, page_size=64,
+            num_pages=max_batch * 8 + 8, max_pages_per_seq=8,
+            prefix_cache=False),
+        num_replicas=1, max_backlog=n_reqs * 2)
+    cluster.start()
+    rng = np.random.RandomState(0)
+    v = model.config.vocab_size
+    prompts = [rng.randint(0, v, (24,)).tolist() for _ in range(n_reqs)]
+
+    def run(traced):
+        reqs = []
+        t0 = time.perf_counter()
+        for p in prompts:
+            if traced:
+                with _tracing.activate(_tracing.mint()):
+                    reqs.append(cluster.submit(
+                        p, max_new_tokens=new_tokens))
+            else:
+                reqs.append(cluster.submit(p, max_new_tokens=new_tokens))
+        for r in reqs:
+            r.wait(300.0)
+        wall = time.perf_counter() - t0
+        return sum(len(r.output_ids) for r in reqs) / wall
+
+    run(False)                  # warm: compile the serving programs
+    on, off = [], []
+    for _ in range(rounds):     # interleave to share thermal/jit drift
+        off.append(run(False))
+        on.append(run(True))
+    cluster.stop()
+    model.train()
+    # best-of per mode: external noise (scheduler preemption, a
+    # neighbor's compile) only ever SLOWS a run, so the per-mode max is
+    # the noise-robust estimate of true capability — a mean would gate
+    # on whichever mode drew the unluckier rounds
+    tps_on, tps_off = max(on), max(off)
+    frac = round(max(0.0, 1.0 - tps_on / max(tps_off, 1e-9)), 3)
+    return {
+        "trace_tokens_per_sec_on": round(tps_on, 1),
+        "trace_tokens_per_sec_off": round(tps_off, 1),
+        "trace_overhead_frac": frac,
+        "trace_overhead_ok": bool(frac <= 0.03),
+    }
+
+
 def bench_fused_ce(on_tpu=True):
     """Chunked fused cross-entropy lm-head vs the materialized logits
     path at an 8k+ vocab config: fwd+bwd step time, static peak-memory
@@ -1806,6 +1870,13 @@ def main():
     except Exception as e:
         log(f"frontend bench failed: {e!r:.300}")
         result["frontend_error"] = repr(e)[:200]
+
+    try:
+        model = bench_train_step.last_model
+        result.update(bench_trace_overhead(model, on_tpu=on_tpu))
+    except Exception as e:
+        log(f"trace-overhead bench failed: {e!r:.300}")
+        result["trace_overhead_error"] = repr(e)[:200]
 
     try:
         result.update(bench_fused_ce(on_tpu=on_tpu))

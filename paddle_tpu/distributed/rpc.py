@@ -27,6 +27,7 @@ Deterministic chaos rides :func:`paddle_tpu.testing.faults
 from __future__ import annotations
 
 import collections
+import contextlib
 import itertools
 import os
 import pickle
@@ -34,6 +35,8 @@ import random
 import threading
 import time
 
+from ..observability import trace as _otrace
+from ..observability import tracing as _tracing
 from ..testing import faults as _faults
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
@@ -220,21 +223,53 @@ class _RpcAgent:
             caller = None
             try:
                 msg = pickle.loads(payload)
-                if len(msg) == 5:
+                tr = None
+                if len(msg) >= 5:
                     # dedup envelope: a redelivered request (network
                     # duplicate, or a retry whose original executed
                     # but whose reply was lost) must NOT run the
-                    # handler again — republish the cached reply
-                    caller, cid, fn, args, kwargs = msg
+                    # handler again — republish the cached reply.
+                    # A 6th element is the optional trace context
+                    # (absent entirely when the caller traced nothing
+                    # — the envelope stays on the pre-trace layout).
+                    caller, cid, fn, args, kwargs = msg[:5]
+                    tr = msg[5] if len(msg) > 5 else None
                     call_key = (caller, cid)
                     cached = self._reply_cache.get(call_key)
                     if cached is not None:
                         self._m_dups.inc()
                         reply = cached[0]
+                        rctx = _tracing.extract(tr)
+                        if rctx is not None:
+                            # tag the suppressed redelivery in the
+                            # trace: a zero-width child of the call
+                            # span, so retries that hit the dedup
+                            # cache are visible on the timeline
+                            with _otrace.span("rpc.dedup",
+                                              trace_ctx=rctx.child(),
+                                              caller=str(caller),
+                                              suppressed=True):
+                                pass
                 else:
                     fn, args, kwargs = msg      # legacy envelope
                 if reply is None:
-                    reply = b"ok:" + pickle.dumps(fn(*args, **kwargs))
+                    rctx = _tracing.extract(tr)
+                    if rctx is None:
+                        reply = b"ok:" + pickle.dumps(
+                            fn(*args, **kwargs))
+                    else:
+                        # restore the caller's context: the handler
+                        # span (and anything the handler itself
+                        # spans or injects downstream) chains to the
+                        # remote call span
+                        with _tracing.activate(rctx), \
+                                _otrace.span(
+                                    "rpc.handle",
+                                    fn=getattr(fn, "__name__",
+                                               str(fn)),
+                                    endpoint=str(self.name)):
+                            reply = b"ok:" + pickle.dumps(
+                                fn(*args, **kwargs))
             except Exception as e:
                 reply = b"er:" + pickle.dumps(e)
             if call_key is not None:
@@ -302,31 +337,59 @@ class _RpcAgent:
             # attempt's default budget
             timeout = _default_rpc_timeout()
         cid = (self._incarnation, next(self._call_ids))
-        payload = pickle.dumps(
-            (self.name, cid, fn, args or (), kwargs or {}))
+        env = (self.name, cid, fn, args or (), kwargs or {})
+        # trace propagation: with an active context, mint ONE child
+        # span for the logical call and append its wire fields as a
+        # 6th envelope element. The SAME envelope is re-sent on every
+        # retry, so however many deliveries happen, the callee's spans
+        # all chain to this one call node. With no active trace (or
+        # under PADDLE_TPU_METRICS=0) the envelope stays byte-for-byte
+        # on the 5-element pre-trace layout.
+        call_ctx = None
+        tctx = _tracing.current()
+        if tctx is not None:
+            call_ctx = tctx.child()
+            env = env + (call_ctx.to_wire(),)
+        payload = pickle.dumps(env)
         # per-attempt budget + worst-case backoff + slack: the driver
         # thread decides the typed error, wait() is a backstop
         total = attempts * timeout + sum(
             min(backoff_max, backoff * (2 ** i))
             for i in range(attempts - 1)) + 5.0
         fut = _FutureReply(to=to, seq=None, timeout=total)
+        fname = getattr(fn, "__name__", str(fn))
 
         def driver():
             delay = backoff
             last_err = None
+            # the driver runs on its own thread (fresh contextvars):
+            # record the call span under the exact identity the
+            # envelope carries, with per-attempt child spans so
+            # retries are visible on the timeline
+            call_span = _otrace.span("rpc.call", trace_ctx=call_ctx,
+                                     to=str(to), fn=fname) \
+                if call_ctx is not None else contextlib.nullcontext()
             try:
-                for attempt in range(attempts):
-                    if attempt:
-                        self._m_retries.inc()
-                        time.sleep(
-                            delay * (1.0 + 0.25 * random.random()))
-                        delay = min(backoff_max, delay * 2.0)
-                    err = self._attempt(to, payload, timeout, fut)
-                    if err is None:
-                        return          # fut already resolved
-                    last_err = err
-                    if not isinstance(err, RpcTimeoutError):
-                        break           # transport broke, not a loss
+                with call_span:
+                    for attempt in range(attempts):
+                        if attempt:
+                            self._m_retries.inc()
+                            time.sleep(
+                                delay * (1.0 + 0.25 * random.random()))
+                            delay = min(backoff_max, delay * 2.0)
+                        att_span = _otrace.span(
+                            "rpc.attempt", to=str(to),
+                            attempt=attempt, retry=bool(attempt)) \
+                            if call_ctx is not None \
+                            else contextlib.nullcontext()
+                        with att_span:
+                            err = self._attempt(to, payload, timeout,
+                                                fut)
+                        if err is None:
+                            return      # fut already resolved
+                        last_err = err
+                        if not isinstance(err, RpcTimeoutError):
+                            break       # transport broke, not a loss
             except Exception as e:      # noqa: BLE001 — a dying driver
                 last_err = e            # must resolve, never strand
             fut._set(None, last_err)

@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import collections
 import json
+import logging
 import os
 import random
 import tempfile
@@ -71,6 +72,10 @@ import numpy as np
 from ..distributed.watchdog import (ElasticManager, FileStore,
                                     StaleEpochError)
 from ..observability import metrics as _om
+from ..observability import slo as _slo
+from ..observability import tracing as _tracing
+from ..observability.export import (aggregate_snapshot, json_snapshot,
+                                    merge_snapshots)
 from ..observability.trace import span as _span
 from ..testing import faults as _faults
 from .sampling import SamplingParams
@@ -147,6 +152,10 @@ def _router_metrics():
             "serving_prefix_affinity_hits_total",
             "requests routed to a replica advertising their prompt's "
             "prefix in its hot-prefix set"),
+        "scrape_failures": _om.counter(
+            "cluster_scrape_failures_total",
+            "per-replica metric-scrape rpcs that failed",
+            labelnames=("replica",)),
     }
 
 
@@ -193,6 +202,11 @@ class ClusterRequest:
         #: token by an IN-PROCESS engine attempt (subprocess replicas
         #: surface partials through :meth:`partial_output` instead)
         self.on_token = on_token
+        #: the distributed trace node this request belongs to, captured
+        #: from the ambient context at construction (the frontend's
+        #: request span, or the rpc.handle span in a subprocess worker)
+        #: so replica-side spans can chain to it from other threads
+        self._trace = _tracing.current()
         self.failovers = 0
         self.request: Request | None = None   # current engine attempt
         self.replica_id = None
@@ -657,8 +671,20 @@ class EngineReplica:
             if req is None:
                 self._unpend(creq)
                 continue        # finished typed (cluster deadline)
+            # thread the request's trace context onto the worker
+            # thread: the admit span chains to the submitter's span
+            # tree, and the engine request carries the context so the
+            # first-token emit can tag itself too
+            req._trace = creq._trace
             try:
-                e._admit(req)
+                if creq._trace is not None:
+                    with _tracing.activate(creq._trace), \
+                            _span("serving.admit",
+                                  replica=self.replica_id,
+                                  prompt_len=len(creq.prompt_ids)):
+                        e._admit(req)
+                else:
+                    e._admit(req)
             except AdmissionError:
                 with self._lock:
                     self._backlog.appendleft(creq)
@@ -888,6 +914,10 @@ class SubprocessReplica:
         # request must hit compiled programs, not the compile bill
         env.setdefault("PADDLE_TPU_SERVING_PREWARM",
                        "1" if self._prewarm else "0")
+        if self.log_dir is not None:
+            # the worker flushes trace shards + flight-recorder
+            # postmortems under the shared log dir (ISSUE 17)
+            env["PADDLE_TPU_REPLICA_LOG_DIR"] = str(self.log_dir)
         out = subprocess.DEVNULL
         if self.log_dir is not None:
             os.makedirs(self.log_dir, exist_ok=True)
@@ -1259,13 +1289,15 @@ class _RestartState:
     its death has been processed, when the next (backed-off) restart is
     due, and whether the crash-loop breaker holds it out."""
 
-    __slots__ = ("deaths", "down", "restart_at", "quarantined")
+    __slots__ = ("deaths", "down", "restart_at", "quarantined",
+                 "postmortem")
 
     def __init__(self):
         self.deaths = collections.deque(maxlen=64)  # monotonic stamps
         self.down = False
         self.restart_at = None
         self.quarantined = False
+        self.postmortem = None      # newest harvested postmortem dir
 
 
 class ServingCluster:
@@ -1322,7 +1354,7 @@ class ServingCluster:
                  restart_jitter=0.25, breaker_threshold=5,
                  breaker_window=30.0, spawn_grace=180.0,
                  submit_timeout=15.0, log_dir=None, prewarm=True,
-                 affinity_weight=1.0):
+                 affinity_weight=1.0, slo_interval=5.0, slos=None):
         if engine_factory is None and engine_spec is None:
             raise ValueError(
                 "ServingCluster needs engine_factory (in-process "
@@ -1367,6 +1399,14 @@ class ServingCluster:
         self._m = _router_metrics()
         self._route_count = 0
         self._started = False
+        # SLO burn-rate engine: fed cluster-aggregated TTFT/TPOT
+        # histograms by the sweep every ``slo_interval`` seconds;
+        # surfaces on membership_info() and the
+        # serving_slo_burn_rate{slo,window} gauge
+        self.slo_interval = float(slo_interval)
+        self.slo = _slo.SloEngine(slos=slos)
+        self._slo_last = 0.0
+        self._slo_burn = {}
 
     # ------------------------------------------------------------------
     def _make_replica(self, rid):
@@ -1442,6 +1482,9 @@ class ServingCluster:
         grepping logs."""
         out = {}
         quarantined = self.quarantined()
+        with self._lock:
+            postmortems = {rid: st.postmortem
+                           for rid, st in self._restarts.items()}
         for rid, rep in self.replicas().items():
             out[rid] = {
                 "epoch": getattr(rep, "epoch", None),
@@ -1449,16 +1492,107 @@ class ServingCluster:
                 "alive": rep.alive(),
                 "ready": rep.ready(),
                 "quarantined": rid in quarantined,
+                "postmortem": postmortems.get(rid),
             }
-        return {"membership": out}
+        info = {"membership": out}
+        if _om.enabled():
+            info["slo_burn_rates"] = self._slo_tick()
+        return info
 
     def start_http_server(self, port=0, addr="127.0.0.1"):
-        """Metrics + /healthz + /readyz endpoint for the whole tier.
-        /healthz carries :meth:`membership_info` (epochs + heartbeat
-        ages)."""
+        """One-pane endpoint for the whole tier: ``/metrics`` and
+        ``/metrics.json`` render the *merged* cluster scrape (every
+        replica's registry under a ``replica`` label — see
+        :meth:`scrape`), ``/healthz`` carries :meth:`membership_info`
+        (epochs + heartbeat ages + SLO burn rates)."""
         from ..observability.export import start_http_server
         return start_http_server(port=port, addr=addr, ready=self.ready,
-                                 health_info=self.membership_info)
+                                 health_info=self.membership_info,
+                                 snapshot_fn=self.scrape)
+
+    # -- one-pane observability ----------------------------------------
+    def scrape(self):
+        """Cluster-wide metrics snapshot: every subprocess replica's
+        registry (pulled over the rpc path) plus this router process's
+        own, merged under a ``replica`` label (``replica="router"`` for
+        the local registry). In-process replicas share the router's
+        registry, so they are already covered by the local snapshot.
+        A replica whose scrape rpc fails is skipped (and counted on
+        ``cluster_scrape_failures_total``) — one sick replica must not
+        blank the pane."""
+        sources = []
+        if self._spec is not None and self._endpoint is not None:
+            from . import replica_worker as _rw
+            for rid, rep in self.replicas().items():
+                if not rep.alive():
+                    continue
+                try:
+                    rsp = self._endpoint.call_sync(
+                        rid, _rw._worker_scrape, (),
+                        timeout=2.0, retries=1)
+                    sources.append((rid, rsp.get("snapshot") or []))
+                except Exception:
+                    self._m["scrape_failures"].labels(rid).inc()
+        sources.append(("router", json_snapshot()))
+        return merge_snapshots(sources)
+
+    def _slo_tick(self, force=False):
+        """Feed the SLO engine one cumulative TTFT/TPOT point from the
+        cluster-aggregated scrape (rate-limited to ``slo_interval``)."""
+        if not _om.enabled():
+            return self._slo_burn
+        now = time.monotonic()
+        if not force and now - self._slo_last < self.slo_interval:
+            return self._slo_burn
+        self._slo_last = now
+        agg = {e["name"]: e for e in aggregate_snapshot(self.scrape())}
+        for spec in self.slo.slos:
+            entry = agg.get(spec.metric)
+            if entry is None or entry.get("type") != "histogram":
+                continue
+            buckets = counts = None
+            for sample in entry.get("samples", ()):
+                if buckets is None:
+                    buckets = list(sample["buckets"])
+                    counts = list(sample["counts"])
+                elif list(sample["buckets"]) == buckets:
+                    counts = [a + b for a, b
+                              in zip(counts, sample["counts"])]
+            if buckets is not None:
+                self.slo.observe(spec.name, buckets, counts, now=now)
+        self._slo_burn = self.slo.burn_rates(now=now)
+        return self._slo_burn
+
+    def collect_trace(self, path=None):
+        """Harvest every worker's span shard from ``log_dir`` plus this
+        process's own live span ring and merge them into ONE Perfetto-
+        loadable chrome-trace document, shard timestamps shifted onto a
+        common clock via each process's recorded monotonic<->epoch
+        offset (see ``tracing.merge_shards``). ``path`` additionally
+        writes the JSON there. Returns the merged document (``None``
+        under ``PADDLE_TPU_METRICS=0``)."""
+        if not _om.enabled():
+            return None
+        shards = []
+        if self.log_dir is not None:
+            shards.extend(_tracing.harvest_shards(self.log_dir))
+        shards.append(_tracing.local_shard("router"))
+        merged = _tracing.merge_shards(shards)
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(merged, f)
+        return merged
+
+    def request_trace(self, trace_id):
+        """One request's cross-process timeline: the parent-linked span
+        tree for ``trace_id`` assembled from the merged cluster trace
+        (what ``GET /v1/requests/<id>/trace`` serves)."""
+        merged = self.collect_trace()
+        if merged is None:
+            return {"trace_id": trace_id, "spans": []}
+        return {"trace_id": trace_id,
+                "spans": _tracing.span_tree(merged["traceEvents"],
+                                            trace_id)}
 
     # -- routing --------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
@@ -1626,6 +1760,7 @@ class ServingCluster:
             quarantined = sum(1 for s in self._restarts.values()
                               if s.quarantined)
         self._m["quarantined_now"].set(quarantined)
+        self._slo_tick()
 
     def _backoff_delay(self, st, now):
         """Restart delay from the deaths inside the breaker window:
@@ -1667,8 +1802,32 @@ class ServingCluster:
             pass
         for creq in orphans:
             self._failover(creq, dead_rid=rid)
+        self._harvest_postmortem(rid, rep, st)
         st.down = True
         self._record_death(rid, st)
+
+    def _harvest_postmortem(self, rid, rep, st):
+        """A subprocess worker's fatal handler dumps a flight-recorder
+        bundle under ``<log_dir>/<rid>/postmortem/<run>``; record the
+        newest one on the replica's restart state so an operator (or
+        ``stats()``) finds it without grepping the log dir. Run names
+        sort lexicographically ~= chronologically."""
+        log_dir = getattr(rep, "log_dir", None)
+        if log_dir is None:
+            return
+        pm_dir = os.path.join(str(log_dir), rid, "postmortem")
+        try:
+            bundles = sorted(os.listdir(pm_dir))
+        except OSError:
+            return
+        if not bundles:
+            return
+        path = os.path.join(pm_dir, bundles[-1])
+        if path == st.postmortem:
+            return              # same bundle as the previous death
+        st.postmortem = path
+        logging.getLogger("paddle_tpu.cluster").warning(
+            "replica %s died; postmortem bundle at %s", rid, path)
 
     def _try_restart(self, rid, rep, st):
         """One backed-off restart attempt. A failed spawn (serve.spawn

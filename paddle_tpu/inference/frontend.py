@@ -48,14 +48,18 @@ completions API's token-array form).
 
 from __future__ import annotations
 
+import collections
 import json
 import queue
+import threading
 import time
 import uuid
 
 from ..observability import metrics as _om
+from ..observability import tracing as _tracing
 from ..observability.export import (ClientDisconnected, HttpService,
                                     add_probe_routes)
+from ..observability.trace import span as _span
 from .sampling import SamplingParams
 from .serving import AdmissionError, DeadlineExceeded
 
@@ -185,6 +189,11 @@ class ServingFrontend:
         self._replica = None          # local worker over engine=
         self._svc = None
         self._t0 = time.time()
+        # request id -> trace id, bounded: what GET
+        # /v1/requests/<id>/trace resolves through
+        self._traces = collections.OrderedDict()
+        self._traces_cap = 1024
+        self._traces_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -208,6 +217,7 @@ class ServingFrontend:
         svc.route("/v1/chat/completions", self._chat_completions,
                   methods=("POST",))
         svc.route("/v1/models", self._models)
+        svc.route_prefix("/v1/requests/", self._request_trace)
         add_probe_routes(svc, ready=self._ready,
                          health_info=self._health_info)
         self._svc = svc.start()
@@ -365,6 +375,57 @@ class ServingFrontend:
         self._handle_generate(ctx, chat=True)
 
     def _handle_generate(self, ctx, chat):
+        """Trace-context front door: adopt the caller's W3C
+        ``traceparent`` (or mint a fresh root) and activate it for the
+        whole handler — every span below (routing, rpc, admission,
+        first token, SSE) chains to it, across processes."""
+        tctx = _tracing.adopt(ctx.headers.get("traceparent"))
+        if tctx is None:        # PADDLE_TPU_METRICS=0: plain dispatch
+            return self._generate_impl(ctx, chat, None)
+        with _tracing.activate(tctx), \
+                _span("frontend.request",
+                      endpoint="chat" if chat else "completions"):
+            return self._generate_impl(ctx, chat, tctx)
+
+    def _remember_trace(self, rid, trace_id):
+        with self._traces_lock:
+            self._traces[rid] = trace_id
+            while len(self._traces) > self._traces_cap:
+                self._traces.popitem(last=False)
+
+    def _request_trace(self, ctx):
+        """``GET /v1/requests/<id>/trace`` — one request's merged
+        cross-process timeline as a parent-linked span tree."""
+        parts = ctx.path.split("/")
+        if len(parts) != 5 or parts[4] != "trace":
+            self._m["requests"].labels("trace", "404").inc()
+            ctx.send_json(404, {"error": {
+                "message": f"unknown path {ctx.path!r} (expected "
+                           f"/v1/requests/<id>/trace)",
+                "type": "invalid_request_error"}})
+            return
+        rid = parts[3]
+        with self._traces_lock:
+            trace_id = self._traces.get(rid)
+        if trace_id is None:
+            self._m["requests"].labels("trace", "404").inc()
+            ctx.send_json(404, {"error": {
+                "message": f"no trace for request id {rid!r} (evicted, "
+                           f"never traced, or tracing disabled)",
+                "type": "invalid_request_error"}})
+            return
+        if self.cluster is not None:
+            doc = self.cluster.request_trace(trace_id)
+        else:
+            from ..observability import trace as _otrace
+            doc = {"trace_id": trace_id,
+                   "spans": _tracing.span_tree(_otrace.get_events(),
+                                               trace_id)}
+        doc["request_id"] = rid
+        self._m["requests"].labels("trace", "200").inc()
+        ctx.send_json(200, doc)
+
+    def _generate_impl(self, ctx, chat, tctx):
         endpoint = "chat" if chat else "completions"
         t_start = time.perf_counter()
 
@@ -429,6 +490,8 @@ class ServingFrontend:
             return
 
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:24]}"
+        if tctx is not None:
+            self._remember_trace(rid, tctx.trace_id)
         if stream:
             self._stream_response(ctx, creq, grant, rid, chat,
                                   endpoint, len(ids), timeout, t_start,

@@ -46,6 +46,14 @@ Environment contract (set by :class:`SubprocessReplica`):
 - ``PADDLE_TPU_REPLICA_HEALTH_PORT`` — serve ``/metrics`` +
   ``/healthz`` + ``/readyz`` on this port (optional; the actual port is
   written to ``<store>/.http.<id>`` so ``port=0`` works)
+- ``PADDLE_TPU_REPLICA_LOG_DIR`` — the cluster log dir (optional).
+  When set, the worker (a) installs the crash flight recorder with its
+  bundles under ``<log_dir>/<id>/postmortem/`` (the supervisor's death
+  path harvests them), and (b) flushes its span ring to a bounded
+  trace shard ``<log_dir>/trace_shards/<id>.trace.json`` every
+  ``PADDLE_TPU_TRACE_FLUSH`` seconds (default 0.5) for the cluster's
+  merged-trace collector. Both are no-ops under
+  ``PADDLE_TPU_METRICS=0``.
 
 Spec format::
 
@@ -225,6 +233,20 @@ def _worker_drain(grace=30.0):
     return w.rep.drain(grace)
 
 
+def _worker_scrape():
+    """This replica's full registry snapshot (the one-pane metrics
+    feed): the supervisor's ``ServingCluster.scrape()`` pulls these
+    over the existing rpc path and merges them under a ``replica``
+    label. Returns an empty snapshot under ``PADDLE_TPU_METRICS=0``."""
+    from ..observability import metrics as _om
+    from ..observability.export import json_snapshot
+
+    w = _require()
+    snapshot = json_snapshot() if _om.enabled() else []
+    return {"replica": w.replica_id, "pid": os.getpid(),
+            "snapshot": snapshot}
+
+
 def _worker_exit():
     """Clean shutdown: the main loop deregisters from membership and
     exits 0 (the reply is published before the dispatcher yields)."""
@@ -282,8 +304,17 @@ def replica_main():
 
     from ..distributed.rpc import RpcEndpoint
     from ..distributed.watchdog import FileStore
+    from ..observability import flight_recorder as _fr
+    from ..observability import tracing as _tracing
     from .cluster import ClusterRequest, EngineReplica
     from .serving import LlamaServingEngine
+
+    log_dir = os.environ.get("PADDLE_TPU_REPLICA_LOG_DIR")
+    if log_dir:
+        # install BEFORE the engine builds: a crash mid-compile leaves
+        # a postmortem bundle too. Per-replica subdir, so the
+        # supervisor's death path knows exactly whose bundle it found.
+        _fr.install(log_dir=os.path.join(log_dir, replica_id))
 
     model = _build_model(spec.get("model", {}))
     engine_kw = dict(spec.get("engine", {}))
@@ -319,6 +350,12 @@ def replica_main():
     # registration IS the readiness signal the supervisor waits on
     rep.start()
 
+    # monotonic<->epoch clock-offset handshake AT registration: the
+    # collector needs this process's span-clock base to align its
+    # shard with the other processes' timelines (dot-prefixed file:
+    # membership hosts() scans ignore it). No file under METRICS=0.
+    _tracing.record_clock_handshake(store_path, replica_id)
+
     # restart -> serving self-probe: one trivial request through the
     # real admission + prefill + decode path proves every serving
     # program compiles and works — so a COLD worker pays exactly the
@@ -353,15 +390,33 @@ def replica_main():
                   "w") as f:
             f.write(str(srv.port))
 
+    flush_every = float(os.environ.get("PADDLE_TPU_TRACE_FLUSH")
+                        or 0.5)
+    last_flush = 0.0
+
+    def _flush_shard():
+        if log_dir:
+            try:
+                _tracing.write_span_shard(log_dir, replica_id)
+            except Exception:
+                pass    # telemetry must never kill a serving worker
+
     try:
         while not state.stop.wait(0.1):
+            now = time.monotonic()
+            if now - last_flush >= flush_every:
+                last_flush = now
+                _flush_shard()
             if rep._dead:
                 # the worker loop DIED (fault injection, a crash the
                 # fatal-guard re-raised) — as opposed to a deliberate
                 # stop_worker() during a drain, which keeps this
                 # process serving rpc until _worker_exit. Exit unclean
                 # WITHOUT deregistering: a crashed host never says
-                # goodbye; membership TTL is the detector.
+                # goodbye; membership TTL is the detector. The final
+                # shard flush below still happens: the dying worker's
+                # spans are exactly the ones worth merging.
+                _flush_shard()
                 os._exit(17)
             if rep._fenced:
                 # fenced out by a replacement incarnation (stale-epoch
@@ -374,6 +429,7 @@ def replica_main():
         # clean exit: give the dispatcher a beat to flush the
         # _worker_exit reply, then say goodbye properly
         time.sleep(0.3)
+        _flush_shard()
         rep.stop()
         endpoint.stop()
         if srv is not None:

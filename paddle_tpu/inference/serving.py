@@ -132,6 +132,7 @@ from ..incubate.nn import functional as FI
 from ..observability import compile_watch as _cw
 from ..observability import flight_recorder as _fr
 from ..observability import metrics as _om
+from ..observability import tracing as _tracing
 from ..observability.trace import span as _span
 from ..ops.ragged_paged_attention import (fused_ragged_paged_attention,
                                           fused_rope_geometry_ok,
@@ -2348,7 +2349,16 @@ class LlamaServingEngine:
     def _emit(self, req, token):
         first = not req.output_ids
         if first and req._t_admit is not None:
-            self._m["ttft"].observe(time.perf_counter() - req._t_admit)
+            ttft = time.perf_counter() - req._t_admit
+            self._m["ttft"].observe(ttft)
+            # a zero-width marker node in the request's distributed
+            # trace: where the first token landed, on which pid
+            rctx = getattr(req, "_trace", None)
+            if rctx is not None:
+                with _tracing.activate(rctx), \
+                        _span("serving.first_token",
+                              ttft_seconds=round(ttft, 6)):
+                    pass
         # stop tokens are checked BEFORE the append: the request
         # retires ``completed`` with the stop token excluded from its
         # output (the chat-endpoint contract; eos keeps its legacy

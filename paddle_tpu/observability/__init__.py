@@ -2,15 +2,17 @@
 
 The measurement substrate for the serving engine, elastic launcher, and
 training loop: a thread-safe metric registry (`metrics`), a host-span
-tracer with chrome-trace export (`trace`), Prometheus/JSON/HTTP
-exporters (`export`), the XLA compile watcher + device-memory gauges
-(`compile_watch`), and the crash flight recorder (`flight_recorder`).
+tracer with chrome-trace export (`trace`), distributed trace-context
+propagation + cross-process trace merging (`tracing`), Prometheus/
+JSON/HTTP exporters (`export`), the XLA compile watcher +
+device-memory gauges (`compile_watch`), the crash flight recorder
+(`flight_recorder`), and the SLO burn-rate engine (`slo`).
 ``PADDLE_TPU_METRICS=0`` turns the whole layer into no-ops. See README
 "Observability" for the standard metric names.
 """
 
 from . import (  # noqa: F401
-    compile_watch, export, flight_recorder, metrics, trace,
+    compile_watch, export, flight_recorder, metrics, slo, trace, tracing,
 )
 from .compile_watch import (  # noqa: F401
     sample_device_memory, watch, watched_jit,
@@ -23,10 +25,19 @@ from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, counter, default_registry,
     enabled, gauge, histogram,
 )
+from .slo import SloEngine, SloSpec  # noqa: F401
 from .trace import export_chrome_trace, span  # noqa: F401
+from .tracing import (  # noqa: F401
+    TraceContext, activate, adopt, current, format_traceparent,
+    parse_traceparent,
+)
 
 __all__ = [
-    "metrics", "trace", "export", "compile_watch", "flight_recorder",
+    "metrics", "trace", "tracing", "export", "compile_watch",
+    "flight_recorder", "slo",
+    "TraceContext", "current", "activate", "adopt",
+    "parse_traceparent", "format_traceparent",
+    "SloEngine", "SloSpec",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "counter", "gauge", "histogram", "default_registry", "enabled",
     "span", "export_chrome_trace",

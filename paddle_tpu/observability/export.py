@@ -33,7 +33,8 @@ from .metrics import default_registry
 
 __all__ = ["json_snapshot", "snapshot_to_prometheus", "prometheus_text",
            "start_http_server", "ScrapeServer", "HttpService",
-           "HttpContext", "ClientDisconnected", "add_probe_routes"]
+           "HttpContext", "ClientDisconnected", "add_probe_routes",
+           "merge_snapshots", "aggregate_snapshot"]
 
 
 def _fmt_value(v):
@@ -127,6 +128,92 @@ def snapshot_to_prometheus(snapshot):
 def prometheus_text(registry=None):
     """Prometheus text for a registry (the scrape-endpoint body)."""
     return snapshot_to_prometheus(json_snapshot(registry))
+
+
+def merge_snapshots(sources, label="replica"):
+    """Merge per-process :func:`json_snapshot` lists into ONE snapshot
+    with ``label`` prepended to every metric's labelnames — the
+    one-pane cluster view: ``sources`` is an iterable of ``(label_value,
+    snapshot)`` pairs and every sample keeps its original labels behind
+    the new ``label`` value. A source whose entry disagrees with the
+    first-seen schema for a name (different type or labelnames — a
+    version-skewed replica) is skipped for that metric rather than
+    corrupting the pane."""
+    merged, order = {}, []
+    for src_value, snapshot in sources:
+        for entry in snapshot or ():
+            name = entry["name"]
+            names = list(entry.get("labelnames", []))
+            cur = merged.get(name)
+            if cur is None:
+                cur = {"name": name, "help": entry.get("help", ""),
+                       "type": entry["type"],
+                       "labelnames": [label] + names, "samples": []}
+                merged[name] = cur
+                order.append(name)
+            elif (cur["type"] != entry["type"]
+                  or cur["labelnames"][1:] != names):
+                continue
+            for sample in entry.get("samples", ()):
+                s = dict(sample)
+                s["labels"] = ([str(src_value)]
+                               + list(sample.get("labels", [])))
+                cur["samples"].append(s)
+    return [merged[n] for n in order]
+
+
+def _add_json(a, b):
+    # float() accepts the "+Inf"/"-Inf"/"NaN" snapshot markers
+    return _json_value(float(a) + float(b))
+
+
+def aggregate_snapshot(snapshot, drop_label="replica"):
+    """Collapse ``drop_label`` out of a merged snapshot: samples that
+    agree on every remaining label combine exactly — counters/gauges
+    sum, histograms merge element-wise (a sample whose bucket bounds
+    disagree with the first-seen bounds is skipped). Entries without
+    ``drop_label`` pass through unchanged. The inverse of
+    :func:`merge_snapshots` up to summation — what the SLO engine and
+    tier-level dashboards consume."""
+    out = []
+    for entry in snapshot:
+        labelnames = list(entry.get("labelnames", []))
+        if drop_label not in labelnames:
+            out.append(entry)
+            continue
+        i = labelnames.index(drop_label)
+        agg, order = {}, []
+        for sample in entry.get("samples", ()):
+            labels = list(sample.get("labels", []))
+            key = tuple(labels[:i] + labels[i + 1:])
+            cur = agg.get(key)
+            if entry["type"] == "histogram":
+                if cur is None:
+                    agg[key] = {"labels": list(key),
+                                "buckets": list(sample["buckets"]),
+                                "counts": list(sample["counts"]),
+                                "sum": sample["sum"],
+                                "count": int(sample["count"])}
+                    order.append(key)
+                elif list(sample["buckets"]) == cur["buckets"]:
+                    cur["counts"] = [a + b for a, b in
+                                     zip(cur["counts"], sample["counts"])]
+                    cur["sum"] = _add_json(cur["sum"], sample["sum"])
+                    cur["count"] += int(sample["count"])
+            else:
+                if cur is None:
+                    agg[key] = {"labels": list(key),
+                                "value": sample["value"]}
+                    order.append(key)
+                else:
+                    cur["value"] = _add_json(cur["value"],
+                                             sample["value"])
+        out.append({"name": entry["name"], "help": entry.get("help", ""),
+                    "type": entry["type"],
+                    "labelnames": (labelnames[:i]
+                                   + labelnames[i + 1:]),
+                    "samples": [agg[k] for k in order]})
+    return out
 
 
 class ClientDisconnected(ConnectionError):
@@ -231,6 +318,7 @@ class HttpService:
         self._want_port = port
         self.name = name
         self._routes = {}
+        self._prefix_routes = []
         self._httpd = None
         self._thread = None
         self.port = None
@@ -240,6 +328,23 @@ class HttpService:
         for m in methods:
             self._routes[(m, path)] = handler
         return self
+
+    def route_prefix(self, prefix, handler, methods=("GET",)):
+        """Register ``handler(ctx)`` for any path starting with
+        ``prefix`` (path-parameter routes like ``/v1/requests/<id>/
+        trace``). Exact routes win; among prefixes the longest match
+        wins. The handler reads the remainder off ``ctx.path``."""
+        for m in methods:
+            self._prefix_routes.append((m, str(prefix), handler))
+        self._prefix_routes.sort(key=lambda r: -len(r[1]))
+        return self
+
+    def _match_prefix(self, method, path, head_only=False):
+        for m, prefix, fn in self._prefix_routes:
+            if path.startswith(prefix) and (
+                    m == method or (head_only and m == "GET")):
+                return fn
+        return None
 
     def start(self):
         from http.server import (BaseHTTPRequestHandler,
@@ -255,6 +360,9 @@ class HttpService:
                 fn = svc._routes.get((ctx.method, ctx.path))
                 if fn is None and head_only:
                     fn = svc._routes.get(("GET", ctx.path))
+                if fn is None:
+                    fn = svc._match_prefix(ctx.method, ctx.path,
+                                           head_only)
                 if fn is None:
                     self.send_error(404)
                     return
@@ -319,7 +427,8 @@ class HttpService:
 ScrapeServer = HttpService
 
 
-def add_probe_routes(svc, registry=None, ready=None, health_info=None):
+def add_probe_routes(svc, registry=None, ready=None, health_info=None,
+                     snapshot_fn=None):
     """Install the standard probe routes on an :class:`HttpService`:
     ``/metrics`` (+ ``/``), ``/metrics.json``, ``/healthz``,
     ``/readyz``.
@@ -336,16 +445,26 @@ def add_probe_routes(svc, registry=None, ready=None, health_info=None):
     merged into the ``/healthz`` document per probe (e.g. membership
     epoch + last-heartbeat age, so an operator can spot a fenced-out
     stale incarnation from the probe alone); a raising callable
-    degrades to the base document rather than failing liveness."""
+    degrades to the base document rather than failing liveness.
+
+    ``snapshot_fn`` overrides what ``/metrics`` + ``/metrics.json``
+    render: a zero-arg callable returning a :func:`json_snapshot`-shaped
+    list (e.g. ``ServingCluster.scrape`` — the merged one-pane cluster
+    snapshot) instead of the local registry."""
     reg = registry if registry is not None else default_registry()
     t_start = time.monotonic()
 
+    def _snapshot():
+        if snapshot_fn is not None:
+            return snapshot_fn()
+        return json_snapshot(reg)
+
     def metrics(ctx):
-        ctx.send(200, prometheus_text(reg).encode(),
+        ctx.send(200, snapshot_to_prometheus(_snapshot()).encode(),
                  "text/plain; version=0.0.4; charset=utf-8")
 
     def metrics_json(ctx):
-        ctx.send_json(200, json_snapshot(reg))
+        ctx.send_json(200, _snapshot())
 
     def healthz(ctx):
         doc = {"status": "ok", "pid": os.getpid(),
@@ -377,11 +496,11 @@ def add_probe_routes(svc, registry=None, ready=None, health_info=None):
 
 
 def start_http_server(port=0, addr="127.0.0.1", registry=None,
-                      ready=None, health_info=None):
+                      ready=None, health_info=None, snapshot_fn=None):
     """Serve the probe routes (see :func:`add_probe_routes`) on a
     daemon thread; ``port=0`` picks a free port. Returns the running
     :class:`HttpService` (``.port`` / ``.url`` / ``.stop``)."""
     svc = HttpService(addr=addr, port=port, name="metrics")
     add_probe_routes(svc, registry=registry, ready=ready,
-                     health_info=health_info)
+                     health_info=health_info, snapshot_fn=snapshot_fn)
     return svc.start()

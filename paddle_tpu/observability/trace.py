@@ -22,13 +22,25 @@ import threading
 import time
 from collections import deque
 
+from . import tracing as _tracing
 from .metrics import enabled
 
 __all__ = ["span", "TraceBuffer", "default_buffer", "get_events", "clear",
-           "export_chrome_trace", "unique_run_name"]
+           "export_chrome_trace", "unique_run_name", "epoch_unix"]
 
-#: process epoch — span timestamps are microseconds since this point
+#: process epoch — span timestamps are microseconds since this point.
+#: Spans are stamped off the MONOTONIC clock (an NTP step mid-run must
+#: not make a trace jump backwards); ``_EPOCH_UNIX`` records where that
+#: monotonic epoch sits on the shared unix clock — the offset the
+#: cross-process merge (`tracing.merge_shards`) aligns shards on.
 _EPOCH = time.perf_counter()
+_EPOCH_UNIX = time.time() - (time.perf_counter() - _EPOCH)
+
+
+def epoch_unix():
+    """Unix time (seconds) at which this process's span clock reads 0 —
+    the recorded monotonic<->epoch clock offset."""
+    return _EPOCH_UNIX
 
 
 class TraceBuffer:
@@ -83,18 +95,32 @@ class span:
 
         @span("engine.step")
         def step(...): ...
+
+    When a distributed :class:`~.tracing.TraceContext` is active (see
+    ``tracing.activate``), the span becomes a node of that trace: it
+    mints a child context for its own duration (so nested spans chain
+    to it) and records ``trace_id`` / ``span_id`` / ``parent_id`` in
+    its args. ``trace_ctx=`` installs a pre-allocated context verbatim
+    instead — how rpc records its call span under the exact identity
+    the envelope carried across the process boundary.
     """
 
-    __slots__ = ("name", "args", "buffer", "_t0")
+    __slots__ = ("name", "args", "buffer", "_t0", "_trace_ctx_in",
+                 "_trace_ctx", "_trace_token")
 
-    def __init__(self, name, buffer=None, **args):
+    def __init__(self, name, buffer=None, trace_ctx=None, **args):
         self.name = name
         self.args = args or None
         self.buffer = buffer
         self._t0 = None
+        self._trace_ctx_in = trace_ctx
+        self._trace_ctx = None
+        self._trace_token = None
 
     def __enter__(self):
         if enabled():
+            self._trace_ctx, self._trace_token = \
+                _tracing._enter_span(self._trace_ctx_in)
             self._t0 = time.perf_counter()
         return self
 
@@ -111,7 +137,14 @@ class span:
             "pid": os.getpid(),
             "tid": threading.get_ident(),
         }
-        if self.args:
+        ctx = self._trace_ctx
+        if ctx is not None:
+            event["args"] = dict(self.args or ())
+            event["args"].update(ctx.to_wire())
+            _tracing._exit_span(self._trace_token)
+            self._trace_ctx = None
+            self._trace_token = None
+        elif self.args:
             event["args"] = dict(self.args)
         # explicit None-check: an empty TraceBuffer is falsy (__len__)
         buf = self.buffer if self.buffer is not None else _default_buffer
@@ -154,5 +187,11 @@ def export_chrome_trace(dir_name, worker_name=None, buffer=None):
     path = os.path.join(out_dir, f"{worker}.host_spans.trace.json")
     with open(path, "w") as f:
         json.dump({"traceEvents": buf.events(),
-                   "displayTimeUnit": "ms"}, f)
+                   "displayTimeUnit": "ms",
+                   # where this process's span clock (ts=0) sits on the
+                   # unix clock — lets offline tooling align single-
+                   # process exports the same way the cluster collector
+                   # aligns shards
+                   "metadata": {"epoch_unix": _EPOCH_UNIX,
+                                "pid": os.getpid()}}, f)
     return path

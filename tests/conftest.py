@@ -37,13 +37,16 @@ _WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
                         "test_chunked_scheduler", "test_speculative",
                         "test_moe_serving", "test_partition_tolerance",
                         "test_ragged_attention", "test_fused_ce",
-                        "test_weight_quant"}
+                        "test_weight_quant", "test_distributed_tracing"}
 
 # per-module budgets where the default is wrong: subprocess-cluster
 # tests legitimately wait out several worker-process startups (import +
 # model build + compile each) inside ONE test, so their wedge budget is
 # sized to the e2e's worst case, not the in-process default
 _WEDGE_BUDGETS = {"test_subprocess_cluster": 700.0,
+                  # the tracing e2e waits out a 3-worker subprocess
+                  # cluster startup (import + model build + compile)
+                  "test_distributed_tracing": 700.0,
                   # many engines per test (spec/int8 variants of the
                   # mixed program compile per geometry)
                   "test_speculative": 600.0,
