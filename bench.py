@@ -44,6 +44,37 @@ def device_peak():
     return PEAK_FLOPS.get(d.device_kind, 197e12), d.device_kind
 
 
+#: bump when the snapshot layout changes; tools/bench_check.py refuses
+#: to diff snapshots whose schema versions disagree
+BENCH_SCHEMA_VERSION = 1
+
+#: the knobs that change what a bench run measures — stamped into every
+#: snapshot so a regression diff can rule out "different config"
+_PROVENANCE_KNOBS = (
+    "PADDLE_TPU_METRICS", "PADDLE_TPU_PERF",
+    "PADDLE_TPU_PERF_FENCE_INTERVAL", "PADDLE_TPU_PEAK_FLOPS",
+    "PADDLE_TPU_PEAK_HBM_GBS", "PADDLE_TPU_SERVING_Q8",
+    "PADDLE_TPU_FUSED_KV", "PADDLE_TPU_FUSED_ROPE",
+)
+
+
+def bench_provenance():
+    """The identity block every snapshot carries: what ran, where, and
+    under which knobs — so a later ``bench_check`` diff can tell a real
+    regression from a config or platform change."""
+    from paddle_tpu.observability import perf as _perf
+
+    info = _perf.build_info()
+    return {
+        "git_commit": info["git_commit"],
+        "jax_version": info["jax_version"],
+        "device_kind": info["device_kind"],
+        "wall_clock_unix": round(time.time(), 3),
+        "env": {k: os.environ[k] for k in _PROVENANCE_KNOBS
+                if k in os.environ},
+    }
+
+
 def bench_train_step(cfg_kw, batch, seq, steps=10, amp=True):
     """Train-step wall time through to_static; returns a result dict.
 
@@ -1523,6 +1554,103 @@ def bench_trace_overhead(model, on_tpu=True):
     }
 
 
+def bench_perf_overhead(model, on_tpu=True):
+    """Perf-attribution tax at the cluster tier: tokens/sec through a
+    ServingCluster with the roofline/sentinel layer active (host timer
+    every dispatch, aggressive 50 ms fence throttle) vs
+    ``PADDLE_TPU_PERF=0``. ``perf_overhead_frac`` is the fractional
+    rate loss; the gate ``perf_overhead_ok`` requires <= 3% — the same
+    bar as ``trace_overhead_ok``. Also reports the roofline readings
+    attribution produced for the busiest serving callable during the
+    run (the numbers an on-chip sweep publishes as
+    ``paddle_tpu_perf_*`` gauges)."""
+    from paddle_tpu.inference.cluster import ServingCluster
+    from paddle_tpu.inference.serving import LlamaServingEngine
+    from paddle_tpu.observability import perf as _perf
+
+    model.eval()
+    max_batch = 8 if on_tpu else 2
+    new_tokens = 48 if on_tpu else 64
+    n_reqs = 24 if on_tpu else 12
+    rounds = 3 if on_tpu else 4
+    cluster = ServingCluster(
+        engine_factory=lambda: LlamaServingEngine(
+            model, max_batch=max_batch, page_size=64,
+            num_pages=max_batch * 8 + 8, max_pages_per_seq=8,
+            prefix_cache=False),
+        num_replicas=1, max_backlog=n_reqs * 2)
+    cluster.start()
+    rng = np.random.RandomState(0)
+    v = model.config.vocab_size
+    prompts = [rng.randint(0, v, (24,)).tolist() for _ in range(n_reqs)]
+
+    saved = {k: os.environ.get(k) for k in
+             ("PADDLE_TPU_PERF", "PADDLE_TPU_PERF_FENCE_INTERVAL")}
+
+    def mode(attribution_on):
+        if attribution_on:
+            os.environ["PADDLE_TPU_PERF"] = "1"
+            os.environ["PADDLE_TPU_PERF_FENCE_INTERVAL"] = "0.05"
+        else:
+            os.environ["PADDLE_TPU_PERF"] = "0"
+
+    def run():
+        reqs = []
+        t0 = time.perf_counter()
+        for p in prompts:
+            reqs.append(cluster.submit(p, max_new_tokens=new_tokens))
+        for r in reqs:
+            r.wait(300.0)
+        wall = time.perf_counter() - t0
+        return sum(len(r.output_ids) for r in reqs) / wall
+
+    try:
+        mode(True)
+        run()               # warm: compile + populate roofline gauges
+        on, off = [], []
+        for _ in range(rounds):  # interleave to share thermal/jit drift
+            mode(False)
+            off.append(run())
+            mode(True)
+            on.append(run())
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+    cluster.stop()
+    model.train()
+    # best-of per mode (see bench_trace_overhead): noise only slows
+    tps_on, tps_off = max(on), max(off)
+    frac = round(max(0.0, 1.0 - tps_on / max(tps_off, 1e-9)), 3)
+    out = {
+        "perf_tokens_per_sec_on": round(tps_on, 1),
+        "perf_tokens_per_sec_off": round(tps_off, 1),
+        "perf_overhead_frac": frac,
+        "perf_overhead_ok": bool(frac <= 0.03),
+    }
+    serving = {n: s for n, s in _perf.recorders().items()
+               if n.startswith("serving.")}
+    if serving:
+        name, st = max(serving.items(),
+                       key=lambda kv: kv[1]["samples"])
+        peak_flops, peak_bw, _ = _perf.device_peaks()
+        out["perf_serving_callable"] = name
+        if st["device_ewma_ms"]:
+            dev_s = st["device_ewma_ms"] / 1e3
+            out["perf_serving_device_ms"] = round(
+                st["device_ewma_ms"], 3)
+            if st["flops"]:
+                out["perf_serving_flops_frac"] = round(
+                    min(1.0, st["flops"] / (dev_s * peak_flops)), 5)
+            if st["bytes_accessed"]:
+                out["perf_serving_hbm_frac"] = round(
+                    min(1.0, st["bytes_accessed"] / (dev_s * peak_bw)),
+                    5)
+    return out
+
+
 def bench_fused_ce(on_tpu=True):
     """Chunked fused cross-entropy lm-head vs the materialized logits
     path at an 8k+ vocab config: fwd+bwd step time, static peak-memory
@@ -1726,6 +1854,22 @@ CANDIDATES = [
 ]
 
 
+def _run_section(result, key, fn, label=None):
+    """Run one bench section: merge its dict into ``result``, stamp
+    ``<key>_wall_s`` with the section's wall time, and degrade to a
+    ``<key>_error`` key on failure (one broken section must not sink
+    the whole run — the historical contract of main()'s try blocks)."""
+    label = label or key
+    t0 = time.perf_counter()
+    try:
+        result.update(fn())
+    except Exception as e:
+        log(f"{label} bench failed: {e!r:.300}")
+        result[f"{key}_error"] = repr(e)[:200]
+    finally:
+        result[f"{key}_wall_s"] = round(time.perf_counter() - t0, 3)
+
+
 def main():
     import jax
     on_tpu = jax.default_backend() == "tpu"
@@ -1747,159 +1891,99 @@ def main():
     if result is None:
         raise err
 
-    try:
-        if on_tpu:
-            result.update(bench_flash())
-        else:
-            result.update(bench_flash(batch=1, seq=256, heads=4, kv_heads=2,
-                                      dim=64, iters=2))
-    except Exception as e:
-        log(f"flash micro-bench failed: {e!r:.300}")
-        result["flash_error"] = repr(e)[:200]
+    # lambdas read bench_train_step.last_model at CALL time — no local
+    # ref lingers to pin the serving model when the large config runs
+    _model = lambda: bench_train_step.last_model  # noqa: E731
 
-    try:
-        if on_tpu:
-            result.update(bench_paged())
-        else:
-            result.update(bench_paged(batch=2, heads=4, kv_heads=2, dim=32,
-                                      page=8, ctx=64, iters=2))
-    except Exception as e:
-        log(f"paged bench failed: {e!r:.300}")
-        result["paged_error"] = repr(e)[:200]
-
-    try:
-        if on_tpu:
-            result.update(bench_ragged())
-        else:
-            result.update(bench_ragged(rows=4, qb=8, heads=4, kv_heads=2,
-                                       dim=32, page=8, ctx=64, iters=2))
-    except Exception as e:
-        log(f"ragged bench failed: {e!r:.300}")
-        result["ragged_error"] = repr(e)[:200]
-
-    try:
-        model = bench_train_step.last_model
-        result.update(bench_decode(
-            model, batch=16 if on_tpu else 1,
-            prompt=128 if on_tpu else 16,
-            new_tokens=64 if on_tpu else 4))
-    except Exception as e:
-        log(f"decode bench failed: {e!r:.300}")
-        result["decode_error"] = repr(e)[:200]
-
-    try:
-        result.update(bench_distributed_onchip(
-            iters=10 if on_tpu else 1))
-    except Exception as e:
-        log(f"distributed on-chip bench failed: {e!r:.300}")
-        result["distributed_error"] = repr(e)[:200]
-
-    try:
-        model = bench_train_step.last_model
-        result.update(bench_serving(
-            model, n_requests=24 if on_tpu else 2,
+    if on_tpu:
+        _run_section(result, "flash", bench_flash,
+                     label="flash micro")
+    else:
+        _run_section(
+            result, "flash",
+            lambda: bench_flash(batch=1, seq=256, heads=4, kv_heads=2,
+                                dim=64, iters=2),
+            label="flash micro")
+    _run_section(
+        result, "paged",
+        bench_paged if on_tpu else
+        lambda: bench_paged(batch=2, heads=4, kv_heads=2, dim=32,
+                            page=8, ctx=64, iters=2))
+    _run_section(
+        result, "ragged",
+        bench_ragged if on_tpu else
+        lambda: bench_ragged(rows=4, qb=8, heads=4, kv_heads=2,
+                             dim=32, page=8, ctx=64, iters=2))
+    _run_section(
+        result, "decode",
+        lambda: bench_decode(_model(), batch=16 if on_tpu else 1,
+                             prompt=128 if on_tpu else 16,
+                             new_tokens=64 if on_tpu else 4))
+    _run_section(
+        result, "distributed",
+        lambda: bench_distributed_onchip(iters=10 if on_tpu else 1),
+        label="distributed on-chip")
+    _run_section(
+        result, "serving",
+        lambda: bench_serving(
+            _model(), n_requests=24 if on_tpu else 2,
             new_tokens=48 if on_tpu else 4,
             max_batch=16 if on_tpu else 2,
             decode_ceiling=result.get("decode_tokens_per_sec"),
             on_tpu=on_tpu))
-    except Exception as e:
-        log(f"serving bench failed: {e!r:.300}")
-        result["serving_error"] = repr(e)[:200]
+    _run_section(
+        result, "fused_kv",
+        (lambda: bench_fused_kv(_model(), on_tpu=True)) if on_tpu else
+        lambda: bench_fused_kv(_model(), rows=4, qb=8, heads=4,
+                               kv_heads=2, dim=32, page=8, ctx=64,
+                               iters=2, on_tpu=False),
+        label="fused-kv")
+    _run_section(
+        result, "fused_rope",
+        (lambda: bench_fused_rope(_model(), on_tpu=True)) if on_tpu
+        else lambda: bench_fused_rope(_model(), rows=4, qb=8, heads=4,
+                                      kv_heads=2, dim=32, page=8,
+                                      ctx=64, iters=2, on_tpu=False),
+        label="fused-rope")
+    _run_section(result, "cluster",
+                 lambda: bench_prefix_cluster(_model(), on_tpu=on_tpu),
+                 label="prefix/cluster")
+    _run_section(result, "spec",
+                 lambda: bench_speculative(_model(), on_tpu=on_tpu),
+                 label="speculative")
+    _run_section(result, "kv_int8",
+                 lambda: bench_kv_int8(_model(), on_tpu=on_tpu),
+                 label="kv-int8")
+    _run_section(result, "weight_int8",
+                 lambda: bench_weight_int8(_model(), on_tpu=on_tpu),
+                 label="weight-int8")
+    _run_section(result, "restart",
+                 lambda: bench_restart_ttft(on_tpu=on_tpu),
+                 label="restart-ttft")
+    _run_section(result, "frontend",
+                 lambda: bench_frontend(_model(), on_tpu=on_tpu))
+    _run_section(result, "trace_overhead",
+                 lambda: bench_trace_overhead(_model(), on_tpu=on_tpu),
+                 label="trace-overhead")
+    _run_section(result, "perf_overhead",
+                 lambda: bench_perf_overhead(_model(), on_tpu=on_tpu),
+                 label="perf-overhead")
+    _run_section(result, "fused_ce",
+                 lambda: bench_fused_ce(on_tpu=on_tpu),
+                 label="fused-ce")
+    _run_section(result, "moe_train",
+                 lambda: bench_moe_train(on_tpu=on_tpu),
+                 label="moe-train")
+    if on_tpu:
+        # ~11 GB large config: nothing above holds the serving model
+        # now (only bench_train_step.last_model pins its params)
+        _run_section(result, "large", bench_train_large,
+                     label="large-model")
 
-    try:
-        model = bench_train_step.last_model
-        if on_tpu:
-            result.update(bench_fused_kv(model, on_tpu=True))
-        else:
-            result.update(bench_fused_kv(
-                model, rows=4, qb=8, heads=4, kv_heads=2, dim=32,
-                page=8, ctx=64, iters=2, on_tpu=False))
-    except Exception as e:
-        log(f"fused-kv bench failed: {e!r:.300}")
-        result["fused_kv_error"] = repr(e)[:200]
-
-    try:
-        model = bench_train_step.last_model
-        if on_tpu:
-            result.update(bench_fused_rope(model, on_tpu=True))
-        else:
-            result.update(bench_fused_rope(
-                model, rows=4, qb=8, heads=4, kv_heads=2, dim=32,
-                page=8, ctx=64, iters=2, on_tpu=False))
-    except Exception as e:
-        log(f"fused-rope bench failed: {e!r:.300}")
-        result["fused_rope_error"] = repr(e)[:200]
-
-    try:
-        model = bench_train_step.last_model
-        result.update(bench_prefix_cluster(model, on_tpu=on_tpu))
-    except Exception as e:
-        log(f"prefix/cluster bench failed: {e!r:.300}")
-        result["cluster_error"] = repr(e)[:200]
-
-    try:
-        model = bench_train_step.last_model
-        result.update(bench_speculative(model, on_tpu=on_tpu))
-    except Exception as e:
-        log(f"speculative bench failed: {e!r:.300}")
-        result["spec_error"] = repr(e)[:200]
-
-    try:
-        model = bench_train_step.last_model
-        result.update(bench_kv_int8(model, on_tpu=on_tpu))
-    except Exception as e:
-        log(f"kv-int8 bench failed: {e!r:.300}")
-        result["kv_int8_error"] = repr(e)[:200]
-
-    try:
-        model = bench_train_step.last_model
-        result.update(bench_weight_int8(model, on_tpu=on_tpu))
-    except Exception as e:
-        log(f"weight-int8 bench failed: {e!r:.300}")
-        result["weight_int8_error"] = repr(e)[:200]
-
-    try:
-        result.update(bench_restart_ttft(on_tpu=on_tpu))
-    except Exception as e:
-        log(f"restart-ttft bench failed: {e!r:.300}")
-        result["restart_error"] = repr(e)[:200]
-
-    try:
-        model = bench_train_step.last_model
-        result.update(bench_frontend(model, on_tpu=on_tpu))
-    except Exception as e:
-        log(f"frontend bench failed: {e!r:.300}")
-        result["frontend_error"] = repr(e)[:200]
-
-    try:
-        model = bench_train_step.last_model
-        result.update(bench_trace_overhead(model, on_tpu=on_tpu))
-    except Exception as e:
-        log(f"trace-overhead bench failed: {e!r:.300}")
-        result["trace_overhead_error"] = repr(e)[:200]
-
-    try:
-        result.update(bench_fused_ce(on_tpu=on_tpu))
-    except Exception as e:
-        log(f"fused-ce bench failed: {e!r:.300}")
-        result["fused_ce_error"] = repr(e)[:200]
-
-    try:
-        result.update(bench_moe_train(on_tpu=on_tpu))
-    except Exception as e:
-        log(f"moe-train bench failed: {e!r:.300}")
-        result["moe_train_error"] = repr(e)[:200]
-
-    try:
-        if on_tpu:
-            # the decode/serving model must actually die before the
-            # ~11 GB large config allocates — main()'s local ref would
-            # otherwise pin its 2 GB of fp32 params
-            model = None  # noqa: F841
-            result.update(bench_train_large())
-    except Exception as e:
-        log(f"large-model bench failed: {e!r:.300}")
-        result["large_error"] = repr(e)[:200]
+    prov = bench_provenance()
+    result["device_kind"] = prov["device_kind"]
+    result["jax_version"] = prov["jax_version"]
+    result["git_commit"] = prov["git_commit"]
 
     mfu = result["mfu"]
     line = {"metric": "llama_train_mfu", "value": mfu,
@@ -1920,8 +2004,15 @@ def write_metrics_snapshot(result,
     ``observability.export.json_snapshot`` next to the BENCH_*.json
     outputs — strict JSON (``allow_nan=False``), so downstream scrapers
     consume bench history with the exact parser they point at the
-    serving /metrics.json endpoint. Returns the path, or None under
-    ``PADDLE_TPU_METRICS=0`` (the kill switch writes no files)."""
+    serving /metrics.json endpoint.
+
+    The document is versioned: ``{"schema_version":
+    BENCH_SCHEMA_VERSION, "provenance": bench_provenance(), "metrics":
+    [json_snapshot entries]}`` — the shape ``tools/bench_check.py``
+    diffs against a committed baseline (it also still reads the
+    pre-versioning bare-list snapshots). Returns the path, or None
+    under ``PADDLE_TPU_METRICS=0`` (the kill switch writes no
+    files)."""
     from paddle_tpu.observability import metrics as om
     from paddle_tpu.observability.export import json_snapshot
 
@@ -1933,8 +2024,11 @@ def write_metrics_snapshot(result,
             continue
         reg.gauge(f"bench_{key}", "bench.py per-run number") \
             .set(float(value))
+    doc = {"schema_version": BENCH_SCHEMA_VERSION,
+           "provenance": bench_provenance(),
+           "metrics": json_snapshot(reg)}
     with open(path, "w") as f:
-        json.dump(json_snapshot(reg), f, indent=2, allow_nan=False)
+        json.dump(doc, f, indent=2, allow_nan=False)
     return path
 
 

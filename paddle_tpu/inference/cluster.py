@@ -1508,7 +1508,8 @@ class ServingCluster:
         from ..observability.export import start_http_server
         return start_http_server(port=port, addr=addr, ready=self.ready,
                                  health_info=self.membership_info,
-                                 snapshot_fn=self.scrape)
+                                 snapshot_fn=self.scrape,
+                                 profile_fn=self.capture_profile)
 
     # -- one-pane observability ----------------------------------------
     def scrape(self):
@@ -1578,6 +1579,65 @@ class ServingCluster:
             shards.extend(_tracing.harvest_shards(self.log_dir))
         shards.append(_tracing.local_shard("router"))
         merged = _tracing.merge_shards(shards)
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(merged, f)
+        return merged
+
+    def capture_profile(self, seconds=1.0, path=None):
+        """Cluster-wide on-demand profiler capture: fan
+        ``_worker_capture_profile`` out to every live subprocess
+        replica over the rpc path — each runs a ``jax.profiler``
+        window of ``seconds`` while it keeps serving — capture the
+        router's own window concurrently, and merge all shards with
+        the PR-17 clock machinery into ONE Perfetto-loadable bundle
+        (``/debug/profile?seconds=N`` on :meth:`start_http_server`
+        serves exactly this). A replica whose capture rpc fails is
+        skipped (counted on ``cluster_scrape_failures_total``) — one
+        sick replica must not blank the capture. ``path`` additionally
+        writes the JSON there. Returns the merged document (``None``
+        under ``PADDLE_TPU_METRICS=0``)."""
+        from ..observability import perf as _perf
+
+        if not _om.enabled():
+            return None
+        seconds = min(max(float(seconds), 0.0), 30.0)
+        shards = []
+        shard_lock = threading.Lock()
+
+        def _pull(rid):
+            from . import replica_worker as _rw
+            try:
+                shard = self._endpoint.call_sync(
+                    rid, _rw._worker_capture_profile, (seconds,),
+                    timeout=seconds + 30.0, retries=0)
+                with shard_lock:
+                    shards.append(shard)
+            except Exception:
+                self._m["scrape_failures"].labels(rid).inc()
+
+        pullers = []
+        if self._spec is not None and self._endpoint is not None:
+            for rid, rep in self.replicas().items():
+                if not rep.alive():
+                    continue
+                t = threading.Thread(target=_pull, args=(rid,),
+                                     name=f"profile-{rid}", daemon=True)
+                t.start()
+                pullers.append(t)
+        # the router's own window runs concurrently with the fan-out
+        shards.append(_perf.capture_local(seconds, worker_name="router"))
+        for t in pullers:
+            t.join(timeout=seconds + 35.0)
+        merged = _tracing.merge_shards(shards)
+        merged["capture"] = {
+            "seconds": seconds,
+            "workers": [s.get("worker") for s in shards],
+            "pids": sorted({s.get("pid") for s in shards
+                            if s.get("pid") is not None}),
+            "profiler": {s.get("worker"): s.get("profiler")
+                         for s in shards},
+        }
         if path is not None:
             with open(path, "w") as f:
                 json.dump(merged, f)

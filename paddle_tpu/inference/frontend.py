@@ -218,8 +218,14 @@ class ServingFrontend:
                   methods=("POST",))
         svc.route("/v1/models", self._models)
         svc.route_prefix("/v1/requests/", self._request_trace)
+        # /debug/profile: cluster backend -> cluster-wide merged
+        # capture; bare engine -> this process only (profile_fn=None
+        # falls back to perf.capture_bundle)
+        profile_fn = (self.cluster.capture_profile
+                      if self.cluster is not None else None)
         add_probe_routes(svc, ready=self._ready,
-                         health_info=self._health_info)
+                         health_info=self._health_info,
+                         profile_fn=profile_fn)
         self._svc = svc.start()
         return self._svc
 

@@ -239,12 +239,27 @@ def _worker_scrape():
     over the existing rpc path and merges them under a ``replica``
     label. Returns an empty snapshot under ``PADDLE_TPU_METRICS=0``."""
     from ..observability import metrics as _om
+    from ..observability import perf as _perf
     from ..observability.export import json_snapshot
 
     w = _require()
+    _perf.ensure_build_info()   # identity labels ride every scrape
     snapshot = json_snapshot() if _om.enabled() else []
     return {"replica": w.replica_id, "pid": os.getpid(),
             "snapshot": snapshot}
+
+
+def _worker_capture_profile(seconds=1.0):
+    """One on-demand profiler window in this replica process (the
+    fan-out target of ``ServingCluster.capture_profile()``): runs on
+    the rpc dispatcher thread while the engine keeps serving, returns
+    this process's span shard + device-trace events for the
+    supervisor's merge. Empty-events shard under
+    ``PADDLE_TPU_METRICS=0``."""
+    from ..observability import perf as _perf
+
+    w = _require()
+    return _perf.capture_local(seconds, worker_name=w.replica_id)
 
 
 def _worker_exit():

@@ -24,6 +24,7 @@ so LR schedules and randomness never retrace.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -382,7 +383,12 @@ class StaticFunction:
                 return w.timed_first_dispatch(jitted, step_args,
                                               desc=desc)
         try:
-            return compiled(*step_args)
+            from ..observability import perf as _perf
+
+            t0 = time.perf_counter()
+            out = compiled(*step_args)
+            _perf.note_dispatch(self._watch_name, compiled, out, t0)
+            return out
         except _cw.AOT_MISMATCH_ERRORS:
             # the cache signature tracks user inputs, not state avals: a
             # state drift the signature can't see (the model cast to a

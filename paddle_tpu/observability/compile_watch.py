@@ -736,7 +736,12 @@ def watched_jit(fun, name=None, **jit_kwargs):
             if compiled is None:
                 return jitted(*args, **kwargs)
         try:
-            return compiled(*args, **kwargs)
+            from . import perf as _perf
+
+            t0 = time.perf_counter()
+            out = compiled(*args, **kwargs)
+            _perf.note_dispatch(watch_name, compiled, out, t0)
+            return out
         except AOT_MISMATCH_ERRORS:
             # aval drift the key cannot see (weak->strong type, a
             # sharding change): plain jit retraces transparently — stop

@@ -428,7 +428,7 @@ ScrapeServer = HttpService
 
 
 def add_probe_routes(svc, registry=None, ready=None, health_info=None,
-                     snapshot_fn=None):
+                     snapshot_fn=None, profile_fn=None):
     """Install the standard probe routes on an :class:`HttpService`:
     ``/metrics`` (+ ``/``), ``/metrics.json``, ``/healthz``,
     ``/readyz``.
@@ -450,11 +450,21 @@ def add_probe_routes(svc, registry=None, ready=None, health_info=None,
     ``snapshot_fn`` overrides what ``/metrics`` + ``/metrics.json``
     render: a zero-arg callable returning a :func:`json_snapshot`-shaped
     list (e.g. ``ServingCluster.scrape`` — the merged one-pane cluster
-    snapshot) instead of the local registry."""
+    snapshot) instead of the local registry.
+
+    ``profile_fn`` backs ``/debug/profile?seconds=N``: a callable taking
+    the window in seconds and returning a Perfetto-loadable trace dict
+    (e.g. ``ServingCluster.capture_profile`` for a cluster-wide merged
+    capture). With ``profile_fn=None`` the route captures THIS process
+    via :func:`~.perf.capture_bundle`. Returns 503 when capture is
+    disabled (``PADDLE_TPU_METRICS=0``)."""
+    from . import perf as _perf
+
     reg = registry if registry is not None else default_registry()
     t_start = time.monotonic()
 
     def _snapshot():
+        _perf.ensure_build_info(reg)
         if snapshot_fn is not None:
             return snapshot_fn()
         return json_snapshot(reg)
@@ -487,20 +497,47 @@ def add_probe_routes(svc, registry=None, ready=None, health_info=None,
                       {"status": "ready" if ok else "not_ready",
                        "pid": os.getpid()})
 
+    def debug_profile(ctx):
+        import urllib.parse
+
+        try:
+            q = urllib.parse.parse_qs(ctx.query)
+            seconds = float(q.get("seconds", ["1.0"])[0])
+        except (ValueError, TypeError):
+            ctx.send_json(400, {"error": "bad seconds parameter"})
+            return
+        seconds = min(max(seconds, 0.0), 30.0)    # bound the window
+        try:
+            if profile_fn is not None:
+                bundle = profile_fn(seconds)
+            else:
+                bundle = _perf.capture_bundle(seconds)
+        except Exception as e:
+            ctx.send_json(500, {"error": f"capture failed: {e!r}"})
+            return
+        if bundle is None:
+            ctx.send_json(503, {"error": "profiling disabled "
+                                         "(PADDLE_TPU_METRICS=0)"})
+            return
+        ctx.send_json(200, bundle)
+
     svc.route("/", metrics)
     svc.route("/metrics", metrics)
     svc.route("/metrics.json", metrics_json)
     svc.route("/healthz", healthz)
     svc.route("/readyz", readyz)
+    svc.route("/debug/profile", debug_profile)
     return svc
 
 
 def start_http_server(port=0, addr="127.0.0.1", registry=None,
-                      ready=None, health_info=None, snapshot_fn=None):
+                      ready=None, health_info=None, snapshot_fn=None,
+                      profile_fn=None):
     """Serve the probe routes (see :func:`add_probe_routes`) on a
     daemon thread; ``port=0`` picks a free port. Returns the running
     :class:`HttpService` (``.port`` / ``.url`` / ``.stop``)."""
     svc = HttpService(addr=addr, port=port, name="metrics")
     add_probe_routes(svc, registry=registry, ready=ready,
-                     health_info=health_info, snapshot_fn=snapshot_fn)
+                     health_info=health_info, snapshot_fn=snapshot_fn,
+                     profile_fn=profile_fn)
     return svc.start()

@@ -33,7 +33,8 @@ from collections import deque
 from . import metrics as _om
 from .metrics import enabled
 
-__all__ = ["SloSpec", "SloEngine", "DEFAULT_WINDOWS"]
+__all__ = ["SloSpec", "SloEngine", "DEFAULT_WINDOWS",
+           "histogram_quantile"]
 
 #: multi-window shape: fast page / mid alert / slow leak (seconds)
 DEFAULT_WINDOWS = (60.0, 300.0, 1800.0)
@@ -80,6 +81,45 @@ def _split_counts(buckets, counts, threshold):
     good = sum(counts[:k])
     bad = sum(counts[k:])
     return good, bad
+
+
+def histogram_quantile(buckets, counts, q):
+    """Prometheus-style quantile estimate from one histogram snapshot:
+    finite bucket upper bounds ``buckets`` plus per-bucket
+    (non-cumulative) ``counts`` with the +Inf bucket last (``len(counts)
+    == len(buckets) + 1``). Works on raw snapshots and equally on the
+    *delta* of two cumulative snapshots — the window shape the burn-rate
+    ring keeps.
+
+    Linear interpolation inside the landing bucket (lower bound 0.0 for
+    the first bucket — the latency domain is non-negative); a quantile
+    landing in the +Inf bucket clamps to the highest finite bound, as
+    Prometheus does. Returns None when there are no observations or any
+    count is negative (a counter reset between the two snapshots of a
+    delta)."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"q must be in [0, 1], got {q}")
+    buckets = [float(b) for b in buckets]
+    counts = [float(c) for c in counts]
+    if len(counts) != len(buckets) + 1:
+        raise ValueError(
+            f"need len(buckets)+1 counts (+Inf last), got "
+            f"{len(counts)} counts for {len(buckets)} buckets")
+    total = sum(counts)
+    if total <= 0 or any(c < 0 for c in counts):
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        prev = cum
+        cum += c
+        if cum >= rank and c > 0:
+            if i >= len(buckets):       # +Inf bucket: clamp
+                return buckets[-1] if buckets else None
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i]
+            return lo + (hi - lo) * max(0.0, rank - prev) / c
+    return buckets[-1] if buckets else None
 
 
 class SloEngine:

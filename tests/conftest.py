@@ -37,7 +37,8 @@ _WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
                         "test_chunked_scheduler", "test_speculative",
                         "test_moe_serving", "test_partition_tolerance",
                         "test_ragged_attention", "test_fused_ce",
-                        "test_weight_quant", "test_distributed_tracing"}
+                        "test_weight_quant", "test_distributed_tracing",
+                        "test_perf_attribution"}
 
 # per-module budgets where the default is wrong: subprocess-cluster
 # tests legitimately wait out several worker-process startups (import +
@@ -70,7 +71,10 @@ _WEDGE_BUDGETS = {"test_subprocess_cluster": 700.0,
                   # the quality-gate test fits a model on the bundled
                   # prompts (40 Adam steps) and the engine-knob tests
                   # build several serving engines
-                  "test_weight_quant": 600.0}
+                  "test_weight_quant": 600.0,
+                  # the capture e2e waits out a 2-worker subprocess
+                  # cluster startup plus profiler windows
+                  "test_perf_attribution": 700.0}
 
 
 @pytest.fixture(autouse=True)

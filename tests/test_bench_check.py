@@ -1,0 +1,165 @@
+"""tools/bench_check.py against synthetic baseline/candidate snapshots
+written through the real ``bench.write_metrics_snapshot`` path: exits
+nonzero on an injected 20% regression, zero on an identical pair and on
+an improvement, and bench.py's snapshot document validates against the
+declared schema."""
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_ROOT))
+sys.path.insert(0, str(_ROOT / "tools"))
+
+import bench  # noqa: E402
+import bench_check  # noqa: E402
+
+#: a plausible CPU-smoke result row covering several checked metrics
+_BASE_RESULT = {
+    "mfu": 0.42, "step_time_ms": 120.0, "tokens_per_sec": 5200.0,
+    "decode_tokens_per_sec": 900.0, "serving_tokens_per_sec": 850.0,
+    "serving_ceiling_frac": 0.8, "trace_overhead_frac": 0.01,
+    "perf_overhead_frac": 0.012, "flash_error": "not a number",
+    "parity_ok": True,      # bools must not become gauges
+}
+
+
+def _write(tmp_path, name, result):
+    path = tmp_path / name
+    out = bench.write_metrics_snapshot(result, path=str(path))
+    assert out == str(path)
+    return str(path)
+
+
+def test_snapshot_document_matches_schema(tmp_path):
+    path = _write(tmp_path, "base.json", _BASE_RESULT)
+    doc = json.loads(pathlib.Path(path).read_text())
+    assert doc["schema_version"] == bench.BENCH_SCHEMA_VERSION \
+        == bench_check.SCHEMA_VERSION
+    for key in bench_check.PROVENANCE_KEYS:
+        assert key in doc["provenance"], key
+    names = {e["name"] for e in doc["metrics"]}
+    assert "bench_mfu" in names
+    assert "bench_parity_ok" not in names       # bool skipped
+    assert "bench_flash_error" not in names     # string skipped
+    parsed_doc, metrics = bench_check.load_snapshot(path)
+    assert bench_check.validate_snapshot(parsed_doc, metrics) == []
+
+
+def test_identical_pair_passes(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _BASE_RESULT)
+    cand = _write(tmp_path, "cand.json", dict(_BASE_RESULT))
+    assert bench_check.main([base, cand]) == 0
+    assert "0 regression(s)" in capsys.readouterr().out
+
+
+def test_injected_regression_fails(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _BASE_RESULT)
+    worse = dict(_BASE_RESULT)
+    worse["tokens_per_sec"] = _BASE_RESULT["tokens_per_sec"] * 0.8
+    cand = _write(tmp_path, "cand.json", worse)
+    assert bench_check.main([base, cand]) == 1
+    assert "bench_tokens_per_sec" in capsys.readouterr().out
+
+
+def test_lower_is_better_regression(tmp_path):
+    base = _write(tmp_path, "base.json", _BASE_RESULT)
+    worse = dict(_BASE_RESULT)
+    worse["step_time_ms"] = _BASE_RESULT["step_time_ms"] * 1.2
+    cand = _write(tmp_path, "cand.json", worse)
+    assert bench_check.main([base, cand]) == 1
+
+
+def test_improvement_passes(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _BASE_RESULT)
+    better = dict(_BASE_RESULT)
+    better["tokens_per_sec"] = _BASE_RESULT["tokens_per_sec"] * 1.3
+    better["step_time_ms"] = _BASE_RESULT["step_time_ms"] * 0.7
+    cand = _write(tmp_path, "cand.json", better)
+    assert bench_check.main([base, cand]) == 0
+    assert "bench_tokens_per_sec" in capsys.readouterr().out   # "ok" line
+
+
+def test_within_tolerance_noise_passes(tmp_path):
+    base = _write(tmp_path, "base.json", _BASE_RESULT)
+    noisy = dict(_BASE_RESULT)
+    noisy["tokens_per_sec"] = _BASE_RESULT["tokens_per_sec"] * 0.95
+    cand = _write(tmp_path, "cand.json", noisy)
+    assert bench_check.main([base, cand]) == 0  # 5% < the 8% band
+
+
+def test_overhead_abs_slack_near_zero_baseline(tmp_path):
+    # rel-tol 0 + abs slack 0.01: 1.2% -> 2.0% must fail even though
+    # the baseline is tiny
+    base = _write(tmp_path, "base.json", _BASE_RESULT)
+    worse = dict(_BASE_RESULT)
+    worse["perf_overhead_frac"] = 0.025
+    cand = _write(tmp_path, "cand.json", worse)
+    assert bench_check.main([base, cand]) == 1
+
+
+def test_missing_metric_is_skipped_not_failed(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _BASE_RESULT)
+    partial = {k: v for k, v in _BASE_RESULT.items()
+               if k != "serving_tokens_per_sec"}
+    cand = _write(tmp_path, "cand.json", partial)
+    assert bench_check.main([base, cand]) == 0
+    assert "skip" in capsys.readouterr().out
+
+
+def test_legacy_bare_list_snapshot_still_diffs(tmp_path):
+    base = _write(tmp_path, "base.json", _BASE_RESULT)
+    doc = json.loads(pathlib.Path(base).read_text())
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps(doc["metrics"]))    # pre-versioning
+    assert bench_check.main([str(legacy), base]) == 0
+    worse = dict(_BASE_RESULT)
+    worse["mfu"] = 0.2
+    cand = _write(tmp_path, "cand.json", worse)
+    assert bench_check.main([str(legacy), str(cand)]) == 1
+
+
+def test_schema_mismatch_refuses(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _BASE_RESULT)
+    doc = json.loads(pathlib.Path(base).read_text())
+    doc["schema_version"] = bench.BENCH_SCHEMA_VERSION + 1
+    other = tmp_path / "other.json"
+    other.write_text(json.dumps(doc))
+    assert bench_check.main([base, str(other)]) == 2
+
+
+def test_unreadable_input_exits_2(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    ok = _write(tmp_path, "ok.json", _BASE_RESULT)
+    assert bench_check.main([str(bad), ok]) == 2
+    assert bench_check.main([ok, str(tmp_path / "absent.json")]) == 2
+
+
+def test_custom_table_merges(tmp_path):
+    base = _write(tmp_path, "base.json", _BASE_RESULT)
+    worse = dict(_BASE_RESULT)
+    worse["serving_ceiling_frac"] = 0.5     # -37%: fails default table
+    cand = _write(tmp_path, "cand.json", worse)
+    table = tmp_path / "table.json"
+    table.write_text(json.dumps(
+        {"bench_serving_ceiling_frac": ["higher", 0.5]}))
+    assert bench_check.main([base, cand]) == 1
+    assert bench_check.main([base, cand, "--table", str(table)]) == 0
+
+
+def test_kill_switch_writes_nothing(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_METRICS", "0")
+    path = tmp_path / "none.json"
+    assert bench.write_metrics_snapshot(_BASE_RESULT,
+                                        path=str(path)) is None
+    assert not path.exists()
+
+
+def test_check_function_direction_validation():
+    with pytest.raises(ValueError):
+        bench_check.check({"x": 1.0}, {"x": 1.0},
+                          table={"x": ("sideways", 0.1)})

@@ -1,5 +1,7 @@
 """Tier-1 gate: every metric name registered in the codebase is
-documented in README.md (tools/check_metrics_docs.py)."""
+documented in README.md, and — the reverse direction — no README
+metric section documents a name that is no longer registered
+(tools/check_metrics_docs.py)."""
 
 import pathlib
 import sys
@@ -38,6 +40,34 @@ def test_every_registered_metric_is_documented():
         "metric name(s) registered but not documented in README.md "
         "(add them to a metric table/list): "
         + ", ".join(f"{n} ({s[0]})" for n, s in missing))
+
+
+def test_no_stale_docs():
+    stale = cmd.stale_docs()
+    assert not stale, (
+        "metric name(s) documented in README.md but no longer "
+        "registered anywhere (remove or rename the docs): "
+        + ", ".join(stale))
+
+
+def test_stale_scanner_catches_renamed_metric():
+    readme = ("## Observability\n"
+              "- `serving_ttft_seconds` — time to first token\n"
+              "- `serving_metric_that_was_renamed_total` — gone\n")
+    assert cmd.stale_docs(readme=readme) == \
+        ["serving_metric_that_was_renamed_total"]
+
+
+def test_stale_scanner_scoping():
+    # outside a metric-scoped section: never a candidate
+    readme = ("## Quickstart\n"
+              "- `serving_metric_that_was_renamed_total` — prose\n")
+    assert cmd.stale_docs(readme=readme) == []
+    # inside the section but not a registered family's namespace
+    # (env vars, function names): never a candidate
+    readme = ("## Metrics\n"
+              "- `PADDLE_TPU_METRICS` knob, `some_helper_fn` — prose\n")
+    assert cmd.stale_docs(readme=readme) == []
 
 
 def test_checker_cli_exit_code():

@@ -235,8 +235,10 @@ class TestExport:
             assert 'reqs_total{verb="GET"} 3' in body
             snap = json.loads(
                 urllib.request.urlopen(f"{base}/metrics.json").read())
+            # every scrape additionally carries the build-info gauge
             assert {e["name"] for e in snap} == \
-                {"reqs_total", "depth", "lat_seconds"}
+                {"reqs_total", "depth", "lat_seconds",
+                 "paddle_tpu_build_info"}
             with pytest.raises(urllib.error.HTTPError):
                 urllib.request.urlopen(f"{base}/nope")
         finally:
@@ -1022,7 +1024,13 @@ class TestSatellites:
         path = bench.write_metrics_snapshot(result, path=out)
         assert path == out
         with open(out) as f:
-            snap = _strict_loads(f.read())
+            doc = _strict_loads(f.read())
+        # versioned document: schema stamp + provenance + the gauges
+        assert doc["schema_version"] == bench.BENCH_SCHEMA_VERSION
+        for key in ("git_commit", "jax_version", "device_kind",
+                    "wall_clock_unix"):
+            assert key in doc["provenance"], key
+        snap = doc["metrics"]
         names = {e["name"] for e in snap}
         assert {"bench_mfu", "bench_step_time_ms",
                 "bench_n_params"} <= names

@@ -1,0 +1,120 @@
+"""histogram_quantile vs exact quantiles on synthetic distributions:
+dense/uniform (estimate within one bucket width), sparse buckets,
+all-in-one-bucket, the +Inf tail clamp, and the delta-of-cumulative
+shape the SLO burn-rate ring feeds it."""
+
+import bisect
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability.slo import histogram_quantile
+
+
+def _bucketize(values, buckets):
+    """Per-bucket (non-cumulative) counts with the +Inf bucket last —
+    the shape metrics.Histogram.snapshot() returns."""
+    counts = [0] * (len(buckets) + 1)
+    for v in values:
+        counts[bisect.bisect_left(buckets, v)] += 1
+    return counts
+
+
+BUCKETS = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5]
+
+
+@pytest.mark.parametrize("q", [0.5, 0.9, 0.95, 0.99])
+def test_dense_uniform_within_bucket_width(q):
+    rng = np.random.RandomState(0)
+    vals = rng.uniform(0.0, 1.0, 20000)
+    counts = _bucketize(vals, BUCKETS)
+    est = histogram_quantile(BUCKETS, counts, q)
+    exact = float(np.quantile(vals, q))
+    # the estimate interpolates inside the landing bucket, so it can
+    # be off by at most that bucket's width
+    i = bisect.bisect_left(BUCKETS, exact)
+    lo = BUCKETS[i - 1] if i > 0 else 0.0
+    width = BUCKETS[min(i, len(BUCKETS) - 1)] - lo
+    assert abs(est - exact) <= width + 1e-9
+
+
+def test_exact_on_bucket_boundaries():
+    # all mass exactly fills whole buckets: interpolation lands on the
+    # true quantile, not just within a width
+    counts = [0] * (len(BUCKETS) + 1)
+    counts[4] = 100     # 100 obs in (0.05, 0.1]
+    est = histogram_quantile(BUCKETS, counts, 1.0)
+    assert est == pytest.approx(0.1)
+    assert histogram_quantile(BUCKETS, counts, 0.5) == \
+        pytest.approx(0.075)    # halfway through the landing bucket
+
+
+def test_sparse_buckets():
+    rng = np.random.RandomState(1)
+    # bimodal: fast mode near 8 ms, slow tail near 800 ms, empty
+    # buckets between
+    vals = np.concatenate([rng.uniform(0.006, 0.009, 900),
+                           rng.uniform(0.6, 0.9, 100)])
+    counts = _bucketize(vals, BUCKETS)
+    p50 = histogram_quantile(BUCKETS, counts, 0.5)
+    p99 = histogram_quantile(BUCKETS, counts, 0.99)
+    assert 0.005 < p50 <= 0.01      # inside the fast mode's bucket
+    assert 0.5 < p99 <= 1.0         # inside the tail's bucket
+    exact99 = float(np.quantile(vals, 0.99))
+    assert abs(p99 - exact99) <= 0.5    # one bucket width out there
+
+
+def test_all_in_one_bucket():
+    counts = [0] * (len(BUCKETS) + 1)
+    counts[2] = 57      # everything in (0.01, 0.025]
+    for q in (0.01, 0.5, 0.99):
+        est = histogram_quantile(BUCKETS, counts, q)
+        assert 0.01 <= est <= 0.025
+    # interpolation is linear across the single bucket
+    assert histogram_quantile(BUCKETS, counts, 0.5) == \
+        pytest.approx(0.01 + 0.015 * 0.5)
+
+
+def test_inf_tail_clamps_to_highest_finite_bound():
+    counts = [0] * (len(BUCKETS) + 1)
+    counts[-1] = 10     # all observations above the last finite bound
+    assert histogram_quantile(BUCKETS, counts, 0.5) == BUCKETS[-1]
+    # mixed: p50 finite, p99 in the +Inf tail
+    counts = [0] * (len(BUCKETS) + 1)
+    counts[0] = 90
+    counts[-1] = 10
+    assert histogram_quantile(BUCKETS, counts, 0.5) <= BUCKETS[0]
+    assert histogram_quantile(BUCKETS, counts, 0.99) == BUCKETS[-1]
+
+
+def test_delta_of_cumulative_snapshots():
+    # the burn-rate window shape: quantile over the traffic BETWEEN two
+    # scrapes = quantile of (counts_t2 - counts_t1)
+    rng = np.random.RandomState(2)
+    old = rng.uniform(0.0, 0.05, 5000)      # fast traffic before t1
+    new = rng.uniform(0.2, 0.5, 5000)       # slow traffic in (t1, t2]
+    c1 = np.array(_bucketize(old, BUCKETS))
+    c2 = c1 + np.array(_bucketize(new, BUCKETS))
+    delta = (c2 - c1).tolist()
+    est = histogram_quantile(BUCKETS, delta, 0.5)
+    exact = float(np.quantile(new, 0.5))
+    assert abs(est - exact) <= 0.25         # window-bucket width
+    # the full-history quantile would sit far lower — the delta isolates
+    # the regression the window is supposed to see
+    assert est > histogram_quantile(BUCKETS, c2.tolist(), 0.5)
+
+
+def test_empty_and_reset_return_none():
+    counts = [0] * (len(BUCKETS) + 1)
+    assert histogram_quantile(BUCKETS, counts, 0.5) is None
+    # a negative delta (replica restart between snapshots) is not a
+    # distribution — refuse rather than fabricate
+    counts[0], counts[1] = 5, -3
+    assert histogram_quantile(BUCKETS, counts, 0.5) is None
+
+
+def test_input_validation():
+    with pytest.raises(ValueError):
+        histogram_quantile(BUCKETS, [0] * (len(BUCKETS) + 1), 1.5)
+    with pytest.raises(ValueError):
+        histogram_quantile(BUCKETS, [0] * len(BUCKETS), 0.5)

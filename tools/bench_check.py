@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Diff a fresh ``bench.py`` metrics snapshot against a committed
+baseline and exit nonzero on regression — the defended-trajectory half
+of the perf attribution layer.
+
+Usage::
+
+    python bench.py                     # writes BENCH_observability_snapshot.json
+    python tools/bench_check.py baselines/v5e.json BENCH_observability_snapshot.json
+
+Each metric the two snapshots share and that the check table declares
+is compared by direction + relative tolerance: a ``higher``-is-better
+metric regresses when ``candidate < baseline * (1 - rel_tol) -
+abs_slack``, a ``lower``-is-better one when ``candidate > baseline *
+(1 + rel_tol) + abs_slack``. Metrics in only one snapshot are reported
+and skipped (a new bench section is not a regression; a vanished one
+is worth reading about in the report, not an automatic failure).
+Snapshots whose ``schema_version`` disagree refuse to diff (exit 2) —
+bump the baseline deliberately, with provenance, not by accident.
+
+Exit codes: 0 no regression, 1 regression(s), 2 unreadable/invalid
+input. Stdlib-only on purpose: CI can run it without the framework
+importable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+#: must match bench.BENCH_SCHEMA_VERSION (kept literal so the tool
+#: stays importable without the framework)
+SCHEMA_VERSION = 1
+
+#: metric -> (direction, relative tolerance, absolute slack).
+#: Direction is which way is BETTER. Tolerances are deliberately looser
+#: than run-to-run noise on a quiet chip (~2-3%) so the gate pages on
+#: real regressions, not thermals; overhead fractions get a small
+#: absolute slack because their baselines sit near zero where a
+#: relative band is meaningless.
+DEFAULT_TABLE = {
+    "bench_mfu":                        ("higher", 0.08, 0.0),
+    "bench_value":                      ("higher", 0.08, 0.0),
+    "bench_step_time_ms":               ("lower", 0.08, 0.0),
+    "bench_tokens_per_sec":             ("higher", 0.08, 0.0),
+    "bench_decode_tokens_per_sec":      ("higher", 0.08, 0.0),
+    "bench_decode_ms_per_token":        ("lower", 0.08, 0.0),
+    "bench_serving_tokens_per_sec":     ("higher", 0.08, 0.0),
+    "bench_serving_ceiling_frac":       ("higher", 0.05, 0.0),
+    "bench_cluster_tokens_per_sec":     ("higher", 0.08, 0.0),
+    "bench_spec_tokens_per_sec":        ("higher", 0.08, 0.0),
+    "bench_serving_spec_tokens_per_sec": ("higher", 0.08, 0.0),
+    "bench_weight_int8_capacity_x":     ("higher", 0.05, 0.0),
+    "bench_moe_dispatch_speedup":       ("higher", 0.08, 0.0),
+    "bench_moe_train_scaling_frac":     ("lower", 0.08, 0.0),
+    "bench_fused_ce_speedup":           ("higher", 0.08, 0.0),
+    "bench_input_stall_frac":           ("lower", 0.10, 0.01),
+    "bench_restart_warm_ttft_s":        ("lower", 0.15, 0.1),
+    "bench_frontend_stream_overhead_frac": ("lower", 0.0, 0.01),
+    "bench_trace_overhead_frac":        ("lower", 0.0, 0.01),
+    "bench_perf_overhead_frac":         ("lower", 0.0, 0.01),
+    "bench_perf_serving_flops_frac":    ("higher", 0.10, 0.0),
+    "bench_perf_serving_hbm_frac":      ("higher", 0.10, 0.0),
+}
+
+#: what a v1 provenance block must carry
+PROVENANCE_KEYS = ("git_commit", "jax_version", "device_kind",
+                   "wall_clock_unix")
+
+
+def load_snapshot(path):
+    """Parse one snapshot file into ``(doc, metrics_list)``. Accepts
+    the v1 versioned document and the pre-versioning bare
+    ``json_snapshot`` list (doc is None then). Raises ValueError on
+    anything else."""
+    with open(path) as f:
+        raw = json.load(f)
+    if isinstance(raw, list):
+        return None, raw
+    if isinstance(raw, dict) and "metrics" in raw:
+        return raw, raw["metrics"]
+    raise ValueError(f"{path}: neither a versioned snapshot dict nor "
+                     f"a bare json_snapshot list")
+
+
+def validate_snapshot(doc, metrics):
+    """Problems with one parsed snapshot (empty list = valid). A bare
+    legacy list only has its metric values checked."""
+    problems = []
+    if doc is not None:
+        sv = doc.get("schema_version")
+        if not isinstance(sv, int):
+            problems.append(f"schema_version missing or not an int: "
+                            f"{sv!r}")
+        prov = doc.get("provenance")
+        if not isinstance(prov, dict):
+            problems.append("provenance block missing")
+        else:
+            for k in PROVENANCE_KEYS:
+                if k not in prov:
+                    problems.append(f"provenance missing {k!r}")
+    if not isinstance(metrics, list):
+        return problems + ["metrics is not a list"]
+    for entry in metrics:
+        if not isinstance(entry, dict) or "name" not in entry:
+            problems.append(f"malformed metric entry: {entry!r:.80}")
+            continue
+        for v in _values(entry):
+            if not math.isfinite(v):
+                problems.append(
+                    f"{entry['name']}: non-finite value {v!r}")
+    return problems
+
+
+def _values(entry):
+    out = []
+    for s in entry.get("samples", ()):
+        v = s.get("value")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out.append(float(v))
+    return out
+
+
+def flatten(metrics):
+    """``{name: value}`` for the unlabeled single-sample gauges bench
+    snapshots hold (a multi-sample metric keeps its first sample —
+    bench never emits one, but a hand-built baseline might)."""
+    out = {}
+    for entry in metrics:
+        vs = _values(entry)
+        if vs:
+            out[entry["name"]] = vs[0]
+    return out
+
+
+def check(baseline, candidate, table=None):
+    """Compare two ``{name: value}`` maps under ``table``. Returns
+    ``(regressions, improvements, skipped)`` — lists of human-readable
+    report lines; nonzero ``regressions`` is the failure."""
+    table = table if table is not None else DEFAULT_TABLE
+    regressions, improvements, skipped = [], [], []
+    for name, spec in sorted(table.items()):
+        direction, rel = spec[0], float(spec[1])
+        abs_slack = float(spec[2]) if len(spec) > 2 else 0.0
+        if direction not in ("higher", "lower"):
+            raise ValueError(f"{name}: bad direction {direction!r}")
+        if name not in baseline or name not in candidate:
+            missing = [side for side, m in
+                       (("baseline", baseline), ("candidate", candidate))
+                       if name not in m]
+            if name in baseline or name in candidate:
+                skipped.append(f"{name}: missing in "
+                               f"{' and '.join(missing)}")
+            continue
+        base, cand = baseline[name], candidate[name]
+        if direction == "higher":
+            floor = base * (1.0 - rel) - abs_slack
+            if cand < floor:
+                regressions.append(
+                    f"{name}: {cand:.6g} < {floor:.6g} "
+                    f"(baseline {base:.6g}, -{rel:.0%} rel"
+                    f"{f' -{abs_slack:g} abs' if abs_slack else ''})")
+            elif cand > base:
+                improvements.append(
+                    f"{name}: {cand:.6g} > baseline {base:.6g}")
+        else:
+            ceil = base * (1.0 + rel) + abs_slack
+            if cand > ceil:
+                regressions.append(
+                    f"{name}: {cand:.6g} > {ceil:.6g} "
+                    f"(baseline {base:.6g}, +{rel:.0%} rel"
+                    f"{f' +{abs_slack:g} abs' if abs_slack else ''})")
+            elif cand < base:
+                improvements.append(
+                    f"{name}: {cand:.6g} < baseline {base:.6g}")
+    return regressions, improvements, skipped
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("baseline", help="committed baseline snapshot json")
+    ap.add_argument("candidate", help="fresh snapshot json to check")
+    ap.add_argument("--table", default=None,
+                    help="json file {name: [direction, rel_tol"
+                         "[, abs_slack]]} MERGED over the built-in "
+                         "check table")
+    args = ap.parse_args(argv)
+
+    try:
+        base_doc, base_metrics = load_snapshot(args.baseline)
+        cand_doc, cand_metrics = load_snapshot(args.candidate)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_check: cannot load snapshots: {e}",
+              file=sys.stderr)
+        return 2
+
+    problems = (validate_snapshot(base_doc, base_metrics)
+                + validate_snapshot(cand_doc, cand_metrics))
+    if problems:
+        for p in problems:
+            print(f"bench_check: invalid snapshot: {p}",
+                  file=sys.stderr)
+        return 2
+    if (base_doc is not None and cand_doc is not None
+            and base_doc["schema_version"] != cand_doc["schema_version"]):
+        print(f"bench_check: schema_version mismatch "
+              f"({base_doc['schema_version']} vs "
+              f"{cand_doc['schema_version']}) — re-baseline "
+              f"deliberately", file=sys.stderr)
+        return 2
+
+    table = dict(DEFAULT_TABLE)
+    if args.table:
+        try:
+            with open(args.table) as f:
+                table.update({k: tuple(v)
+                              for k, v in json.load(f).items()})
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"bench_check: cannot load table: {e}",
+                  file=sys.stderr)
+            return 2
+
+    for doc, side in ((base_doc, "baseline"), (cand_doc, "candidate")):
+        if doc is not None:
+            p = doc.get("provenance", {})
+            print(f"{side}: commit {p.get('git_commit')} on "
+                  f"{p.get('device_kind')} (jax {p.get('jax_version')})")
+
+    regressions, improvements, skipped = check(
+        flatten(base_metrics), flatten(cand_metrics), table)
+    for line in skipped:
+        print(f"  skip  {line}")
+    for line in improvements:
+        print(f"  ok    {line}")
+    for line in regressions:
+        print(f"  REGR  {line}")
+    n = len(regressions)
+    print(f"bench_check: {n} regression(s), {len(improvements)} "
+          f"improvement(s), {len(skipped)} skipped")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,10 +14,20 @@ with two affordances matching the README's established style:
 - family wildcards: ``paddle_tpu_xla_*`` documents every metric with
   that prefix.
 
-Exit 0 when every registered name is documented; exit 1 listing the
-missing ones otherwise. Wired into tier-1 via
-``tests/test_metrics_docs.py`` so a PR that adds a metric without
-documenting it fails CI.
+The check runs BOTH directions: every registered name must be
+documented, and — the stale-doc drift direction — a name documented in
+the README's observability/metric sections that belongs to a
+registered metric family but is no longer registered anywhere fails
+too (a renamed metric must take its documentation along). Stale-doc
+candidates are scoped to metric-looking tokens (underscore names whose
+first segment matches some registered metric's first segment) inside
+sections whose heading mentions observability/metrics, so prose
+backticks elsewhere (env vars, function names) never false-positive.
+
+Exit 0 when both directions are clean; exit 1 listing the offending
+names otherwise. Wired into tier-1 via ``tests/test_metrics_docs.py``
+so a PR that adds a metric without documenting it — or deletes one and
+leaves the docs behind — fails CI.
 """
 
 from __future__ import annotations
@@ -95,19 +105,70 @@ def missing_metrics(root=ROOT, readme=None):
     return out
 
 
+#: README sections whose documented names are held to the "still
+#: registered" bar (scoping keeps prose backticks out of the check)
+_METRIC_SECTION_RE = re.compile(r"observab|metric", re.IGNORECASE)
+
+
+def _metric_sections(text):
+    """The README text inside ``##``-level sections whose heading
+    matches the observability/metrics scope."""
+    parts = []
+    current = None
+    for line in text.splitlines(keepends=True):
+        if line.startswith("## "):
+            current = line if _METRIC_SECTION_RE.search(line) else None
+        elif current is not None:
+            parts.append(line)
+    return "".join(parts)
+
+
+def stale_docs(root=ROOT, readme=None):
+    """Documented metric names that are no longer registered anywhere
+    — the reverse of :func:`missing_metrics`. A name counts as a stale
+    candidate only when it (a) appears backticked inside a
+    metric-scoped README section, (b) looks like a metric (has an
+    underscore) and shares its first ``_`` segment with some registered
+    metric family, and (c) is neither registered nor covered by being
+    the prefix of a documented wildcard family that has registered
+    members."""
+    text = (ROOT / "README.md").read_text() if readme is None else readme
+    scoped = _metric_sections(text)
+    exact, _ = documented_names(scoped)
+    registered = registered_metrics(root)
+    families = {n.split("_", 1)[0] for n in registered}
+    out = []
+    for name in sorted(exact):
+        if name in registered or "_" not in name:
+            continue
+        if name.split("_", 1)[0] not in families:
+            continue    # not a metric namespace we register in
+        out.append(name)
+    return out
+
+
 def main(argv=None):
     missing = missing_metrics()
-    if not missing:
+    stale = stale_docs()
+    if not missing and not stale:
         n = len(registered_metrics())
         print(f"ok: all {n} registered metric names documented in "
-              f"README.md")
+              f"README.md, no stale docs")
         return 0
-    print(f"{len(missing)} registered metric name(s) missing from "
-          f"README.md:", file=sys.stderr)
-    for name, sites in missing:
-        print(f"  {name}   ({sites[0]})", file=sys.stderr)
-    print("document them in a README metric table/list (brace groups "
-          "and `family_*` wildcards count)", file=sys.stderr)
+    if missing:
+        print(f"{len(missing)} registered metric name(s) missing from "
+              f"README.md:", file=sys.stderr)
+        for name, sites in missing:
+            print(f"  {name}   ({sites[0]})", file=sys.stderr)
+        print("document them in a README metric table/list (brace "
+              "groups and `family_*` wildcards count)", file=sys.stderr)
+    if stale:
+        print(f"{len(stale)} documented metric name(s) no longer "
+              f"registered anywhere (stale docs):", file=sys.stderr)
+        for name in stale:
+            print(f"  {name}", file=sys.stderr)
+        print("remove or rename them in README.md's "
+              "observability/metric sections", file=sys.stderr)
     return 1
 
 
