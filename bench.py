@@ -1327,6 +1327,77 @@ def bench_restart_ttft(on_tpu=True):
     }
 
 
+def bench_kv_tiering(model, on_tpu=True):
+    """Host-DRAM KV tiering (ROADMAP item 5a): time-to-next-token of a
+    RESUMED request (H2D page restore + one decode) vs the pre-tier
+    evict fallback (full re-prefill + one decode) for the same prompt
+    on the same warmed engine. The speedup is the pause rung's whole
+    value proposition: preserving decoded K/V beats regenerating it,
+    and the gap widens with context length."""
+    from paddle_tpu.inference.serving import LlamaServingEngine, Request
+
+    model.eval()
+    prompt_len = 384 if on_tpu else 96
+    prompt = [int(t) for t in (np.arange(prompt_len) % 251 + 1)]
+    e = LlamaServingEngine(
+        model, max_batch=2, page_size=16 if on_tpu else 8,
+        num_pages=128 if on_tpu else 48, kv_tier=True,
+        prefix_cache=False)
+    try:
+        def _next_token(req):
+            """Steps until ``req`` emits one more token; seconds."""
+            n0 = len(req.output_ids)
+            t0 = time.perf_counter()
+            while len(req.output_ids) <= n0 and not req.done:
+                e.step()
+            return time.perf_counter() - t0
+
+        # warm every measured path (prefill, decode, D2H export, H2D
+        # restore scatter) so neither arm pays a compile
+        w = Request(prompt, max_new_tokens=8)
+        e.add_request(w)
+        while len(w.output_ids) < 2:
+            e.step()
+        with e._lock:
+            e._pause(w)
+        while not w.done:
+            e.step()
+
+        # arm 1: pause -> resume (restore restores the decoded pages)
+        r = Request(prompt, max_new_tokens=8)
+        e.add_request(r)
+        while len(r.output_ids) < 2:
+            e.step()
+        with e._lock:
+            e._pause(r)
+        resumed = _next_token(r)
+        while not r.done:
+            e.step()
+
+        # arm 2: the pre-tier fallback — evict resets to a from-scratch
+        # re-prefill of the whole prompt
+        r2 = Request(prompt, max_new_tokens=8, retry_budget=2)
+        e.add_request(r2)
+        while len(r2.output_ids) < 2:
+            e.step()
+        with e._lock:
+            e._evict(r2)
+        reprefill = _next_token(r2)
+        while not r2.done:
+            e.step()
+        st = e.tier.stats()
+    finally:
+        e.close()
+    return {
+        "kv_tier_resumed_ttft_ms": round(resumed * 1e3, 2),
+        "kv_tier_reprefill_ttft_ms": round(reprefill * 1e3, 2),
+        "kv_tier_resume_speedup": round(
+            reprefill / max(resumed, 1e-9), 3),
+        "kv_tier_bench_exports": st["exports"],
+        "kv_tier_bench_restores": st["restores"],
+    }
+
+
 # second MFU entry (~0.7-0.9B): best-first with HBM fallbacks
 LARGE_CANDIDATES = [
     (dict(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
@@ -1960,6 +2031,9 @@ def main():
     _run_section(result, "restart",
                  lambda: bench_restart_ttft(on_tpu=on_tpu),
                  label="restart-ttft")
+    _run_section(result, "kv_tier",
+                 lambda: bench_kv_tiering(_model(), on_tpu=on_tpu),
+                 label="kv-tier")
     _run_section(result, "frontend",
                  lambda: bench_frontend(_model(), on_tpu=on_tpu))
     _run_section(result, "trace_overhead",

@@ -287,6 +287,41 @@ class PageAllocator:
         with self._lock:
             return self._refs.get(page, 0)
 
+    def export_table(self, seq_id):
+        """Host-tier export snapshot: ``(pages, n_tokens)`` of a live
+        sequence, copied under the allocator lock. The snapshot is only
+        as stable as the caller's own serialization — the serving
+        engine exports while holding its engine lock, so no extend /
+        release can race the D2H copy that follows. Raises
+        :class:`KeyError` for unknown sequences."""
+        with self._lock:
+            if seq_id not in self._tables:
+                raise KeyError(seq_id)
+            return list(self._tables[seq_id]), self._lens[seq_id]
+
+    def import_table(self, seq_id, n_tokens):
+        """Admit a RESUMED sequence against freshly drawn, exclusively
+        owned pages — never prefix-shared ones: the H2D restore scatter
+        overwrites every slot of every page, and a shared page must
+        stay immutable for its other owners (the restore path does not
+        go through :meth:`ensure_writable`). Same refcount/double-free
+        contract as :meth:`admit`: each page starts at refcount 1 and
+        :meth:`release` is the idempotent inverse."""
+        return self.admit(seq_id, n_tokens)
+
+    def take_pages(self, n):
+        """Draw ``n`` standalone pages, refcount 1 each, owned by the
+        caller (the host-tier prefix-promotion path; hand them to a
+        prefix cache or give them back with :meth:`decref`). Raises
+        :class:`MemoryError` when the free list is short — atomically:
+        either all ``n`` pages are drawn or none are."""
+        with self._lock:
+            if n > len(self._free):
+                raise MemoryError(
+                    f"paged cache exhausted: need {n} standalone "
+                    f"pages, {len(self._free)} free")
+            return [self._pop_free() for _ in range(n)]
+
     def ensure_writable(self, seq_id, pos):
         """Copy-on-write guard for a K/V write at token position
         ``pos``: if the page holding ``pos`` is shared (refcount > 1),

@@ -118,6 +118,14 @@ class PrefixCache:
         self.hits = 0
         self.saved_tokens = 0
         self.evictions = 0
+        # demotion hook (host-DRAM KV tier): called as
+        # ``demote(key, parent_key, page)`` for each page evict_pages
+        # is about to drop, BEFORE its reference is released — the
+        # serving engine wires it to a D2H copy into the host tier so
+        # a cold system prompt survives pressure. Best-effort: any
+        # exception is swallowed (the old behavior IS dropping the
+        # page).
+        self.demote = None
 
     # ------------------------------------------------------------------
     def _keys(self, prompt_ids, n_pages):
@@ -216,6 +224,32 @@ class PrefixCache:
                     self.evict_pages(over)
         return added
 
+    def pin(self, key, page, parent=None, depth=0):
+        """Adopt an already-allocated page under chain key ``key`` —
+        the host-tier PROMOTION path: a demoted page was H2D-restored
+        into ``page`` and rejoins the cache. The caller transfers ONE
+        existing allocator reference (no incref here; on False the
+        caller keeps its reference and should give the page back).
+        ``parent`` must already be cached when given — promotion walks
+        chains in order, so a dangling parent means the caller raced
+        an eviction and the page is rejected. Returns True when
+        adopted."""
+        with self._lock:
+            if key in self._entries:
+                return False
+            if parent is not None and parent not in self._entries:
+                return False
+            e = _Entry(page, key, parent, depth=depth)
+            self._clock += 1
+            e.last_used = self._clock
+            self._entries[key] = e
+            self._leaves[key] = e
+            if parent is not None:
+                p = self._entries[parent]
+                p.children += 1
+                self._leaves.pop(parent, None)
+            return True
+
     # ------------------------------------------------------------------
     def evict_pages(self, n_pages):
         """Release up to ``n_pages`` cached pages, LRU chain-tails
@@ -229,6 +263,11 @@ class PrefixCache:
                     break
                 v = min(self._leaves.values(),
                         key=lambda e: e.last_used)
+                if self.demote is not None:
+                    try:
+                        self.demote(v.key, v.parent, v.page)
+                    except Exception:
+                        pass    # demotion is best-effort by contract
                 del self._entries[v.key]
                 del self._leaves[v.key]
                 if v.parent is not None and v.parent in self._entries:

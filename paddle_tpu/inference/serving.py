@@ -81,9 +81,12 @@ Shared-prefix KV cache (scale-out layer):
 Request lifecycle (robustness layer):
 - Every request moves through ``status``: ``pending`` → ``live`` →
   one of ``completed`` / ``deadline_exceeded`` / ``cancelled`` /
-  ``requeued`` (evicted under pressure, will retry) / ``evicted``
-  (retry budget exhausted). Terminal failures carry a typed exception
-  in ``req.error`` — never a silently truncated output.
+  ``requeued`` (evicted under pressure, will retry) / ``paused``
+  (pages parked in the host-DRAM KV tier —
+  :mod:`paddle_tpu.inference.kv_tier` — resumes without re-prefill) /
+  ``evicted`` (retry budget exhausted). Terminal failures carry a
+  typed exception in ``req.error`` — never a silently truncated
+  output.
 - **Deadlines**: ``Request(deadline=...)`` (wall-clock TTL from
   admission) and ``Request(token_budget=...)`` (seconds per generated
   token) are enforced at step/scan boundaries; an expired request's
@@ -139,6 +142,7 @@ from ..ops.ragged_paged_attention import (fused_ragged_paged_attention,
                                           ragged_paged_attention,
                                           rope_tables)
 from ..testing import faults as _faults
+from .kv_tier import KvPageTier, TierError
 from .paged_cache import PageAllocator, quantize_kv_int8
 from .sampling import SamplingParams, sampled_next_tokens
 from .speculative import NGramDrafter
@@ -256,6 +260,20 @@ def _serving_metrics():
             "serving_degraded_total",
             "degradation-ladder actions under admission pressure",
             labelnames=("rung",)),
+        "paused": _om.counter(
+            "serving_paused_total",
+            "requests paused into the host-DRAM KV tier under pool "
+            "pressure (pages D2H-copied, request parked)"),
+        "resumed": _om.counter(
+            "serving_resumed_total",
+            "paused requests resumed by H2D page restore (no "
+            "re-prefill)"),
+        "postponed": _om.counter(
+            "serving_pressure_postponed_total",
+            "decode rows dropped from ONE dispatch because victim "
+            "page releases were deferred (cross-thread entry in "
+            "flight); no state change — the rows rejoin at the next "
+            "boundary"),
         "drain_seconds": _om.gauge(
             "serving_drain_seconds",
             "duration of the last graceful drain"),
@@ -508,6 +526,8 @@ class Request:
         self._cancel_requested = False  # honored at (re-)admission
         self._cached_tokens = 0       # prefix tokens served from cache
         self._prefilled = 0           # prompt tokens written to pages
+        self._tier_key = None         # host-tier handle while paused
+        self._tier_tokens = 0         # context length of the parked KV
 
 
 class LlamaServingEngine:
@@ -523,7 +543,8 @@ class LlamaServingEngine:
                  prefix_cache_pages=None, prewarm=None, kv_dtype=None,
                  spec_k=None, spec_ngram=3, drafter_factory=None,
                  sampling=None, sample_slots=8, fused_kv=None,
-                 fused_rope=None, weight_dtype=None, weight_block=None):
+                 fused_rope=None, weight_dtype=None, weight_block=None,
+                 kv_tier=None, kv_tier_bytes=None):
         if num_pages is None:
             num_pages = max_batch * 24 + 8
         self.model = model
@@ -721,6 +742,25 @@ class LlamaServingEngine:
         self.kv_bytes_per_token = tok_bytes
         self._m["kv_bytes"].set(tok_bytes)
         self._m["weight_bytes"].set(self.weight_bytes_per_param)
+        # host-DRAM KV page tier (ROADMAP item 5a): under pool pressure
+        # the ladder PAUSES victims — pages D2H-copied into a bounded
+        # host pool, the request parked ``paused``, resumed by an H2D
+        # restore when capacity returns — instead of destroying their
+        # work via evict. Opt-in (kv_tier=True / PADDLE_TPU_KV_TIER=1)
+        # because pause changes the ladder's observable semantics;
+        # kv_tier_bytes bounds the host pool (PADDLE_TPU_KV_TIER_BYTES,
+        # default 256 MiB). Cold prefix-cache pages demote into the
+        # same pool before being dropped and promote back on a match.
+        if kv_tier is None:
+            kv_tier = os.environ.get(
+                "PADDLE_TPU_KV_TIER", "0").lower() in ("1", "true", "on")
+        if kv_tier_bytes is None:
+            kv_tier_bytes = int(os.environ.get(
+                "PADDLE_TPU_KV_TIER_BYTES", str(256 << 20)))
+        self.tier = KvPageTier(max_bytes=kv_tier_bytes) \
+            if kv_tier else None
+        if self.tier is not None and self.prefix is not None:
+            self.prefix.demote = self._demote_prefix_page
         self._next_id = 0
         # ONE traced mixed-program function covers every dispatch; its
         # per-signature cache holds the chunk_budget-token shape and the
@@ -903,8 +943,21 @@ class LlamaServingEngine:
             expired = [r for r in self._live.values()
                        if not r.done and r._expires_at is not None
                        and now >= r._expires_at]
+            # paused requests park on the requeue with their deadline
+            # clock still TICKING (their work is preserved, their SLA
+            # is not suspended); an expired one frees its host-tier
+            # copy too, not just its — already released — pages
+            parked = [r for r in self._requeue
+                      if not r.done and r._tier_key is not None
+                      and r._expires_at is not None
+                      and now >= r._expires_at]
+            for r in parked:
+                self._requeue.remove(r)
         for r in expired:
             self._expire(r, now=now)
+        for r in parked:
+            self._expire(r, now=now)
+            self._tier_discard(r)
 
     def cancel(self, req):
         """Cancel a live request (by :class:`Request` or seq_id).
@@ -933,6 +986,8 @@ class LlamaServingEngine:
                         r.done = True
                         r.status = "cancelled"
                         self._m["cancelled"].inc()
+                        # a paused request's host copy dies with it
+                        self._tier_discard(r)
                         return True
                     if r.seq_id is None \
                             or self._live.get(r.seq_id) is not r:
@@ -1776,6 +1831,8 @@ class LlamaServingEngine:
         if self._wd is not None:
             self._wd.stop()
             self._wd = None
+        if self.tier is not None:
+            self.tier.close()
 
     # ------------------------------------------------------------------
     # warm restart: shape registry + prewarm (ROADMAP item 5)
@@ -2038,6 +2095,11 @@ class LlamaServingEngine:
             if len(self._live) >= self.max_batch:
                 return "engine full"
             n = len(req.prompt_ids)
+            if self.tier is not None and self.prefix is not None:
+                # demoted prefix pages promote back BEFORE the match,
+                # so a system prompt that rode out pressure in host
+                # DRAM is a cache hit, not a re-prefill
+                self._promote_prefix(req.prompt_ids, n)
             cached = 0
             val_retries = 0
             evicted_cache = False
@@ -2131,27 +2193,35 @@ class LlamaServingEngine:
                 del self._live[v.seq_id]
             self._spec_state.pop(v.seq_id, None)
             self._release_pages(v.seq_id)
-            if v.retry_budget > 0:
-                v.retry_budget -= 1
-                v.output_ids = []
-                v.status = "requeued"
-                v._t_admit = None
-                v._expires_at = None
-                v._cached_tokens = 0    # re-matched at re-admission
-                v._prefilled = 0        # KV is gone; prefill restarts
-                # a fresh seq_id on re-admission: the old id may still
-                # have a deferred page release in flight
-                v.seq_id = None
-                self._requeue.append(v)
-            else:
-                v.done = True
-                v.status = "evicted"
-                v.error = AdmissionError(
-                    "evicted under pressure; retry budget exhausted",
-                    live=len(self._live), max_batch=self.max_batch,
-                    free_pages=self.alloc.free_pages,
-                    num_pages=self.alloc.num_pages, retries=0)
-            self._m["degraded"].labels("evict").inc()
+            self._requeue_or_fail(v)
+
+    def _requeue_or_fail(self, v):
+        """Shared evict epilogue (the ladder's evict rung AND the host
+        tier's failed-restore fallback): park the victim for a
+        from-scratch retry against its ``retry_budget``, or fail it
+        typed when the budget is spent. Caller holds the engine lock
+        and has already released/returned the victim's pages."""
+        if v.retry_budget > 0:
+            v.retry_budget -= 1
+            v.output_ids = []
+            v.status = "requeued"
+            v._t_admit = None
+            v._expires_at = None
+            v._cached_tokens = 0    # re-matched at re-admission
+            v._prefilled = 0        # KV is gone; prefill restarts
+            # a fresh seq_id on re-admission: the old id may still
+            # have a deferred page release in flight
+            v.seq_id = None
+            self._requeue.append(v)
+        else:
+            v.done = True
+            v.status = "evicted"
+            v.error = AdmissionError(
+                "evicted under pressure; retry budget exhausted",
+                live=len(self._live), max_batch=self.max_batch,
+                free_pages=self.alloc.free_pages,
+                num_pages=self.alloc.num_pages, retries=0)
+        self._m["degraded"].labels("evict").inc()
 
     def _degrade_evict(self, req):
         """Ladder rung 2: evict the lowest-priority victim — pages
@@ -2166,13 +2236,200 @@ class LlamaServingEngine:
             self._evict(v)
         return True
 
+    # ------------------------------------------------------------------
+    # host-DRAM KV page tier: the pause rung (ROADMAP item 5a)
+    # ------------------------------------------------------------------
+    def _pause(self, v):
+        """The ladder's pause rung: D2H-export the victim's pages into
+        the host tier, release the HBM pages, and park the request
+        ``paused`` on the requeue — the evict rung minus the destroyed
+        work (output, prefill progress, seed and retry budget all
+        survive; the deadline clock keeps ticking while parked). Any
+        tier failure is typed and degrades to :meth:`_evict` — never a
+        wedge, never a leak. Caller holds the engine lock."""
+        if self.tier is None:
+            self._evict(v)
+            return
+        with self._lock:
+            if v.done or v.seq_id is None:
+                return
+            try:
+                table, n_tokens = self.alloc.export_table(v.seq_id)
+            except KeyError:
+                self._evict(v)
+                return
+            try:
+                key = self.tier.export_seq(
+                    self.k_pools, self.v_pools, self.k_scales,
+                    self.v_scales, table, n_tokens,
+                    step=self._dispatch_count)
+            except TierError:
+                self._evict(v)
+                return
+            if v.seq_id in self._live:
+                del self._live[v.seq_id]
+            self._spec_state.pop(v.seq_id, None)
+            self._release_pages(v.seq_id)
+            v._tier_key = key
+            v._tier_tokens = n_tokens
+            v.status = "paused"
+            # a fresh seq_id at resume: the old id may still have a
+            # deferred page release in flight (same rule as _evict)
+            v.seq_id = None
+            self._requeue.append(v)
+            self._m["paused"].inc()
+            self._m["degraded"].labels("pause").inc()
+
+    def _degrade_pause(self, req):
+        """Ladder rung between cache-reclaim and trim (requires the
+        host tier): pause the lowest-priority victim — frees its batch
+        slot and pages WITHOUT destroying its work. Returns True when
+        a victim left the live set (even if its export failed and the
+        pause degraded to an evict: capacity was freed either way)."""
+        if self.tier is None:
+            return False
+        with self._lock:
+            victims = [r for r in self._live.values()
+                       if not r.done and r.priority < req.priority]
+            if not victims:
+                return False
+            v = min(victims,
+                    key=lambda r: (r.priority, len(r.output_ids)))
+            self._pause(v)
+        return True
+
+    def _tier_discard(self, req):
+        """Free a parked request's host-tier copy (a cancel, deadline
+        expiry, or drain ended its pause). Idempotent — racing a
+        resume that already consumed the entry is a no-op."""
+        key = req._tier_key
+        if key is None or self.tier is None:
+            return
+        req._tier_key = None
+        req._tier_tokens = 0
+        self.tier.free(key)
+
+    def _try_resume(self, req):
+        """Resume one paused request at a boundary: fresh exclusively
+        owned pages via :meth:`PageAllocator.import_table`, H2D
+        restore (CRC-verified per page) into them, rejoin the live set
+        with output/prefill progress intact — the remaining tokens are
+        bitwise what an uninterrupted run produces. Returns False when
+        capacity is short: the request is re-parked at the FRONT and
+        the pump stops for this boundary. A failed or torn restore
+        falls back to the evict→requeue path (host copy freed,
+        from-scratch retry against the retry budget) — typed, never
+        wedged, never leaked."""
+        with self._lock:
+            if req._cancel_requested and not req.done:
+                req.done = True
+                req.status = "cancelled"
+                self._m["cancelled"].inc()
+            if req.done:
+                self._tier_discard(req)
+                return True
+            expired = (req._expires_at is not None
+                       and time.perf_counter() >= req._expires_at)
+        if expired:
+            self._expire(req)
+            self._tier_discard(req)
+            return True
+        with self._lock:
+            if len(self._live) >= self.max_batch:
+                self._requeue.appendleft(req)
+                return False
+            sid = self._next_id
+            try:
+                self.alloc.import_table(sid, req._tier_tokens)
+            except MemoryError:
+                self._requeue.appendleft(req)
+                return False
+            self._next_id += 1
+            table = list(self.alloc._tables[sid])
+            try:
+                (self.k_pools, self.v_pools, self.k_scales,
+                 self.v_scales) = self.tier.restore_seq(
+                    req._tier_key, self.k_pools, self.v_pools,
+                    self.k_scales, self.v_scales, table,
+                    step=self._dispatch_count)
+            except TierError:
+                # the pre-tier behavior: fresh pages back to the pool,
+                # from-scratch retry (or a typed terminal failure)
+                self._release_pages(sid)
+                req._tier_key = None
+                req._tier_tokens = 0
+                self._requeue_or_fail(req)
+                return True
+            req._tier_key = None
+            req._tier_tokens = 0
+            req.seq_id = sid
+            req.status = "live"
+            self._live[sid] = req
+            self._m["resumed"].inc()
+        return True
+
+    def _demote_prefix_page(self, key, parent, page):
+        """Prefix-cache evict hook: D2H-copy ONE cold cached page into
+        the host tier before its last reference drops, so a hot system
+        prompt survives pool pressure without re-prefill. Raises
+        :class:`TierError` on a failed copy — the cache swallows it
+        (demotion is best-effort; the old behavior IS dropping the
+        page)."""
+        self.tier.put_prefix(
+            key.hex(), parent.hex() if parent is not None else None,
+            self.k_pools, self.v_pools, self.k_scales, self.v_scales,
+            page, step=self._dispatch_count)
+
+    def _promote_prefix(self, prompt_ids, n_tokens):
+        """Host-tier prefix promotion: extend this prompt's in-HBM
+        cached chain with demoted pages the host tier still holds.
+        Best-effort — promotion only spends SURPLUS pages (the
+        admission's own page need plus one stays untouched) and any
+        tier failure just leaves the cold path (the chain re-prefills).
+        Caller holds the engine lock."""
+        tier = self.tier
+        if tier is None or self.prefix is None:
+            return
+        from .prefix_cache import chain_keys
+        keys = chain_keys(prompt_ids, self.page_size)
+        if not keys:
+            return
+        cached_pages, _ = self.prefix.match(prompt_ids, record=False)
+        j = len(cached_pages)
+        need = max(1, math.ceil(n_tokens / self.page_size))
+        while j < len(keys):
+            key = keys[j]
+            if not tier.has_prefix(key.hex()):
+                break
+            if self.alloc.free_pages <= need + 1:
+                break
+            try:
+                page = self.alloc.take_pages(1)[0]
+            except MemoryError:
+                break
+            try:
+                (self.k_pools, self.v_pools, self.k_scales,
+                 self.v_scales) = tier.restore_prefix(
+                    key.hex(), self.k_pools, self.v_pools,
+                    self.k_scales, self.v_scales, page,
+                    step=self._dispatch_count)
+            except TierError:
+                self.alloc.decref(page)
+                break
+            if not self.prefix.pin(key, page, parent=keys[j - 1]
+                                   if j > 0 else None, depth=j):
+                # someone re-cached this link meanwhile: give the
+                # promoted page back (the cached one wins)
+                self.alloc.decref(page)
+            j += 1
+
     def _relieve_pressure(self, live, n):
         """Decode-boundary rung of the degradation ladder: when the
         pool cannot hold the next ``n`` tokens for every live sequence,
-        evict the lowest-priority (then least-progressed) victim until
-        the rest fit — shed or degrade, never crash mid-step with a
-        torn allocator. Returns the surviving live list. Caller holds
-        the engine lock."""
+        pause (host tier on) or evict the lowest-priority (then
+        least-progressed) victim until the rest fit — shed or degrade,
+        never crash mid-step with a torn allocator. Returns the
+        surviving live list. Caller holds the engine lock."""
         page = self.page_size
         live = list(live)
         # a sequence about to cross its per-seq table cap can NEVER
@@ -2208,7 +2465,14 @@ class LlamaServingEngine:
             v = min(live, key=lambda r: (r.priority, len(r.output_ids)))
             live.remove(v)
             if not deferrals_blocked:
-                self._evict(v)
+                if self.tier is not None:
+                    self._pause(v)
+                else:
+                    self._evict(v)
+            else:
+                # POSTPONE: no state change — the row sits this
+                # dispatch out and rejoins at the next boundary
+                self._m["postponed"].inc()
         return live
 
     def _pump_requeue(self):
@@ -2225,6 +2489,13 @@ class LlamaServingEngine:
                     break
                 nxt = self._requeue.popleft()
             if nxt.done:
+                self._tier_discard(nxt)
+                continue
+            if nxt._tier_key is not None:
+                # paused: resume is an H2D restore into fresh pages,
+                # not a re-admission — no prefill, no ladder walk
+                if not self._try_resume(nxt):
+                    break
                 continue
             try:
                 # quiet probe: no backoff sleeps inside the dispatch
@@ -2235,6 +2506,15 @@ class LlamaServingEngine:
                 with self._lock:
                     self._requeue.appendleft(nxt)
                 break
+        # hint the tier at the NEXT resume candidate so its CRC verify
+        # + device put overlap the coming decode dispatches
+        if self.tier is not None:
+            with self._lock:
+                head = next((r for r in self._requeue
+                             if not r.done and r._tier_key is not None),
+                            None)
+            if head is not None:
+                self.tier.stage(head._tier_key)
 
     def _admit(self, req):
         """Admit one request, walking the degradation ladder under
@@ -2293,6 +2573,10 @@ class LlamaServingEngine:
                          or any(t is not me
                                 for t in self._entry_threads)))
             if reason != "draining" and not pages_blocked:
+                # rung order: cache-reclaim (inside _try_reserve) →
+                # pause → trim → evict → backoff → shed
+                if self._degrade_pause(req):
+                    continue
                 if self._degrade_trim(req, trim_tried):
                     continue
                 if self._degrade_evict(req):
@@ -2834,6 +3118,9 @@ class LlamaServingEngine:
             for r in requeued:
                 if not r.done:
                     self._expire(r, reason="drain grace window")
+                # paused requests drain typed AND leak-free: the host
+                # copy goes with them
+                self._tier_discard(r)
             # everything that was live at entry is terminal now
             dur = time.perf_counter() - t0
             self._m["drain_seconds"].set(dur)

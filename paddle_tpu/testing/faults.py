@@ -42,7 +42,25 @@ Rule fields (all optional except ``point`` and ``action``):
   stamp refresh with ``path`` = the replica id and ``step`` = the beat
   ordinal, so a ``hang``/``sleep`` rule freezes heartbeats and the
   replica silently ages out of membership, driving TTL death detection
-  and, repeated, the circuit breaker).
+  and, repeated, the circuit breaker), and the host-DRAM KV page tier
+  (``tier.d2h`` / ``tier.h2d`` — fired before each device↔host page
+  copy via :func:`fire_copy`, with ``step`` = the engine's dispatch
+  ordinal and ``path`` = ``"seq"`` for paused-sequence copies or
+  ``"prefix"`` for demoted prefix-cache pages, so one plan can scope
+  chaos to either flow; ``sleep`` = a slow copy, ``raise`` = a failed
+  copy, ``bitflip`` = a torn copy — see :func:`fire_copy` for why the
+  tear is performed by the caller). Cookbook — a slow-copy +
+  torn-restore chaos plan that exercises both tier fallback paths::
+
+      PADDLE_TPU_FAULTS='[
+        {"point": "tier.d2h", "action": "sleep", "seconds": 0.05,
+         "count": 2},
+        {"point": "tier.h2d", "action": "bitflip", "count": 1}
+      ]'
+
+  (the first two D2H exports run slow; the first H2D restore is torn,
+  the per-page CRC check catches it and the request falls back to the
+  evict→requeue path — typed, never decoded into garbage).
 - ``action``: one of ``crash`` (``os._exit``), ``sigkill``, ``sigterm``
   (signal self), ``hang`` (sleep ~forever), ``sleep`` (slow-down, then
   continue), ``raise`` (``OSError`` by default; see ``exc``),
@@ -107,8 +125,8 @@ import time
 
 __all__ = ["PLAN_ENV", "FaultRule", "NetworkRule", "NetworkVerdict",
            "FaultPlan", "plan", "reset", "active", "fire",
-           "fire_network", "rename", "bitflip", "PROCESS_POINTS",
-           "NETWORK_POINTS"]
+           "fire_copy", "fire_network", "rename", "bitflip",
+           "PROCESS_POINTS", "NETWORK_POINTS"]
 
 #: environment variable holding the JSON fault plan
 PLAN_ENV = "PADDLE_TPU_FAULTS"
@@ -124,7 +142,7 @@ PROCESS_POINTS = frozenset({
     "ckpt.write", "ckpt.before_marker", "ckpt.save_begin",
     "ckpt.committed", "rename", "train.step", "serve.admit",
     "serve.decode", "serve.drain", "serve.spawn", "replica.dead",
-    "replica.heartbeat", "router.route",
+    "replica.heartbeat", "router.route", "tier.d2h", "tier.h2d",
 })
 
 #: instrumented message points — :func:`fire_network` call sites
@@ -358,6 +376,20 @@ class FaultPlan:
             if rule.matches(point, step, path):
                 rule.perform(point, step, path)
 
+    def fire_copy(self, point, step=None, path=None):
+        torn = False
+        for rule in self.rules:
+            if not rule.matches(point, step, path):
+                continue
+            if rule.action == "bitflip":
+                # an in-memory copy has no file to flip: consume the
+                # rule and report the tear back for the caller
+                rule.fired += 1
+                torn = True
+            else:
+                rule.perform(point, step, path)
+        return torn
+
     def fire_network(self, point, src=None, dst=None, step=None):
         verdict = None
         with self._net_lock:
@@ -401,6 +433,18 @@ def fire(point, step=None, path=None):
     p = plan()
     if p is not None:
         p.fire(point, step=step, path=path)
+
+
+def fire_copy(point, step=None, path=None):
+    """Copy-point hook (``tier.d2h`` / ``tier.h2d``): like :func:`fire`
+    for every matching rule EXCEPT ``bitflip`` — an in-flight
+    device↔host copy has no file to flip, so a matching bitflip rule is
+    consumed and reported back (returns True) for the CALLER to tear
+    the in-memory buffer it is copying. ``sleep`` rules model a slow
+    copy, ``raise`` a failed one, ``bitflip`` a torn one."""
+    p = plan()
+    return p.fire_copy(point, step=step, path=path) \
+        if p is not None else False
 
 
 def fire_network(point, src=None, dst=None, step=None):

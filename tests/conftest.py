@@ -38,7 +38,7 @@ _WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
                         "test_moe_serving", "test_partition_tolerance",
                         "test_ragged_attention", "test_fused_ce",
                         "test_weight_quant", "test_distributed_tracing",
-                        "test_perf_attribution"}
+                        "test_perf_attribution", "test_kv_tier"}
 
 # per-module budgets where the default is wrong: subprocess-cluster
 # tests legitimately wait out several worker-process startups (import +
@@ -74,7 +74,12 @@ _WEDGE_BUDGETS = {"test_subprocess_cluster": 700.0,
                   "test_weight_quant": 600.0,
                   # the capture e2e waits out a 2-worker subprocess
                   # cluster startup plus profiler windows
-                  "test_perf_attribution": 700.0}
+                  "test_perf_attribution": 700.0,
+                  # the pause/resume exactness matrix compiles one
+                  # engine per fp/int8 x spec-on/off variant, and the
+                  # copy-chaos soak ping-pongs requests through slow
+                  # injected D2H/H2D copies
+                  "test_kv_tier": 600.0}
 
 
 @pytest.fixture(autouse=True)
