@@ -1327,6 +1327,69 @@ def bench_restart_ttft(on_tpu=True):
     }
 
 
+def bench_store_failover(on_tpu=True):
+    """Control-plane store cost (ROADMAP item 4a / PR 20): per-op
+    latency of the membership surface on the shared-filesystem
+    FileStore vs the TCP LeaseStore, and how long membership takes to
+    RE-CONVERGE after the lease server is stopped and restarted on the
+    same port (client reconnect + fresh registration + a scan that
+    shows every host again) — the number the chaos drills bound."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.distributed.net_store import (LeaseStore,
+                                                  LeaseStoreServer)
+    from paddle_tpu.distributed.watchdog import FileStore
+
+    iters = 300 if on_tpu else 60
+    root = tempfile.mkdtemp(prefix="paddle_tpu_store_bench_")
+
+    def _ops_ms(store):
+        # one warm-up round so neither backend pays its first-touch
+        # cost (fs clock probe / TCP session handshake) in the loop
+        store.register("h0")
+        store.heartbeat("h0")
+        store.hosts()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            store.heartbeat("h0")
+            store.hosts()
+        return (time.perf_counter() - t0) / (2 * iters) * 1e3
+
+    try:
+        file_ms = _ops_ms(FileStore(os.path.join(root, "m"), ttl=30.0))
+        srv = LeaseStoreServer()
+        port = srv.port
+        st = LeaseStore(f"127.0.0.1:{port}", ttl=30.0, retries=6)
+        try:
+            tcp_ms = _ops_ms(st)
+            st.register("h1")
+            srv.stop()
+            t0 = time.perf_counter()
+            srv = LeaseStoreServer(port=port)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    st.register("h0")
+                    st.register("h1")
+                    if st.hosts() == ["h0", "h1"]:
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.005)
+            reconverge_ms = (time.perf_counter() - t0) * 1e3
+        finally:
+            st.close()
+            srv.stop()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "store_file_op_ms": round(file_ms, 4),
+        "store_tcp_op_ms": round(tcp_ms, 4),
+        "store_reconverge_ms": round(reconverge_ms, 2),
+    }
+
+
 def bench_kv_tiering(model, on_tpu=True):
     """Host-DRAM KV tiering (ROADMAP item 5a): time-to-next-token of a
     RESUMED request (H2D page restore + one decode) vs the pre-tier
@@ -2031,6 +2094,9 @@ def main():
     _run_section(result, "restart",
                  lambda: bench_restart_ttft(on_tpu=on_tpu),
                  label="restart-ttft")
+    _run_section(result, "store_failover",
+                 lambda: bench_store_failover(on_tpu=on_tpu),
+                 label="store-failover")
     _run_section(result, "kv_tier",
                  lambda: bench_kv_tiering(_model(), on_tpu=on_tpu),
                  label="kv-tier")

@@ -38,11 +38,12 @@ import time
 from ..observability import trace as _otrace
 from ..observability import tracing as _tracing
 from ..testing import faults as _faults
+from .net_store import LeaseStore, StoreUnavailableError
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_current_worker_info", "get_worker_info",
            "get_all_worker_infos", "WorkerInfo", "RpcTimeoutError",
-           "RpcEndpoint", "DEFAULT_TIMEOUT_ENV"]
+           "RpcEndpoint", "DEFAULT_TIMEOUT_ENV", "IDLE_WAIT_ENV"]
 
 #: env var capping a ``wait(timeout=None)`` on a call that was itself
 #: made with ``timeout=None`` — the docstring's "never an indefinite
@@ -59,6 +60,15 @@ _DEFAULT_RETRIES = 2
 #: env var bounding the dispatcher's reply cache (dedup window)
 REPLY_CACHE_ENV = "PADDLE_TPU_RPC_REPLY_CACHE"
 _DEFAULT_REPLY_CACHE = 512
+
+#: env var for the dispatcher's idle blocking-wait budget per wake.
+#: The old idle poll issued a fresh 0.25 s ``get`` four times a second
+#: per mailbox; one blocking ``wait`` per budget cuts that control-
+#: plane churn ~8x (``store_ops_total{op}`` meters it). Clamped to
+#: 2 s so ``stop()`` stays responsive — the wait blocks server-side
+#: and can only be abandoned between wakes.
+IDLE_WAIT_ENV = "PADDLE_TPU_RPC_IDLE_WAIT"
+_DEFAULT_IDLE_WAIT = 2.0
 
 
 def _env_float(name, default):
@@ -172,6 +182,16 @@ class _RpcAgent:
         self._reply_cache_cap = max(
             8, int(_env_float(REPLY_CACHE_ENV, _DEFAULT_REPLY_CACHE)))
         self._m_retries, self._m_dups = _metrics()
+        # LeaseStore meters its own store_ops_total{op}; the native
+        # TCPStore is ctypes and can't, so the dispatcher counts its
+        # idle-loop ops itself — same counter, either backend
+        self._m_store_ops = None
+        if not isinstance(store, LeaseStore):
+            from ..observability import metrics as _om
+            self._m_store_ops = _om.counter(
+                "store_ops_total",
+                "control-plane store client operations",
+                labelnames=("op",))
         if dynamic:
             # a REPLACEMENT incarnation of this name must resume the
             # mailbox where the store's seq counter stands — starting at
@@ -183,6 +203,9 @@ class _RpcAgent:
                 self._serve_from = int.from_bytes(raw, "little")
             except TimeoutError:
                 pass                  # never called: fresh mailbox
+            except StoreUnavailableError:
+                pass    # store down at join: start at 0; the serve
+                # loop resyncs the cursor once the store is back
         self._served = self._serve_from   # dispatcher's next-unserved seq
         if not dynamic:
             store.set(f"rpc/worker/{rank}", name.encode())
@@ -203,21 +226,77 @@ class _RpcAgent:
                 self.workers[wname] = WorkerInfo(wname, r)
 
     def _connect(self):
+        # a LeaseStore clones a fresh session to the same lease
+        # server; the native TCPStore gets a fresh socket the old way
+        clone = getattr(self.store, "clone", None)
+        if clone is not None:
+            return clone()
         from ..native import TCPStore
 
         return TCPStore(host=self.store.host, port=self.store.port,
                         timeout=self.store.timeout)
 
+    def _count_op(self, op):
+        if self._m_store_ops is not None:
+            self._m_store_ops.labels(op).inc()
+
+    def _resync(self, st, seq, streak):
+        """The idle wait expired with no message at ``seq``: reconcile
+        the cursor against the store's authoritative ``rpc/seq``
+        counter. Counter BELOW us -> the store restarted and lost its
+        state (new claims start at 0): resume at 0 so the post-restart
+        mailbox drains from its bottom — anything re-delivered from
+        before the restart hits the dedup cache. Counter ABOVE us with
+        our slot still empty across consecutive wakes -> a sender
+        claimed the slot and died before publishing: skip the hole
+        (safe under at-least-once — its caller times out typed and
+        retries under a fresh seq)."""
+        try:
+            raw = st.get(f"rpc/seq/{self.name}", timeout=0.25)
+            claimed = int.from_bytes(raw, "little")
+        except StoreUnavailableError:
+            return seq, 0
+        except TimeoutError:
+            return seq, 0       # counter absent: nothing ever claimed
+        if claimed < seq:
+            return 0, 0
+        if claimed > seq:
+            streak += 1
+            if streak >= 2:
+                return seq + 1, 0
+            return seq, streak
+        return seq, 0
+
     def _serve(self):
         seq = self._serve_from
         st = self._dispatch_store
+        idle_cap = min(2.0, max(0.1, _env_float(IDLE_WAIT_ENV,
+                                                _DEFAULT_IDLE_WAIT)))
+        missing_streak = 0
         while not self._stop.is_set():
             key = f"rpc/to/{self.name}/{seq}"
             try:
+                # one blocking wait per wake replaces the old fresh-
+                # 0.25s get poll (see IDLE_WAIT_ENV)
+                self._count_op("wait")
+                st.wait(key, timeout=idle_cap)
+                self._count_op("get")
                 payload = st.get(key, timeout=0.25)
-            except TimeoutError:
+            except StoreUnavailableError:
+                # store outage: hold the cursor and re-poll — no
+                # mailbox slot is skipped, service resumes with the
+                # reconnected session
+                time.sleep(0.2)
                 continue
-            st.delete_key(key)
+            except TimeoutError:
+                seq, missing_streak = self._resync(st, seq,
+                                                   missing_streak)
+                continue
+            missing_streak = 0
+            try:
+                st.delete_key(key)
+            except StoreUnavailableError:
+                pass    # request key leaks until the store's restart
             reply = None
             call_key = None
             caller = None
@@ -277,7 +356,10 @@ class _RpcAgent:
                 # out caller suppressed this publication, its retry
                 # must find the result here (exactly-once-effective)
                 for stale in self._cache_reply(call_key, reply, seq):
-                    st.delete_key(f"rpc/reply/{self.name}/{stale}")
+                    try:
+                        st.delete_key(f"rpc/reply/{self.name}/{stale}")
+                    except StoreUnavailableError:
+                        pass
             # (rpc.reply faults fire on the WAITER side — the receiving
             # end of the reply path — where a simulated loss can be
             # cleaned up without leaking tombstones)
@@ -289,10 +371,16 @@ class _RpcAgent:
             # symmetrically deletes the reply if it was already out).
             reply_key = f"rpc/reply/{self.name}/{seq}"
             tomb_key = f"rpc/dead/{self.name}/{seq}"
-            if not st.delete_key(tomb_key):
-                st.set(reply_key, reply)
-                if st.delete_key(tomb_key):
-                    st.delete_key(reply_key)
+            try:
+                if not st.delete_key(tomb_key):
+                    st.set(reply_key, reply)
+                    if st.delete_key(tomb_key):
+                        st.delete_key(reply_key)
+            except StoreUnavailableError:
+                # outage between serve and publish: the reply stays in
+                # the dedup cache, so the caller's retry (under the
+                # same identity) republishes it — advance the cursor
+                pass
             seq += 1
             self._served = seq
 
@@ -388,8 +476,11 @@ class _RpcAgent:
                         if err is None:
                             return      # fut already resolved
                         last_err = err
-                        if not isinstance(err, RpcTimeoutError):
-                            break       # transport broke, not a loss
+                        if not isinstance(err, (RpcTimeoutError,
+                                                StoreUnavailableError)):
+                            break       # terminal: neither a loss nor
+                            # a store outage (both of which retry —
+                            # the backoff rides out a store restart)
             except Exception as e:      # noqa: BLE001 — a dying driver
                 last_err = e            # must resolve, never strand
             fut._set(None, last_err)
@@ -485,8 +576,8 @@ class _RpcAgent:
         start = self._served
         if self._dispatcher.is_alive():
             # the join timed out, so the dispatcher is stuck inside a
-            # slow handler for seq _served (after stop() its get() can
-            # only block 0.25s) and will run that seq's tombstone
+            # slow handler for seq _served (after stop() its idle wait
+            # can only block ~2s) and will run that seq's tombstone
             # protocol itself when the handler returns — sweeping it
             # here would let the late reply leak instead
             start += 1
@@ -542,13 +633,20 @@ class RpcEndpoint:
     """
 
     def __init__(self, name, host="127.0.0.1", port=0, is_master=False,
-                 timeout=60.0):
-        from ..native import TCPStore
-
+                 timeout=60.0, store=None):
         self.name = name
-        store = TCPStore(host=host, port=int(port), is_master=is_master,
-                         timeout=timeout)
-        self.host = host
+        if store is None:
+            from ..native import TCPStore
+
+            store = TCPStore(host=host, port=int(port),
+                             is_master=is_master, timeout=timeout)
+            self.host = host
+        else:
+            # ride a caller-provided store session — how a TCP-only
+            # cluster puts every mailbox on the one LeaseStoreServer
+            # (cross-host reachable, outage-tolerant) instead of a
+            # per-router native master store
+            self.host = store.host
         self.port = store.port
         self._agent = _RpcAgent(name, rank=None, world_size=None,
                                 store=store, dynamic=True)

@@ -69,6 +69,7 @@ import time
 
 import numpy as np
 
+from ..distributed.net_store import LeaseStore, StoreUnavailableError
 from ..distributed.watchdog import (ElasticManager, FileStore,
                                     StaleEpochError)
 from ..observability import metrics as _om
@@ -506,6 +507,8 @@ class EngineReplica:
             self._last_beat = time.monotonic()
 
     def _hb_loop(self, stop, epoch):
+        gen = getattr(self.store, "restarts", None)
+        seen_gen = gen() if gen is not None else 0
         while not stop.wait(self._hb_interval):
             if self._dead or not self.alive():
                 return      # a crashed host never says goodbye
@@ -526,8 +529,31 @@ class EngineReplica:
                 if self.epoch == epoch:
                     self._fenced = True
                 return
+            except StoreUnavailableError:
+                continue    # store outage, not OUR death: keep
+                # beating — the client reconnects by itself and the
+                # router's outage credit suppresses the age-out
             except OSError:
                 pass
+            if gen is not None and gen() != seen_gen:
+                # the store came back from a RESTART: its leases and
+                # epoch counters are gone, so re-register under a
+                # FRESH epoch (the server's adopt-max fence heals at
+                # it; _worker_poll mirrors the bump to the router).
+                # Only the current incarnation may — a straggler
+                # sidecar minting epochs would fence out its OWN
+                # replacement.
+                if self.epoch != epoch:
+                    seen_gen = gen()    # straggler: nothing to mint
+                else:
+                    try:
+                        epoch = self.epoch = \
+                            self.store.next_epoch(self.replica_id)
+                        self.store.register(self.replica_id,
+                                            epoch=epoch)
+                        seen_gen = gen()
+                    except OSError:
+                        pass    # still flapping: next beat retries
 
     # -- router-facing surface -----------------------------------------
     def alive(self):
@@ -829,12 +855,13 @@ class SubprocessReplica:
                  ttl=None, max_backlog=None, burst=None,
                  spawn_grace=180.0, poll_interval=0.05,
                  submit_timeout=15.0, env=None, on_orphan=None,
-                 prewarm=True, log_dir=None):
+                 prewarm=True, log_dir=None, store_addr=None):
         self.replica_id = str(replica_id)
         self.spec = spec
         self.endpoint = endpoint
         self.store = store
         self.store_path = store_path
+        self.store_addr = store_addr
         self.ttl = ttl
         self.max_backlog = max_backlog
         self.burst = burst
@@ -899,7 +926,14 @@ class SubprocessReplica:
             os.pathsep + env["PYTHONPATH"]
             if env.get("PYTHONPATH") else "")
         env["PADDLE_TPU_REPLICA_ID"] = self.replica_id
-        env["PADDLE_TPU_REPLICA_STORE"] = str(self.store_path)
+        if self.store_addr is not None:
+            # TCP-only control plane: the worker joins membership AND
+            # its rpc mailbox through the lease server — no shared
+            # filesystem path travels to it at all
+            env["PADDLE_TPU_REPLICA_STORE_ADDR"] = str(self.store_addr)
+            env.pop("PADDLE_TPU_REPLICA_STORE", None)
+        else:
+            env["PADDLE_TPU_REPLICA_STORE"] = str(self.store_path)
         env["PADDLE_TPU_REPLICA_RPC"] = \
             f"{self.endpoint.host}:{self.endpoint.port}"
         env["PADDLE_TPU_REPLICA_SPEC"] = json.dumps(self.spec)
@@ -1324,9 +1358,19 @@ class ServingCluster:
         num_replicas: replica count at start().
         store_path: membership directory (a shared filesystem in a
             real deployment); default: a private temp dir.
+        store_addr: ``"host:port"`` of a
+            :class:`~paddle_tpu.distributed.net_store
+            .LeaseStoreServer` — switches the WHOLE control plane
+            (membership + rpc mailboxes) to TCP, no shared filesystem
+            anywhere; overrides ``store_path``.
         ttl: membership TTL in seconds — a replica whose heartbeat is
             older ages out and is treated as dead.
         monitor_interval: seconds between membership sweeps.
+        store_outage_grace: seconds of store unreachability after
+            which NEW admissions are rejected typed (``retry_after``).
+            In-flight requests always run to completion from the
+            last-known-membership cache, and store silence alone never
+            fails a replica over.
         auto_replace: rebuild dead replicas automatically
             (kill-and-replace).
         failover_budget: default per-request failover budget.
@@ -1347,7 +1391,8 @@ class ServingCluster:
     """
 
     def __init__(self, engine_factory=None, num_replicas=2,
-                 store_path=None, ttl=2.0, monitor_interval=0.05,
+                 store_path=None, store_addr=None, ttl=2.0,
+                 monitor_interval=0.05, store_outage_grace=5.0,
                  auto_replace=True, failover_budget=3, max_backlog=None,
                  burst=None, engine_spec=None, subprocess_env=None,
                  restart_backoff=0.1, restart_backoff_max=30.0,
@@ -1363,9 +1408,32 @@ class ServingCluster:
         self._spec = engine_spec
         self.num_replicas = int(num_replicas)
         self.ttl = ttl
-        self._store_path = store_path \
-            or tempfile.mkdtemp(prefix="paddle_tpu_cluster_")
-        self.store = FileStore(self._store_path, ttl=ttl)
+        if store_addr is not None:
+            # TCP-only control plane: membership AND the rpc mailboxes
+            # ride one LeaseStoreServer at store_addr — no shared
+            # filesystem anywhere (replicas may span hosts)
+            self.store_addr = str(store_addr)
+            self._store_path = None
+            self.store = LeaseStore(store_addr, ttl=ttl)
+        else:
+            self.store_addr = None
+            self._store_path = store_path \
+                or tempfile.mkdtemp(prefix="paddle_tpu_cluster_")
+            self.store = FileStore(self._store_path, ttl=ttl)
+        # store-outage degradation (see _live_hosts/submit): routing
+        # serves from the last-known-membership cache for the whole
+        # outage, but NEW admissions are rejected typed (retry_after)
+        # once the outage exceeds this grace window
+        self.store_outage_grace = float(store_outage_grace)
+        self._member_cache: set = set()
+        self._member_cache_t = None
+        self._outage_since = None
+        self._lenient_until = 0.0
+        self._store_gen = 0
+        self._m_cache_age = _om.gauge(
+            "cluster_membership_cache_age_seconds",
+            "age of the membership view routing decisions are based "
+            "on (0 while the store is reachable)")
         self.monitor_interval = float(monitor_interval)
         self.auto_replace = auto_replace
         self.failover_budget = int(failover_budget)
@@ -1418,7 +1486,8 @@ class ServingCluster:
                 spawn_grace=self.spawn_grace,
                 submit_timeout=self.submit_timeout,
                 env=self.subprocess_env, on_orphan=self._orphaned,
-                prewarm=self.prewarm, log_dir=self.log_dir)
+                prewarm=self.prewarm, log_dir=self.log_dir,
+                store_addr=self.store_addr)
         return EngineReplica(rid, self._factory, store=self.store,
                              ttl=self.ttl, max_backlog=self.max_backlog,
                              burst=self.burst)
@@ -1438,8 +1507,16 @@ class ServingCluster:
         if self._spec is not None and self._endpoint is None:
             from ..distributed.rpc import RpcEndpoint
 
-            self._endpoint = RpcEndpoint("router", is_master=True,
-                                         port=0)
+            if self.store_addr is not None:
+                # TCP-only mode: the router mailbox rides the SAME
+                # lease server as membership (its own session), so a
+                # store restart is the only control-plane failure
+                # domain and the mailboxes resync through it
+                self._endpoint = RpcEndpoint(
+                    "router", store=self.store.clone())
+            else:
+                self._endpoint = RpcEndpoint("router", is_master=True,
+                                             port=0)
         for i in range(self.num_replicas):
             rid = f"replica-{i}"
             rep = self._make_replica(rid)
@@ -1486,9 +1563,13 @@ class ServingCluster:
             postmortems = {rid: st.postmortem
                            for rid, st in self._restarts.items()}
         for rid, rep in self.replicas().items():
+            try:
+                hb_age = self.store.heartbeat_age(rid)
+            except OSError:
+                hb_age = None   # store outage: age unknown, not 0
             out[rid] = {
                 "epoch": getattr(rep, "epoch", None),
-                "heartbeat_age_seconds": self.store.heartbeat_age(rid),
+                "heartbeat_age_seconds": hb_age,
                 "alive": rep.alive(),
                 "ready": rep.ready(),
                 "quarantined": rid in quarantined,
@@ -1665,6 +1746,21 @@ class ServingCluster:
         across replicas when the whole tier is at capacity.
         ``sampling``/``stop``/``on_token`` ride the request to the
         engine (see :class:`ClusterRequest`)."""
+        outage = self._store_outage_age()
+        if outage > self.store_outage_grace:
+            # degraded mode: in-flight work keeps running off the
+            # membership cache, but admitting NEW work against a view
+            # this stale risks routing onto corpses — reject typed,
+            # with a retry_after sized to one lease period
+            self._m["backpressure"].inc()
+            raise AdmissionError(
+                f"control-plane store {getattr(self, 'store_addr', None)} "
+                f"unreachable for {outage:.1f}s (grace "
+                f"{self.store_outage_grace:.1f}s): new admissions "
+                "rejected until it reconnects",
+                live=0, max_batch=0, free_pages=0, num_pages=0,
+                retries=0,
+                retry_after=min(5.0, max(0.5, float(self.ttl or 1.0))))
         creq = ClusterRequest(
             prompt_ids, max_new_tokens, eos_token_id, deadline,
             token_budget, priority, retry_budget,
@@ -1675,8 +1771,66 @@ class ServingCluster:
         self._route(creq)
         return creq
 
+    def _live_hosts(self):
+        """Membership scan that tolerates store outages. A successful
+        scan refreshes the last-known-membership cache; an unreachable
+        store serves the cache instead, age-stamped on the
+        ``cluster_membership_cache_age_seconds`` gauge — a store
+        outage is NOT a replica death, so routing and the sweep keep
+        working from the cached view (process death via ``alive()``
+        still surfaces). On reconnect, a lenient window of
+        ttl + outage credit unions the cache into the live set while
+        replicas re-register their leases against the (possibly
+        restarted) server."""
+        now = time.monotonic()
+        gen = getattr(self.store, "restarts", None)
+        try:
+            hosts = set(self.store.hosts())
+        except StoreUnavailableError:
+            if self._outage_since is None:
+                self._outage_since = now
+            if self._member_cache_t is not None:
+                self._m_cache_age.set(now - self._member_cache_t)
+            return set(self._member_cache)
+        # a server RESTART can be invisible to this thread's exception
+        # bookkeeping (a short outage may be fully absorbed by other
+        # threads' retry envelopes on the shared client) — but the
+        # session's boot-nonce generation can't miss it
+        cur_gen = gen() if gen is not None else 0
+        restarted = cur_gen != getattr(self, "_store_gen", 0)
+        self._store_gen = cur_gen
+        if self._outage_since is not None or restarted:
+            outage = 0.0 if self._outage_since is None \
+                else now - self._outage_since
+            self._outage_since = None
+            # outage credit: cached heartbeats could not refresh while
+            # the server was down, and a restarted server holds no
+            # leases until replicas re-register — suppress age-out
+            # verdicts for ttl + credit while membership reconverges
+            credit = min(30.0, max(outage, 1.0 if restarted else 0.0))
+            self._lenient_until = now + float(self.ttl or 0.0) + credit
+        if now < self._lenient_until:
+            hosts |= self._member_cache
+        else:
+            self._member_cache = set(hosts)
+        self._member_cache_t = now
+        self._m_cache_age.set(0.0)
+        return hosts
+
+    def _store_outage_age(self):
+        # the store client stamps its outage at the FIRST unanswered
+        # attempt — earlier (and so more honest for the admission
+        # grace) than the sweep noticing a whole scan's retry
+        # envelope failed
+        age = getattr(self.store, "outage_age", None)
+        if age is not None:
+            return age()
+        if self._outage_since is None:
+            return 0.0
+        return time.monotonic() - self._outage_since
+
     def _routable(self, exclude=()):
-        live_hosts = set(self.store.hosts())
+        live_hosts = self._live_hosts()
         with self._lock:
             reps = [r for rid, r in self._replicas.items()
                     if rid not in exclude
@@ -1780,8 +1934,11 @@ class ServingCluster:
 
     def _sweep(self):
         if self._elastic is not None:
-            self._elastic.watch_once()      # live-host gauge + events
-        live_hosts = set(self.store.hosts())
+            try:
+                self._elastic.watch_once()  # live-host gauge + events
+            except OSError:
+                pass    # store outage: membership events pause
+        live_hosts = self._live_hosts()
         now = time.monotonic()
         with self._lock:
             reps = [(rid, r) for rid, r in self._replicas.items()

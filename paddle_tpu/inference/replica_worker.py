@@ -36,6 +36,11 @@ Environment contract (set by :class:`SubprocessReplica`):
 
 - ``PADDLE_TPU_REPLICA_ID`` — replica name (rpc address + membership id)
 - ``PADDLE_TPU_REPLICA_STORE`` — FileStore membership directory
+- ``PADDLE_TPU_REPLICA_STORE_ADDR`` — ``host:port`` of a
+  :class:`~paddle_tpu.distributed.net_store.LeaseStoreServer`;
+  replaces ``PADDLE_TPU_REPLICA_STORE`` in TCP-only deployments
+  (membership AND the rpc mailbox ride the lease server — no shared
+  filesystem is touched)
 - ``PADDLE_TPU_REPLICA_RPC`` — ``host:port`` of the router's TCPStore
 - ``PADDLE_TPU_REPLICA_SPEC`` — JSON engine spec (below)
 - ``PADDLE_TPU_REPLICA_TTL`` — membership TTL seconds (optional)
@@ -309,7 +314,13 @@ def replica_main():
 
     t0 = float(os.environ.get("PADDLE_TPU_REPLICA_T0") or time.time())
     replica_id = os.environ["PADDLE_TPU_REPLICA_ID"]
-    store_path = os.environ["PADDLE_TPU_REPLICA_STORE"]
+    store_path = os.environ.get("PADDLE_TPU_REPLICA_STORE")
+    store_addr = os.environ.get("PADDLE_TPU_REPLICA_STORE_ADDR")
+    if store_path is None and store_addr is None:
+        raise RuntimeError(
+            "replica worker needs PADDLE_TPU_REPLICA_STORE (FileStore "
+            "dir) or PADDLE_TPU_REPLICA_STORE_ADDR (LeaseStore "
+            "host:port)")
     rpc_addr = os.environ["PADDLE_TPU_REPLICA_RPC"]
     spec = json.loads(os.environ["PADDLE_TPU_REPLICA_SPEC"])
     ttl_env = os.environ.get("PADDLE_TPU_REPLICA_TTL")
@@ -341,7 +352,15 @@ def replica_main():
         # the persistent cache — BEFORE this replica enters membership
         return LlamaServingEngine(model, **engine_kw)
 
-    store = FileStore(store_path, ttl=ttl)
+    if store_addr is not None:
+        # TCP-only control plane: membership leases live on the
+        # LeaseStoreServer — nothing in this process touches a shared
+        # filesystem (replica and router may be on different hosts)
+        from ..distributed.net_store import LeaseStore
+
+        store = LeaseStore(store_addr, ttl=ttl)
+    else:
+        store = FileStore(store_path, ttl=ttl)
     rep = EngineReplica(
         replica_id, factory, store=store, ttl=ttl,
         max_backlog=int(backlog) if backlog else None,
@@ -356,9 +375,15 @@ def replica_main():
     # replica it has seen in membership (or polled ready), nothing it
     # sends to a registered replica can fall into the resume gap.
     # Pre-engine polls simply report ready=False while compiles run.
-    endpoint_host, _, endpoint_port = rpc_addr.rpartition(":")
-    endpoint = RpcEndpoint(replica_id, host=endpoint_host,
-                           port=int(endpoint_port))
+    if store_addr is not None:
+        # mailbox on the SAME lease server as membership (its own
+        # session): outage tolerance + post-restart seq resync come
+        # from the LeaseStore client, not the native TCPStore
+        endpoint = RpcEndpoint(replica_id, store=store.clone())
+    else:
+        endpoint_host, _, endpoint_port = rpc_addr.rpartition(":")
+        endpoint = RpcEndpoint(replica_id, host=endpoint_host,
+                               port=int(endpoint_port))
 
     # start() builds the engine (compiles included), registers in
     # membership, then starts the worker loop + heartbeat sidecar —
@@ -368,8 +393,10 @@ def replica_main():
     # monotonic<->epoch clock-offset handshake AT registration: the
     # collector needs this process's span-clock base to align its
     # shard with the other processes' timelines (dot-prefixed file:
-    # membership hosts() scans ignore it). No file under METRICS=0.
-    _tracing.record_clock_handshake(store_path, replica_id)
+    # membership hosts() scans ignore it). No file under METRICS=0,
+    # and no file at all in TCP-only mode (no shared dir to put it in)
+    if store_path is not None:
+        _tracing.record_clock_handshake(store_path, replica_id)
 
     # restart -> serving self-probe: one trivial request through the
     # real admission + prefill + decode path proves every serving
@@ -392,18 +419,26 @@ def replica_main():
             # /healthz names the membership epoch + heartbeat age so
             # an operator can spot a fenced-out stale incarnation from
             # the probe alone (ISSUE 11 satellite)
+            try:
+                hb_age = store.heartbeat_age(replica_id)
+            except OSError:
+                hb_age = None   # store outage: age unknown — the
+                # probe itself must keep answering
             return {"replica_id": replica_id, "epoch": rep.epoch,
                     "fenced": rep._fenced,
-                    "membership_heartbeat_age_seconds":
-                        store.heartbeat_age(replica_id)}
+                    "membership_heartbeat_age_seconds": hb_age}
 
         srv = start_http_server(port=int(health_port), ready=rep.ready,
                                 health_info=_health_info)
         # port=0 picks a free port; publish it next to the membership
-        # stamps (dot-prefixed: hosts() ignores it)
-        with open(os.path.join(store_path, f".http.{replica_id}"),
-                  "w") as f:
-            f.write(str(srv.port))
+        # stamps (dot-prefixed: hosts() ignores it). TCP-only mode has
+        # no shared dir — publish through the lease store's KV instead
+        if store_path is not None:
+            with open(os.path.join(store_path, f".http.{replica_id}"),
+                      "w") as f:
+                f.write(str(srv.port))
+        else:
+            store.set(f"http/{replica_id}", str(srv.port).encode())
 
     flush_every = float(os.environ.get("PADDLE_TPU_TRACE_FLUSH")
                         or 0.5)

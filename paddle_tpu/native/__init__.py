@@ -79,7 +79,7 @@ class TCPStore:
             if value else None
         if self._lib.pts_store_set(self._client, key.encode(), buf,
                                    len(value)) != 0:
-            raise RuntimeError("TCPStore.set failed (connection lost?)")
+            raise self._unavailable("set")
 
     def get(self, key, timeout=None):
         t = self.timeout if timeout is None else timeout
@@ -96,8 +96,16 @@ class TCPStore:
     def add(self, key, delta=1):
         v = self._lib.pts_store_add(self._client, key.encode(), delta)
         if v == -(2 ** 63):
-            raise RuntimeError("TCPStore.add failed (connection lost?)")
+            raise self._unavailable("add")
         return v
+
+    def _unavailable(self, op):
+        # typed so no bare transport RuntimeError can reach a serving
+        # dispatch path; lazy import avoids a module cycle (net_store
+        # imports this package for the optional KV offload)
+        from ..distributed.net_store import StoreUnavailableError
+        return StoreUnavailableError(f"{self.host}:{self.port}", op,
+                                     detail="connection lost")
 
     def wait(self, keys, timeout=None):
         t = self.timeout if timeout is None else timeout

@@ -107,6 +107,31 @@ endpoint names), ``p`` (per-message fire probability, drawn from a
 rule-local ``random.Random(seed)`` so a seeded chaos schedule replays
 identically), ``seed``, ``copies``.
 
+Store rules (ISSUE 20): a rule whose ``point`` is a SOCKET point of
+the TCP control-plane store (``store.connect`` — fired per connection
+attempt of a :class:`~paddle_tpu.distributed.net_store.LeaseStore`
+client, ``path`` = the server address; ``store.frame`` — fired per
+request frame, ``path`` = the op name, ``step`` = the client's op
+ordinal) is a :class:`StoreRule` — it fires via :func:`fire_store` and
+returns a :class:`StoreVerdict` the CLIENT interprets (so seeded
+chaos stays deterministic regardless of server threading):
+
+- ``refuse``: the connection is refused (``ConnectionRefusedError``) —
+  the server port is closed.
+- ``reset``: the socket is reset mid-operation
+  (``ConnectionResetError``) — the server died under the client.
+- ``hang``: the operation blocks ``seconds`` (default 1.0), then times
+  out — a black-holed route.
+- ``slow``: the operation is delayed ``seconds`` (default 0.05), then
+  proceeds — a congested link.
+- ``torn``: the frame arrives truncated — the client must treat it as
+  a transport failure, never decode garbage.
+
+Store rules take the same ``p``/``seed``/``count``/``step``/``path``/
+``env`` fields as network rules; every store-client failure they
+induce surfaces as a typed ``StoreUnavailableError`` through the
+normal retry/reconnect machinery.
+
 Plans are VALIDATED at parse time: an unknown rule key, an unknown
 action, or a point name that no instrumented call site registers
 raises a clear ``ValueError`` — a typo'd chaos plan fails loudly
@@ -124,9 +149,10 @@ import threading
 import time
 
 __all__ = ["PLAN_ENV", "FaultRule", "NetworkRule", "NetworkVerdict",
-           "FaultPlan", "plan", "reset", "active", "fire",
-           "fire_copy", "fire_network", "rename", "bitflip",
-           "PROCESS_POINTS", "NETWORK_POINTS"]
+           "StoreRule", "StoreVerdict", "FaultPlan", "plan", "reset",
+           "active", "fire", "fire_copy", "fire_network", "fire_store",
+           "rename", "bitflip", "PROCESS_POINTS", "NETWORK_POINTS",
+           "STORE_POINTS"]
 
 #: environment variable holding the JSON fault plan
 PLAN_ENV = "PADDLE_TPU_FAULTS"
@@ -148,11 +174,19 @@ PROCESS_POINTS = frozenset({
 #: instrumented message points — :func:`fire_network` call sites
 NETWORK_POINTS = frozenset({"rpc.send", "rpc.reply", "store.heartbeat"})
 
+_STORE_ACTIONS = ("refuse", "reset", "hang", "slow", "torn")
+
+#: instrumented socket points of the TCP control-plane store —
+#: :func:`fire_store` call sites (client side, for determinism)
+STORE_POINTS = frozenset({"store.connect", "store.frame"})
+
 _RULE_KEYS = frozenset({"point", "action", "step", "path", "env",
                         "count", "seconds", "exit_code", "exc"})
 _NET_RULE_KEYS = frozenset({"point", "action", "src", "dst", "p",
                             "seed", "count", "step", "seconds",
                             "copies", "env"})
+_STORE_RULE_KEYS = frozenset({"point", "action", "step", "path", "p",
+                              "seed", "count", "seconds", "env"})
 
 #: injectable exception types for ``raise`` rules — a closed set, so a
 #: plan can't name arbitrary symbols
@@ -176,7 +210,8 @@ class FaultRule:
                 f"unregistered fault point {self.point!r}; instrumented "
                 f"points are {sorted(PROCESS_POINTS)} (network points "
                 f"{sorted(NETWORK_POINTS)} take network actions "
-                f"{_NET_ACTIONS})")
+                f"{_NET_ACTIONS}; store points {sorted(STORE_POINTS)} "
+                f"take store actions {_STORE_ACTIONS})")
         self.action = spec["action"]
         if self.action not in _ACTIONS:
             raise ValueError(
@@ -352,24 +387,133 @@ class NetworkRule:
         return verdict
 
 
+class StoreVerdict:
+    """What the matching store rules decided for ONE socket operation.
+    The CLIENT interprets it (see the module docstring): ``slow`` /
+    ``hang`` are seconds to sleep (hang then raises a timeout),
+    ``refuse`` / ``reset`` / ``torn`` are the typed failure to
+    simulate."""
+
+    __slots__ = ("refuse", "reset", "hang", "slow", "torn")
+
+    def __init__(self):
+        self.refuse = False
+        self.reset = False
+        self.hang = 0.0
+        self.slow = 0.0
+        self.torn = False
+
+    def __bool__(self):
+        return self.refuse or self.reset or self.torn \
+            or self.hang > 0 or self.slow > 0
+
+    def __repr__(self):
+        return (f"StoreVerdict(refuse={self.refuse}, "
+                f"reset={self.reset}, hang={self.hang}, "
+                f"slow={self.slow}, torn={self.torn})")
+
+
+#: shared falsy verdict returned when no store rule matched
+_NO_STORE_VERDICT = StoreVerdict()
+
+
+class StoreRule:
+    """One parsed store-socket plan entry (points ``store.connect`` /
+    ``store.frame``). Matching mirrors :class:`NetworkRule`'s seeded
+    determinism; the verdict is applied by the store client."""
+
+    def __init__(self, spec):
+        unknown = set(spec) - _STORE_RULE_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown store fault rule key(s) {sorted(unknown)}; "
+                f"expected a subset of {sorted(_STORE_RULE_KEYS)}")
+        self.point = spec["point"]
+        if self.point not in STORE_POINTS:
+            raise ValueError(
+                f"unregistered store fault point {self.point!r}; "
+                f"instrumented socket points are "
+                f"{sorted(STORE_POINTS)}")
+        self.action = spec["action"]
+        if self.action not in _STORE_ACTIONS:
+            raise ValueError(
+                f"unknown store fault action {self.action!r}; "
+                f"expected one of {_STORE_ACTIONS}")
+        self.step = spec.get("step")
+        self.path = spec.get("path")
+        self.p = float(spec.get("p", 1.0))
+        if not 0.0 <= self.p <= 1.0:
+            raise ValueError(f"store rule p={self.p} outside [0, 1]")
+        self.seed = int(spec.get("seed", 0))
+        self.count = spec.get("count")
+        self.seconds = spec.get("seconds")
+        self.env = spec.get("env") or {}
+        self._rng = random.Random(self.seed)
+        self.fired = 0
+
+    def matches(self, point, step, path):
+        if point != self.point:
+            return False
+        if self.count is not None and self.fired >= self.count:
+            return False
+        if self.step is not None and step != self.step:
+            return False
+        if self.path is not None:
+            if path is None:
+                return False
+            if not fnmatch.fnmatch(str(path), self.path):
+                return False
+        for k, v in self.env.items():
+            if os.environ.get(k) != str(v):
+                return False
+        if self.p < 1.0 and self._rng.random() >= self.p:
+            return False
+        return True
+
+    def apply(self, verdict):
+        self.fired += 1
+        if self.action == "refuse":
+            verdict.refuse = True
+        elif self.action == "reset":
+            verdict.reset = True
+        elif self.action == "hang":
+            verdict.hang += self.seconds if self.seconds is not None \
+                else 1.0
+        elif self.action == "slow":
+            verdict.slow += self.seconds if self.seconds is not None \
+                else 0.05
+        elif self.action == "torn":
+            verdict.torn = True
+        return verdict
+
+
 class FaultPlan:
     def __init__(self, rules):
         self.rules = []
         self.net_rules = []
+        self.store_rules = []
         # network matching mutates rule state (count, seeded rng,
         # partition window) and is called from concurrent rpc driver
         # threads and heartbeat sidecars: serialize it, or a count=1
         # rule fires twice and seeded replays stop being deterministic
         self._net_lock = threading.Lock()
         for r in rules:
-            if isinstance(r, (FaultRule, NetworkRule)):
+            if isinstance(r, (FaultRule, NetworkRule, StoreRule)):
                 rule = r
+            elif r.get("point") in STORE_POINTS:
+                # socket points take store actions only — routed by
+                # point, since "hang" is also a process action
+                rule = StoreRule(r)
             elif r.get("action") in _NET_ACTIONS:
                 rule = NetworkRule(r)
             else:
                 rule = FaultRule(r)
-            (self.net_rules if isinstance(rule, NetworkRule)
-             else self.rules).append(rule)
+            if isinstance(rule, NetworkRule):
+                self.net_rules.append(rule)
+            elif isinstance(rule, StoreRule):
+                self.store_rules.append(rule)
+            else:
+                self.rules.append(rule)
 
     def fire(self, point, step=None, path=None):
         for rule in self.rules:
@@ -397,6 +541,14 @@ class FaultPlan:
                 if rule.matches(point, src, dst, step):
                     verdict = rule.apply(verdict or NetworkVerdict())
         return verdict if verdict is not None else _NO_VERDICT
+
+    def fire_store(self, point, step=None, path=None):
+        verdict = None
+        with self._net_lock:
+            for rule in self.store_rules:
+                if rule.matches(point, step, path):
+                    verdict = rule.apply(verdict or StoreVerdict())
+        return verdict if verdict is not None else _NO_STORE_VERDICT
 
 
 _plan: "FaultPlan | None" = None
@@ -456,6 +608,20 @@ def fire_network(point, src=None, dst=None, step=None):
     if p is None:
         return _NO_VERDICT
     return p.fire_network(point, src=src, dst=dst, step=step)
+
+
+def fire_store(point, step=None, path=None):
+    """Socket-point hook (``store.connect`` / ``store.frame``):
+    returns the merged :class:`StoreVerdict` of every matching store
+    rule (a shared falsy verdict without a plan — one cached-None
+    check on the hot path). The store CLIENT applies the verdict —
+    sleeping for ``slow``/``hang`` and raising the typed connection
+    failure — so every injected fault flows through the same
+    retry/reconnect machinery a real one would."""
+    p = plan()
+    if p is None:
+        return _NO_STORE_VERDICT
+    return p.fire_store(point, step=step, path=path)
 
 
 def rename(src, dst, step=None):

@@ -38,7 +38,8 @@ _WEDGE_GUARD_MODULES = {"test_serving", "test_serving_lifecycle",
                         "test_moe_serving", "test_partition_tolerance",
                         "test_ragged_attention", "test_fused_ce",
                         "test_weight_quant", "test_distributed_tracing",
-                        "test_perf_attribution", "test_kv_tier"}
+                        "test_perf_attribution", "test_kv_tier",
+                        "test_net_store"}
 
 # per-module budgets where the default is wrong: subprocess-cluster
 # tests legitimately wait out several worker-process startups (import +
@@ -79,7 +80,11 @@ _WEDGE_BUDGETS = {"test_subprocess_cluster": 700.0,
                   # engine per fp/int8 x spec-on/off variant, and the
                   # copy-chaos soak ping-pongs requests through slow
                   # injected D2H/H2D copies
-                  "test_kv_tier": 600.0}
+                  "test_kv_tier": 600.0,
+                  # the store chaos smoke waits out two standalone
+                  # lease-server process startups (full package import
+                  # each) plus the outage grace windows
+                  "test_net_store": 600.0}
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,653 @@
+"""Cross-host control plane (ISSUE 20): the TCP LeaseStore — contract
+parity with FileStore, typed outage errors, reconnect/restart
+detection, rpc mailboxes riding the store, store-socket fault
+injection, cluster degradation during a store outage, and the seeded
+TCP-only chaos smoke.
+
+The fast smoke runs on every PR (tier-1): a 2-replica TCP-only cluster
+(no shared filesystem — membership and every rpc mailbox ride one
+standalone lease-server process) under continuous load survives a
+replica SIGKILL and a store-server SIGKILL-and-same-port-restart;
+every request ends completed-token-exact or typed, the client counted
+reconnects, and no healthy replica was failed over on store silence
+alone.
+"""
+
+import json
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import native
+from paddle_tpu.distributed.net_store import (LeaseStore,
+                                              LeaseStoreServer,
+                                              StoreUnavailableError,
+                                              parse_addr)
+from paddle_tpu.distributed.rpc import RpcEndpoint
+from paddle_tpu.distributed.watchdog import FileStore, StaleEpochError
+from paddle_tpu.inference.cluster import ReplicaLostError, ServingCluster
+from paddle_tpu.inference.serving import (AdmissionError,
+                                          DeadlineExceeded,
+                                          LlamaServingEngine)
+from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(tiny_llama_config())
+    m.eval()
+    return m
+
+
+def _factory(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 48)
+    return lambda: LlamaServingEngine(model, **kw)
+
+
+def _reference_continuation(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], np.int64))
+    out = model.generate(ids, max_new_tokens=n)
+    return np.asarray(out._data)[0, len(prompt):].tolist()
+
+
+def _wait(cond, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    os.environ.pop(faults.PLAN_ENV, None)
+    faults.reset()
+
+
+def _plan(rules):
+    os.environ[faults.PLAN_ENV] = json.dumps(rules)
+    faults.reset()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------
+# store contract (satellite): one suite, both backends — the TCP store
+# must be a drop-in for the filesystem store, fence semantics included
+# ---------------------------------------------------------------------
+@pytest.fixture(params=["file", "lease"])
+def store(request, tmp_path):
+    if request.param == "file":
+        yield FileStore(str(tmp_path / "m"), ttl=0.5)
+        return
+    srv = LeaseStoreServer()
+    st = LeaseStore(f"127.0.0.1:{srv.port}", ttl=0.5)
+    yield st
+    st.close()
+    srv.stop()
+
+
+def _second_handle(store):
+    """A fresh handle on the SAME authoritative state (what a second
+    process would hold)."""
+    if isinstance(store, FileStore):
+        return FileStore(store.path, ttl=store.ttl)
+    return store.clone()
+
+
+class TestStoreContract:
+    def test_register_hosts_deregister(self, store):
+        assert store.hosts() == []
+        store.register("r0")
+        store.register("r1")
+        assert store.hosts() == ["r0", "r1"]
+        store.deregister("r0")
+        assert store.hosts() == ["r1"]
+        store.deregister("r0")          # idempotent
+
+    def test_heartbeat_refreshes_and_ttl_ages_out(self, store):
+        store.register("r0")
+        time.sleep(0.3)
+        assert store.heartbeat("r0") is True
+        time.sleep(0.3)
+        # 0.6s after register but only 0.3 after the beat: still live
+        assert "r0" in store.hosts()
+        time.sleep(0.7)
+        assert "r0" not in store.hosts()
+
+    def test_heartbeat_age(self, store):
+        assert store.heartbeat_age("ghost") is None
+        store.register("r0")
+        age = store.heartbeat_age("r0")
+        assert age is not None and 0.0 <= age < 0.5
+
+    def test_epoch_fencing_identical(self, store):
+        e1 = store.next_epoch("r0")
+        store.register("r0", epoch=e1)
+        assert store.heartbeat("r0", epoch=e1) is True
+        e2 = store.next_epoch("r0")
+        store.register("r0", epoch=e2)
+        c0 = om.counter("cluster_stale_epoch_rejections_total").value
+        with pytest.raises(StaleEpochError) as ei:
+            store.heartbeat("r0", epoch=e1)
+        assert (ei.value.host_id, ei.value.epoch, ei.value.current) \
+            == ("r0", e1, e2)
+        with pytest.raises(StaleEpochError):
+            store.check_epoch("r0", e1)
+        if om.enabled():
+            assert om.counter(
+                "cluster_stale_epoch_rejections_total").value > c0
+
+    def test_fence_survives_deregistration(self, store):
+        e1 = store.next_epoch("r0")
+        store.register("r0", epoch=e1)
+        store.deregister("r0")
+        store.next_epoch("r0")          # the replacement's bump
+        with pytest.raises(StaleEpochError):
+            store.register("r0", epoch=e1)
+        assert store.hosts() == []
+
+    def test_epoch_counter_monotonic_across_handles(self, store):
+        assert store.epoch_of("a") is None
+        assert [store.next_epoch("a") for _ in range(3)] == [1, 2, 3]
+        assert store.epoch_of("a") == 3
+        second = _second_handle(store)
+        try:
+            assert second.next_epoch("a") == 4
+        finally:
+            if isinstance(second, LeaseStore):
+                second.close()
+
+
+# ---------------------------------------------------------------------
+# KV surface: native-TCPStore parity on the pure-Python wire
+# ---------------------------------------------------------------------
+class TestLeaseStoreKV:
+    @pytest.fixture()
+    def kv(self):
+        srv = LeaseStoreServer()
+        st = LeaseStore(f"127.0.0.1:{srv.port}")
+        yield st
+        st.close()
+        srv.stop()
+
+    def test_set_get_roundtrip(self, kv):
+        kv.set("k", b"\x00binary\xff")
+        assert kv.get("k") == b"\x00binary\xff"
+        kv.set("s", "text")             # str values encode
+        assert kv.get("s") == b"text"
+
+    def test_get_blocks_until_set(self, kv):
+        other = kv.clone()
+        t = threading.Timer(0.2, lambda: other.set("late", b"v"))
+        t.start()
+        try:
+            t0 = time.monotonic()
+            assert kv.get("late", timeout=5.0) == b"v"
+            assert time.monotonic() - t0 >= 0.1
+        finally:
+            t.join()
+            other.close()
+
+    def test_get_timeout_is_bare_timeout(self, kv):
+        # no-key-yet is NOT an outage: bare TimeoutError, matching the
+        # native TCPStore (rpc's resync logic depends on telling the
+        # two apart)
+        with pytest.raises(TimeoutError) as ei:
+            kv.get("never", timeout=0.1)
+        assert not isinstance(ei.value, StoreUnavailableError)
+
+    def test_wait_and_delete(self, kv):
+        kv.set("w", b"1")
+        kv.wait("w", timeout=1.0)
+        kv.wait(["w"], timeout=1.0)
+        assert kv.delete_key("w") is True
+        assert kv.delete_key("w") is False
+
+    def test_add_counter_bytes_parity(self, kv):
+        # add keys hold a little-endian int64 — the representation the
+        # rpc seq machinery decodes with int.from_bytes(raw, "little")
+        assert kv.add("c", 5) == 5
+        assert kv.add("c", -2) == 3
+        raw = kv.get("c")
+        assert len(raw) == 8
+        assert int.from_bytes(raw, "little", signed=True) == 3
+
+    def test_num_keys_and_barrier(self, kv):
+        n0 = kv.num_keys()
+        kv.set("a", b"1")
+        assert kv.num_keys() == n0 + 1
+        kv.barrier(1, tag="t0", timeout=5.0)
+
+
+# ---------------------------------------------------------------------
+# typed outage error (tentpole): picklable, ConnectionError-shaped
+# ---------------------------------------------------------------------
+class TestStoreUnavailableError:
+    def test_typed_fields_and_pickle(self):
+        e = StoreUnavailableError("10.0.0.5:2379", "heartbeat",
+                                  detail="boom")
+        assert isinstance(e, ConnectionError)     # hence OSError
+        assert "10.0.0.5:2379" in str(e) and "heartbeat" in str(e)
+        e2 = pickle.loads(pickle.dumps(e))
+        assert type(e2) is StoreUnavailableError
+        assert (e2.addr, e2.op, e2.detail) \
+            == ("10.0.0.5:2379", "heartbeat", "boom")
+
+    def test_unreachable_server_raises_typed(self):
+        st = LeaseStore(f"127.0.0.1:{_free_port()}", retries=1,
+                        backoff=0.01)
+        with pytest.raises(StoreUnavailableError) as ei:
+            st.ping()
+        assert ei.value.op == "ping"
+        assert ei.value.addr == st.addr
+        assert st.outage_age() > 0.0
+
+    def test_parse_addr(self):
+        assert parse_addr("10.0.0.5:2379") == ("10.0.0.5", 2379)
+        assert parse_addr(("h", 1)) == ("h", 1)
+        assert parse_addr(":80") == ("127.0.0.1", 80)
+
+    @pytest.mark.skipif(not native.available(),
+                        reason="native toolchain unavailable")
+    def test_native_tcpstore_maps_transport_errors(self):
+        # satellite: the C++ client's set/add transport failures are
+        # typed too — no bare RuntimeError reaches a dispatch path
+        master = native.TCPStore(is_master=True, port=0)
+        client = native.TCPStore(port=master.port)
+        master.close()
+        with pytest.raises(StoreUnavailableError) as ei:
+            client.set("k", b"v")
+        assert ei.value.op == "set"
+        with pytest.raises(StoreUnavailableError):
+            client.add("k", 1)
+        client.close()
+
+
+# ---------------------------------------------------------------------
+# reconnect + restart detection (tentpole)
+# ---------------------------------------------------------------------
+class TestReconnect:
+    def test_restart_bumps_generation_and_counts(self):
+        srv = LeaseStoreServer()
+        port = srv.port
+        st = LeaseStore(f"127.0.0.1:{port}", retries=6, backoff=0.05)
+        try:
+            assert st.ping() is True
+            assert st.restarts() == 0
+            r0 = om.counter("store_reconnects_total").value
+            srv.stop()
+            fast = st.clone()
+            fast.retries = 0
+            with pytest.raises(StoreUnavailableError):
+                fast.ping()
+            fast.close()
+            srv = LeaseStoreServer(port=port)
+            # the surviving client's retry envelope rides out the
+            # restart and notices the new boot nonce
+            assert st.ping() is True
+            assert st.restarts() == 1
+            assert st.outage_age() == 0.0
+            if om.enabled():
+                assert om.counter(
+                    "store_reconnects_total").value > r0
+        finally:
+            st.close()
+            srv.stop()
+
+    def test_server_keeps_epochs_but_restart_loses_them(self):
+        srv = LeaseStoreServer()
+        port = srv.port
+        st = LeaseStore(f"127.0.0.1:{port}", retries=6, backoff=0.05)
+        try:
+            assert st.next_epoch("r0") == 1
+            srv.stop()
+            srv = LeaseStoreServer(port=port)
+            # a restarted server forgot the counter — adopt-max
+            # healing: the first fenced stamp re-establishes the fence
+            assert st.epoch_of("r0") is None
+            st.register("r0", epoch=7)
+            assert st.epoch_of("r0") == 7
+            with pytest.raises(StaleEpochError):
+                st.heartbeat("r0", epoch=3)
+        finally:
+            st.close()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------
+# store-socket fault points (satellite): plan validation + seeded
+# replay + typed behavior through the client's retry envelope
+# ---------------------------------------------------------------------
+class TestStoreFaultPoints:
+    def test_unknown_store_rule_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown store fault rule"):
+            faults.FaultPlan([{"point": "store.frame",
+                               "action": "refuse", "setp": 1}])
+
+    def test_unregistered_store_point_rejected(self):
+        # routing is by POINT, so a typo'd point falls through to the
+        # process registry and fails loudly there
+        with pytest.raises(ValueError, match="unregistered"):
+            faults.FaultPlan([{"point": "store.frme",
+                               "action": "refuse"}])
+
+    def test_unknown_store_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown store fault action"):
+            faults.FaultPlan([{"point": "store.connect",
+                               "action": "explode"}])
+
+    def test_probability_out_of_range_rejected(self):
+        with pytest.raises(ValueError, match="outside"):
+            faults.FaultPlan([{"point": "store.frame",
+                               "action": "reset", "p": 1.5}])
+
+    def test_seeded_probability_replays_identically(self):
+        spec = {"point": "store.frame", "action": "reset", "p": 0.5,
+                "seed": 11}
+        draws = []
+        for _ in range(2):
+            rule = faults.StoreRule(spec)
+            draws.append([rule.matches("store.frame", i, "ping")
+                          for i in range(32)])
+        assert draws[0] == draws[1]
+        assert any(draws[0]) and not all(draws[0])
+
+    def test_connect_refused_once_retries_through(self):
+        srv = LeaseStoreServer()
+        st = LeaseStore(f"127.0.0.1:{srv.port}", retries=3,
+                        backoff=0.01)
+        try:
+            _plan([{"point": "store.connect", "action": "refuse",
+                    "count": 1}])
+            assert st.ping() is True    # second attempt connects
+        finally:
+            st.close()
+            srv.stop()
+
+    def test_frame_reset_midsession_reconnects_typed(self):
+        srv = LeaseStoreServer()
+        st = LeaseStore(f"127.0.0.1:{srv.port}", retries=3,
+                        backoff=0.01)
+        try:
+            assert st.ping() is True
+            r0 = om.counter("store_reconnects_total").value
+            _plan([{"point": "store.frame", "action": "torn",
+                    "count": 1}])
+            assert st.ping() is True    # dropped session, reconnected
+            if om.enabled():
+                assert om.counter(
+                    "store_reconnects_total").value > r0
+            # exhausting the budget surfaces the typed error
+            _plan([{"point": "store.frame", "action": "reset"}])
+            with pytest.raises(StoreUnavailableError):
+                st.ping()
+        finally:
+            st.close()
+            srv.stop()
+
+    def test_frame_path_filter_targets_one_op(self):
+        srv = LeaseStoreServer()
+        st = LeaseStore(f"127.0.0.1:{srv.port}", retries=0)
+        try:
+            assert st.ping() is True
+            _plan([{"point": "store.frame", "action": "reset",
+                    "path": "hosts"}])
+            with pytest.raises(StoreUnavailableError):
+                st.hosts()
+            assert st.ping() is True    # other ops untouched
+        finally:
+            st.close()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------
+# rpc mailboxes riding the LeaseStore (tentpole + idle-churn satellite)
+# ---------------------------------------------------------------------
+def _echo(x):
+    return ("echo", x)
+
+
+class TestRpcOverLeaseStore:
+    def test_call_roundtrip_and_idle_churn(self):
+        srv = LeaseStoreServer()
+        base = LeaseStore(f"127.0.0.1:{srv.port}", retries=6,
+                          backoff=0.05)
+        router = RpcEndpoint("router", store=base.clone())
+        worker = RpcEndpoint("worker-0", store=base.clone())
+        try:
+            assert router.call_sync("worker-0", _echo, args=(3,),
+                                    timeout=30.0) == ("echo", 3)
+            if not om.enabled():
+                return
+            ops = om.counter("store_ops_total", labelnames=("op",))
+
+            def churn():
+                return ops.labels("wait").value + ops.labels("get").value
+
+            c0 = churn()
+            time.sleep(1.2)
+            # blocking wait (2s idle cap): each idle dispatcher issues
+            # ~1 op per 2s — the old 0.25s get poll would burn ~5 ops
+            # per mailbox in this window (2 mailboxes -> 10+)
+            assert churn() - c0 <= 6
+        finally:
+            router.stop()
+            worker.stop()
+            base.close()
+            srv.stop()
+
+    def test_mailbox_resyncs_across_server_restart(self):
+        srv = LeaseStoreServer()
+        port = srv.port
+        base = LeaseStore(f"127.0.0.1:{port}", retries=6, backoff=0.05)
+        router = RpcEndpoint("router", store=base.clone())
+        worker = RpcEndpoint("worker-0", store=base.clone())
+        try:
+            assert router.call_sync("worker-0", _echo, args=(1,),
+                                    timeout=30.0) == ("echo", 1)
+            srv.stop()
+            time.sleep(0.3)             # dispatcher sees the outage
+            srv = LeaseStoreServer(port=port)
+            # the restarted server lost every rpc/seq counter; both
+            # agents resync their cursors and the next call lands
+            assert router.call_sync("worker-0", _echo, args=(2,),
+                                    timeout=30.0, retries=4) \
+                == ("echo", 2)
+        finally:
+            router.stop()
+            worker.stop()
+            base.close()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------
+# cluster degradation during a store outage (tentpole acceptance):
+# cached-membership routing, typed admission past the grace window,
+# ZERO failovers on store silence, fresh-epoch re-register on restart
+# ---------------------------------------------------------------------
+def test_cluster_survives_store_outage(model):
+    srv = LeaseStoreServer()
+    port = srv.port
+    cluster = ServingCluster(
+        _factory(model), num_replicas=2,
+        store_addr=f"127.0.0.1:{port}", ttl=0.6,
+        monitor_interval=0.02, auto_replace=True,
+        restart_backoff=0.02, restart_backoff_max=0.2).start()
+    try:
+        _wait(lambda: len(cluster.store.hosts()) == 2, 60,
+              "both replicas registered over TCP")
+        creq = cluster.submit([1, 2, 3], max_new_tokens=3)
+        assert creq.wait(timeout=240) and creq.status == "completed"
+
+        cluster.store_outage_grace = 1.0
+        srv.stop()
+        time.sleep(2.0)                 # silence > grace
+        with pytest.raises(AdmissionError) as ei:
+            cluster.submit([1, 2], max_new_tokens=2)
+        assert ei.value.retry_after > 0.0
+        # membership view is the age-stamped cache, and store silence
+        # alone NEVER fails a replica over
+        deaths = {rid: len(st.deaths)
+                  for rid, st in cluster._restarts.items()}
+        assert all(v == 0 for v in deaths.values()), deaths
+        if om.enabled():
+            # the monitor may still be queued behind heartbeat retry
+            # envelopes on the shared client: poll until ITS next scan
+            # serves from the cache and stamps the age
+            _wait(lambda: om.gauge(
+                "cluster_membership_cache_age_seconds").value > 0.0,
+                15, "membership cache age gauge stamped")
+
+        srv = LeaseStoreServer(port=port)
+        _wait(lambda: len(cluster.store.hosts()) == 2
+              and cluster._store_outage_age() == 0.0, 60,
+              "membership reconverged after restart")
+        time.sleep(1.0)                 # any spurious verdicts surface
+        creq = cluster.submit([1, 2, 3], max_new_tokens=3)
+        assert creq.wait(timeout=240) and creq.status == "completed"
+        deaths = {rid: len(st.deaths)
+                  for rid, st in cluster._restarts.items()}
+        assert all(v == 0 for v in deaths.values()), deaths
+        # the restarted server forgot the epochs: every heartbeat
+        # sidecar re-registered under a freshly minted fence
+        eps = {rid: r.epoch for rid, r in cluster.replicas().items()}
+        assert all(e is not None and e >= 2 for e in eps.values()), eps
+        assert cluster.store.restarts() > 0
+    finally:
+        cluster.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------
+# chaos smoke (tier-1 acceptance): TCP-only cluster, standalone store
+# process — replica SIGKILL, then store SIGKILL + same-port restart
+# ---------------------------------------------------------------------
+def _spawn_store_server(port=0):
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.net_store",
+         "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    line = proc.stdout.readline()
+    assert "listening" in line, line
+    return proc, int(line.strip().rsplit(":", 1)[1])
+
+
+def test_chaos_smoke_store_failover(model):
+    """Seeded chaos on a TCP-only 2-replica cluster: membership and
+    every rpc mailbox ride one standalone lease-server process (no
+    shared filesystem), with seeded frame slowdowns in the background.
+    Phase 1 SIGKILLs replica-0 mid-load (replaced under a bumped
+    epoch); phase 2 SIGKILLs the store server itself, proves admission
+    degrades typed past the grace window, restarts it on the SAME
+    port, and proves reconvergence. Every request ends
+    completed-token-exact or typed, the client counted reconnects, and
+    the replica that was never touched saw zero failovers."""
+    proc, port = _spawn_store_server()
+    proc2 = None
+    _plan([{"point": "store.frame", "action": "slow",
+            "seconds": 0.003, "p": 0.2, "seed": 13}])
+    cluster = ServingCluster(
+        _factory(model), num_replicas=2,
+        store_addr=f"127.0.0.1:{port}", ttl=0.6,
+        monitor_interval=0.02, auto_replace=True, failover_budget=5,
+        restart_backoff=0.02, restart_backoff_max=0.2).start()
+    creqs = []
+    try:
+        _wait(lambda: len(cluster.store.hosts()) == 2, 60,
+              "both replicas registered over TCP")
+        v = model.config.vocab_size
+
+        def mk_prompt(i):
+            return np.random.RandomState(900 + i) \
+                .randint(0, v, (3 + i % 3,)).tolist()
+
+        # phase 1: SIGKILL replica-0 mid-load (no goodbye)
+        creqs += [cluster.submit(mk_prompt(i), max_new_tokens=3)
+                  for i in range(3)]
+        cluster.replicas()["replica-0"].kill()
+        creqs += [cluster.submit(mk_prompt(3 + i), max_new_tokens=3)
+                  for i in range(2)]
+        rep0 = cluster.replicas()["replica-0"]
+        _wait(lambda: rep0.alive() and (rep0.epoch or 0) >= 2, 60,
+              "SIGKILLed replica replaced under a new epoch")
+
+        # phase 2: SIGKILL the store server itself mid-traffic
+        creqs += [cluster.submit(mk_prompt(5 + i), max_new_tokens=3)
+                  for i in range(2)]
+        cluster.store_outage_grace = 0.8
+        r0 = om.counter("store_reconnects_total").value
+        proc.kill()
+        proc.wait()
+        time.sleep(1.6)                 # silence > grace
+        with pytest.raises(AdmissionError) as ei:
+            cluster.submit(mk_prompt(99), max_new_tokens=2)
+        assert ei.value.retry_after > 0.0
+        # in-flight work kept generating through the outage: the data
+        # plane does not ride the store
+
+        # same-port restart: clients reconnect, sidecars re-register
+        proc2, _ = _spawn_store_server(port)
+        _wait(lambda: len(cluster.store.hosts()) == 2
+              and cluster._store_outage_age() == 0.0, 60,
+              "membership reconverged after store restart")
+        creqs += [cluster.submit(mk_prompt(7 + i), max_new_tokens=3)
+                  for i in range(2)]
+
+        # every request terminal: completed token-exact or typed
+        for c in creqs:
+            assert c.wait(timeout=300), f"request stuck: {c.status}"
+        completed = 0
+        for c in creqs:
+            if c.status == "completed":
+                completed += 1
+                assert c.output_ids == _reference_continuation(
+                    model, list(c.prompt_ids), 3)
+            else:
+                assert isinstance(c.error, (AdmissionError,
+                                            DeadlineExceeded,
+                                            ReplicaLostError,
+                                            StoreUnavailableError)), \
+                    (c.status, c.error)
+        assert completed >= len(creqs) - 2
+
+        assert cluster.store.restarts() > 0
+        if om.enabled():
+            assert om.counter("store_reconnects_total").value > r0
+        # replica-1 was never touched: the store outage must not have
+        # failed it over (zero spurious failovers), and nobody tripped
+        # the restart breaker
+        assert len(cluster._restarts["replica-1"].deaths) == 0
+        assert cluster.quarantined() == set()
+    finally:
+        cluster.stop()
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()

@@ -58,6 +58,8 @@ DEFAULT_TABLE = {
     "bench_fused_ce_speedup":           ("higher", 0.08, 0.0),
     "bench_input_stall_frac":           ("lower", 0.10, 0.01),
     "bench_restart_warm_ttft_s":        ("lower", 0.15, 0.1),
+    "bench_store_tcp_op_ms":            ("lower", 0.30, 0.05),
+    "bench_store_reconverge_ms":        ("lower", 0.30, 20.0),
     "bench_kv_tier_resume_speedup":     ("higher", 0.15, 0.0),
     "bench_frontend_stream_overhead_frac": ("lower", 0.0, 0.01),
     "bench_trace_overhead_frac":        ("lower", 0.0, 0.01),
